@@ -1,0 +1,28 @@
+"""deeplearning4j_tpu — a TPU-native deep-learning framework.
+
+A ground-up rebuild of the capabilities of Eclipse Deeplearning4j
+(reference: 007v/deeplearning4j) designed for TPUs: the eager ndarray API
+(ND4J equivalent) and the graph/autodiff engine (SameDiff equivalent) both
+lower to XLA via JAX, whole-program-compiled rather than interpreted
+op-by-op; distributed training uses `jax.sharding` meshes with XLA
+collectives over ICI/DCN instead of Spark/Aeron gradient sharing.
+
+Top-level layout (mirrors the reference's layer map, SURVEY.md §1):
+
+- ``linalg``      — eager NDArray + Nd4j factory (ref: nd4j-api INDArray/Nd4j)
+- ``ops``         — op registry + Pallas kernels (ref: libnd4j declarable ops)
+- ``autodiff``    — SameDiff graph engine   (ref: org.nd4j.autodiff.samediff)
+- ``nn``          — layer/config/network API (ref: deeplearning4j-nn)
+- ``train``       — updaters, losses, listeners, checkpoints (ref: org.nd4j.linalg.learning, org.deeplearning4j.optimize)
+- ``evaluation``  — metrics (ref: org.nd4j.evaluation)
+- ``data``        — datasets/ETL (ref: DataVec + deeplearning4j-data)
+- ``parallel``    — mesh/sharding, DP/TP/SP, parallel inference (ref: deeplearning4j-scaleout)
+- ``models``      — model zoo (ref: deeplearning4j-zoo)
+- ``modelimport`` — Keras h5 import (ref: deeplearning4j-modelimport)
+- ``ui``          — stats listeners/storage (ref: deeplearning4j-ui-parent)
+- ``utils``       — env/flag registry, common helpers (ref: nd4j-common)
+"""
+
+__version__ = "0.1.0"
+
+from deeplearning4j_tpu.utils.environment import Environment  # noqa: F401

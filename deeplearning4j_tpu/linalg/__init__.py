@@ -1,0 +1,3 @@
+from deeplearning4j_tpu.linalg.dtypes import DataType  # noqa: F401
+from deeplearning4j_tpu.linalg.ndarray import NDArray  # noqa: F401
+from deeplearning4j_tpu.linalg import factory as nd  # noqa: F401

@@ -1,0 +1,61 @@
+"""Data types.
+
+Reference parity: ``org.nd4j.linalg.api.buffer.DataType`` (the enum every
+INDArray carries). The TPU-native twist: BFLOAT16 is the preferred compute
+type (MXU-native), FLOAT is the default storage type, DOUBLE exists for
+gradient checks (ref: gradient-check tests run fp64, SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import enum
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class DataType(enum.Enum):
+    DOUBLE = "float64"
+    FLOAT = "float32"
+    HALF = "float16"
+    BFLOAT16 = "bfloat16"
+    INT64 = "int64"
+    INT32 = "int32"
+    INT16 = "int16"
+    INT8 = "int8"
+    UINT64 = "uint64"
+    UINT32 = "uint32"
+    UINT16 = "uint16"
+    UINT8 = "uint8"
+    BOOL = "bool"
+
+    @property
+    def jnp(self):
+        return jnp.dtype(self.value)
+
+    @property
+    def np(self):
+        return np.dtype(self.value)
+
+    def is_fp(self) -> bool:
+        return self in (DataType.DOUBLE, DataType.FLOAT, DataType.HALF, DataType.BFLOAT16)
+
+    def is_int(self) -> bool:
+        return self in (
+            DataType.INT64, DataType.INT32, DataType.INT16, DataType.INT8,
+            DataType.UINT64, DataType.UINT32, DataType.UINT16, DataType.UINT8,
+        )
+
+    @staticmethod
+    def from_dtype(dt) -> "DataType":
+        name = jnp.dtype(dt).name
+        for member in DataType:
+            if member.value == name:
+                return member
+        raise ValueError(f"Unsupported dtype: {dt}")
+
+
+# Type-promotion order used by pairwise ops (ref: ND4J's
+# Nd4j.defaultFloatingPointType + DataTypeUtil promotion rules; we follow
+# jnp's promotion which matches in practice for the supported set).
+DEFAULT_FLOAT = DataType.FLOAT

@@ -1,0 +1,195 @@
+"""Array factory — the ``Nd4j`` static-factory equivalent.
+
+Reference parity: ``org.nd4j.linalg.factory.Nd4j`` (create/zeros/ones/
+rand/randn/arange/linspace/eye/concat/...) plus the default RNG seam
+(``Nd4j.getRandom()``; ref: org.nd4j.linalg.api.rng, counter-based RNG with
+saveable state — SURVEY.md §2.1 "RNG").
+
+TPU-native: randomness is JAX Threefry — the :class:`Random` wrapper keeps
+a (seed, counter) pair so streams are deterministic, forkable, and
+checkpointable (seed→stream contract preserved, not bit-compat with
+libnd4j, per SURVEY.md).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.linalg.dtypes import DataType
+from deeplearning4j_tpu.linalg.ndarray import NDArray, _unwrap
+
+
+class Random:
+    """Stateful, saveable counter-based RNG over JAX Threefry."""
+
+    def __init__(self, seed: int = 0):
+        self._seed = int(seed)
+        self._counter = 0
+        self._lock = threading.Lock()
+
+    def setSeed(self, seed: int) -> None:
+        with self._lock:
+            self._seed = int(seed)
+            self._counter = 0
+
+    def getSeed(self) -> int:
+        return self._seed
+
+    def next_key(self) -> jax.Array:
+        with self._lock:
+            key = jax.random.fold_in(jax.random.PRNGKey(self._seed), self._counter)
+            self._counter += 1
+            return key
+
+    # state save/restore (ref: saveable RNG state)
+    def getState(self):
+        return {"seed": self._seed, "counter": self._counter}
+
+    def setState(self, state) -> None:
+        with self._lock:
+            self._seed = int(state["seed"])
+            self._counter = int(state["counter"])
+
+
+_default_random = Random(seed=np.random.SeedSequence().entropy % (2**31))
+
+
+def getRandom() -> Random:
+    return _default_random
+
+
+def setSeed(seed: int) -> None:
+    _default_random.setSeed(seed)
+
+
+def _shape(args) -> tuple:
+    if len(args) == 1 and isinstance(args[0], (tuple, list)):
+        return tuple(int(s) for s in args[0])
+    return tuple(int(s) for s in args)
+
+
+# ----------------------------------------------------------------- creation
+def create(data, shape=None, dtype: DataType = DataType.FLOAT) -> NDArray:
+    arr = jnp.asarray(np.asarray(data), dtype.jnp)
+    if shape is not None:
+        arr = jnp.reshape(arr, tuple(shape))
+    return NDArray(arr)
+
+
+def zeros(*shape, dtype: DataType = DataType.FLOAT) -> NDArray:
+    return NDArray(jnp.zeros(_shape(shape), dtype.jnp))
+
+
+def ones(*shape, dtype: DataType = DataType.FLOAT) -> NDArray:
+    return NDArray(jnp.ones(_shape(shape), dtype.jnp))
+
+
+def valueArrayOf(shape, value, dtype: DataType = DataType.FLOAT) -> NDArray:
+    return NDArray(jnp.full(tuple(shape), value, dtype.jnp))
+
+
+def full(shape, value, dtype: DataType = DataType.FLOAT) -> NDArray:
+    return valueArrayOf(shape, value, dtype)
+
+
+def zerosLike(arr) -> NDArray:
+    return NDArray(jnp.zeros_like(_unwrap(arr)))
+
+
+def onesLike(arr) -> NDArray:
+    return NDArray(jnp.ones_like(_unwrap(arr)))
+
+
+def eye(n: int, dtype: DataType = DataType.FLOAT) -> NDArray:
+    return NDArray(jnp.eye(n, dtype=dtype.jnp))
+
+
+def arange(*args, dtype: DataType = DataType.FLOAT) -> NDArray:
+    return NDArray(jnp.arange(*args, dtype=dtype.jnp))
+
+
+def linspace(start, stop, num, dtype: DataType = DataType.FLOAT) -> NDArray:
+    return NDArray(jnp.linspace(start, stop, int(num), dtype=dtype.jnp))
+
+
+def scalar(value, dtype: DataType = DataType.FLOAT) -> NDArray:
+    return NDArray(jnp.asarray(value, dtype.jnp))
+
+
+def empty(dtype: DataType = DataType.FLOAT) -> NDArray:
+    return NDArray(jnp.zeros((0,), dtype.jnp))
+
+
+# ------------------------------------------------------------------- random
+def rand(*shape, rng: Optional[Random] = None, dtype: DataType = DataType.FLOAT) -> NDArray:
+    rng = rng or _default_random
+    return NDArray(jax.random.uniform(rng.next_key(), _shape(shape), dtype.jnp))
+
+
+def randn(*shape, rng: Optional[Random] = None, dtype: DataType = DataType.FLOAT) -> NDArray:
+    rng = rng or _default_random
+    return NDArray(jax.random.normal(rng.next_key(), _shape(shape), dtype.jnp))
+
+
+def randint(low: int, high: int, shape, rng: Optional[Random] = None,
+            dtype: DataType = DataType.INT32) -> NDArray:
+    rng = rng or _default_random
+    return NDArray(jax.random.randint(rng.next_key(), tuple(shape), low, high, dtype.jnp))
+
+
+def bernoulli(p: float, shape, rng: Optional[Random] = None) -> NDArray:
+    rng = rng or _default_random
+    return NDArray(jax.random.bernoulli(rng.next_key(), p, tuple(shape)).astype(jnp.float32))
+
+
+def shuffle(arr: NDArray, rng: Optional[Random] = None) -> NDArray:
+    """IN-PLACE row shuffle (ref: Nd4j.shuffle mutates its argument)."""
+    rng = rng or _default_random
+    arr._set_value(jax.random.permutation(rng.next_key(), _unwrap(arr), axis=0))
+    return arr
+
+
+# ----------------------------------------------------------------- combining
+def concat(dim: int, *arrs) -> NDArray:
+    return NDArray(jnp.concatenate([_unwrap(a) for a in arrs], axis=dim))
+
+
+def stack(dim: int, *arrs) -> NDArray:
+    return NDArray(jnp.stack([_unwrap(a) for a in arrs], axis=dim))
+
+
+def vstack(*arrs) -> NDArray:
+    return NDArray(jnp.vstack([_unwrap(a) for a in arrs]))
+
+
+def hstack(*arrs) -> NDArray:
+    return NDArray(jnp.hstack([_unwrap(a) for a in arrs]))
+
+
+def pile(*arrs) -> NDArray:
+    return stack(0, *arrs)
+
+
+def where(cond, x, y) -> NDArray:
+    return NDArray(jnp.where(_unwrap(cond), _unwrap(x), _unwrap(y)))
+
+
+def gather(arr, indices, axis: int = 0) -> NDArray:
+    return NDArray(jnp.take(_unwrap(arr), jnp.asarray(_unwrap(indices)), axis=axis))
+
+
+def sortWithIndices(arr, dim: int = -1, ascending: bool = True):
+    v = _unwrap(arr)
+    idx = jnp.argsort(v, axis=dim)
+    if not ascending:
+        idx = jnp.flip(idx, axis=dim)
+    return NDArray(jnp.take_along_axis(v, idx, axis=dim)), NDArray(idx)
+
+
+def oneHot(indices, depth: int, dtype: DataType = DataType.FLOAT) -> NDArray:
+    return NDArray(jax.nn.one_hot(jnp.asarray(_unwrap(indices), jnp.int32), depth, dtype=dtype.jnp))

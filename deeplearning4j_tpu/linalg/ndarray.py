@@ -1,0 +1,397 @@
+"""Eager NDArray — the INDArray equivalent.
+
+Reference parity: ``org.nd4j.linalg.api.ndarray.INDArray`` /
+``BaseNDArray`` (~300 methods; views, in-place ``*i`` variants,
+broadcasting, ``mmul``). SURVEY.md §2.2 "INDArray API".
+
+TPU-native design (NOT a port of BaseNDArray):
+
+- The array is an immutable ``jax.Array``; "in-place" ``*i`` methods swap
+  the wrapper's buffer (functional under the hood — XLA-friendly, no
+  aliasing machinery). This preserves the reference's *API contract*
+  (``x.addi(y)`` mutates ``x`` as observed by every holder of the same
+  NDArray object) without libnd4j's strided-buffer machinery.
+- Views (``get``, ``getRow``, ``slice_``, ``__getitem__``) return
+  write-back views: mutating a view updates the base via a functional
+  ``at[...].set`` — the observable semantics of ND4J views for the
+  patterns the framework itself uses (param vector regions, row assigns).
+- There is no TAD/stride engine: XLA owns layout (SURVEY.md §2.1 "Shape
+  machinery → mostly vanishes").
+- Ops dispatch straight to jnp/lax; XLA fuses. Eager dispatch is cheap
+  because jax caches per-shape compiled single-op programs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.linalg.dtypes import DataType
+
+Index = Union[int, slice, tuple, "NDArray", jnp.ndarray]
+
+
+def _unwrap(x):
+    if isinstance(x, NDArray):
+        return x.jax()
+    return x
+
+
+class NDArray:
+    """Device ndarray with INDArray-style API over a ``jax.Array``."""
+
+    __slots__ = ("_buf", "_base", "_index")
+    __array_priority__ = 100  # beat numpy in mixed binary ops
+
+    def __init__(self, value, base: Optional["NDArray"] = None, index: Optional[Index] = None):
+        if base is None:
+            if isinstance(value, NDArray):
+                value = value.jax()
+            if not isinstance(value, jax.Array):
+                value = jnp.asarray(value)
+            self._buf = value
+        else:
+            self._buf = None  # views read through to the base, never snapshot
+        self._base = base
+        self._index = index
+
+    # ------------------------------------------------------------------ core
+    @property
+    def _value(self) -> jax.Array:
+        if self._base is not None:
+            return self._base._value[self._index]
+        return self._buf
+
+    def jax(self) -> jax.Array:
+        return self._value
+
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._value)
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(self._value.shape)
+
+    @property
+    def dtype(self) -> DataType:
+        return DataType.from_dtype(self._value.dtype)
+
+    def dataType(self) -> DataType:
+        return self.dtype
+
+    def rank(self) -> int:
+        return self._value.ndim
+
+    def length(self) -> int:
+        return int(np.prod(self.shape)) if self.shape else 1
+
+    def size(self, dim: int) -> int:
+        return self.shape[dim]
+
+    def isView(self) -> bool:
+        return self._base is not None
+
+    def isScalar(self) -> bool:
+        return self._value.ndim == 0 or self.length() == 1
+
+    def isVector(self) -> bool:
+        return self.rank() == 1 or (self.rank() == 2 and 1 in self.shape)
+
+    def isMatrix(self) -> bool:
+        return self.rank() == 2
+
+    def rows(self) -> int:
+        return self.shape[0]
+
+    def columns(self) -> int:
+        return self.shape[1]
+
+    # --------------------------------------------------------- mutation core
+    def _set_value(self, new: jax.Array) -> "NDArray":
+        """Install a new buffer; propagate to base if this is a view."""
+        cur = self._value
+        if new.dtype != cur.dtype:
+            new = new.astype(cur.dtype)
+        if new.shape != cur.shape:
+            raise ValueError(
+                f"in-place op cannot change shape: {cur.shape} -> {new.shape}"
+            )
+        if self._base is not None:
+            self._base._set_value(self._base._value.at[self._index].set(new))
+        else:
+            self._buf = new
+        return self
+
+    def assign(self, other) -> "NDArray":
+        """In-place overwrite (ref: INDArray.assign)."""
+        other = _unwrap(other)
+        return self._set_value(jnp.broadcast_to(jnp.asarray(other, self._value.dtype), self.shape))
+
+    # -------------------------------------------------------------- elementwise
+    def _binary(self, other, fn, inplace: bool = False) -> "NDArray":
+        res = fn(self._value, _unwrap(other))
+        if inplace:
+            return self._set_value(res)
+        return NDArray(res)
+
+    def add(self, o):  return self._binary(o, jnp.add)
+    def sub(self, o):  return self._binary(o, jnp.subtract)
+    def mul(self, o):  return self._binary(o, jnp.multiply)
+    def div(self, o):  return self._binary(o, jnp.divide)
+    def rsub(self, o): return self._binary(o, lambda a, b: b - a)
+    def rdiv(self, o): return self._binary(o, lambda a, b: b / a)
+    def addi(self, o): return self._binary(o, jnp.add, inplace=True)
+    def subi(self, o): return self._binary(o, jnp.subtract, inplace=True)
+    def muli(self, o): return self._binary(o, jnp.multiply, inplace=True)
+    def divi(self, o): return self._binary(o, jnp.divide, inplace=True)
+
+    __add__ = add
+    __sub__ = sub
+    __mul__ = mul
+    __truediv__ = div
+    __radd__ = add
+    __rmul__ = mul
+    def __rsub__(self, o): return self.rsub(o)
+    def __rtruediv__(self, o): return self.rdiv(o)
+    def __neg__(self): return NDArray(-self._value)
+    def __pow__(self, p): return NDArray(self._value ** _unwrap(p))
+    def __matmul__(self, o): return self.mmul(o)
+
+    def neg(self): return NDArray(-self._value)
+    def negi(self): return self._set_value(-self._value)
+
+    # comparison → BOOL arrays (ref: INDArray.gt/lt/eq...)
+    def gt(self, o): return self._binary(o, jnp.greater)
+    def gte(self, o): return self._binary(o, jnp.greater_equal)
+    def lt(self, o): return self._binary(o, jnp.less)
+    def lte(self, o): return self._binary(o, jnp.less_equal)
+    def eq(self, o): return self._binary(o, jnp.equal)
+    def neq(self, o): return self._binary(o, jnp.not_equal)
+
+    # ------------------------------------------------------------- linalg
+    def mmul(self, other, transpose_a: bool = False, transpose_b: bool = False) -> "NDArray":
+        """Matrix multiply on the MXU (ref: INDArray.mmul → BLAS GEMM;
+        here: one XLA dot_general, bf16-accumulate policy via
+        ``Environment.matmul_precision``)."""
+        a, b = self._value, _unwrap(other)
+        if transpose_a:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_b:
+            b = jnp.swapaxes(b, -1, -2)
+        from deeplearning4j_tpu.utils.environment import Environment
+        prec = Environment.get().matmul_precision
+        precision = {"bfloat16": jax.lax.Precision.DEFAULT,
+                     "tensorfloat32": jax.lax.Precision.HIGH,
+                     "float32": jax.lax.Precision.HIGHEST}.get(prec, jax.lax.Precision.DEFAULT)
+        return NDArray(jnp.matmul(a, b, precision=precision))
+
+    def dot(self, other) -> float:
+        return float(jnp.vdot(self._value, _unwrap(other)))
+
+    def transpose(self, *axes) -> "NDArray":
+        """No-args form reverses ALL dimensions (ref: INDArray.transpose)."""
+        if not axes:
+            return NDArray(jnp.transpose(self._value))
+        return NDArray(jnp.transpose(self._value, axes))
+
+    def permute(self, *axes) -> "NDArray":
+        return NDArray(jnp.transpose(self._value, axes))
+
+    # ------------------------------------------------------------- reductions
+    def _reduce(self, fn, dims, keepdims=False):
+        axis = None
+        if dims:
+            axis = tuple(d if d >= 0 else d + self.rank() for d in dims)
+        res = fn(self._value, axis=axis, keepdims=keepdims)
+        return NDArray(res)
+
+    def sum(self, *dims, keepdims=False):  return self._reduce(jnp.sum, dims, keepdims)
+    def mean(self, *dims, keepdims=False): return self._reduce(jnp.mean, dims, keepdims)
+    def max(self, *dims, keepdims=False):  return self._reduce(jnp.max, dims, keepdims)
+    def min(self, *dims, keepdims=False):  return self._reduce(jnp.min, dims, keepdims)
+    def prod(self, *dims, keepdims=False): return self._reduce(jnp.prod, dims, keepdims)
+    def std(self, *dims, keepdims=False):
+        return self._reduce(lambda v, axis, keepdims: jnp.std(v, axis=axis, ddof=1, keepdims=keepdims), dims, keepdims)
+    def var(self, *dims, keepdims=False):
+        return self._reduce(lambda v, axis, keepdims: jnp.var(v, axis=axis, ddof=1, keepdims=keepdims), dims, keepdims)
+    def _arg_reduce(self, fn, dims):
+        """argMax/argMin over one or MORE dims (ref: INDArray.argMax(int...)):
+        the given dims are flattened into one plane and the flat index within
+        that plane is returned."""
+        if not dims:
+            return NDArray(fn(self._value))
+        dims = tuple(sorted(d if d >= 0 else d + self.rank() for d in dims))
+        if len(dims) == 1:
+            return NDArray(fn(self._value, axis=dims[0]))
+        other = tuple(d for d in range(self.rank()) if d not in dims)
+        moved = jnp.transpose(self._value, other + dims)
+        flat_shape = tuple(self.shape[d] for d in other) + (-1,)
+        return NDArray(fn(jnp.reshape(moved, flat_shape), axis=-1))
+
+    def argMax(self, *dims):
+        return self._arg_reduce(jnp.argmax, dims)
+    def argMin(self, *dims):
+        return self._arg_reduce(jnp.argmin, dims)
+    def norm1(self, *dims): return self._reduce(lambda v, axis, keepdims: jnp.sum(jnp.abs(v), axis=axis, keepdims=keepdims), dims, False)
+    def norm2(self, *dims): return self._reduce(lambda v, axis, keepdims: jnp.sqrt(jnp.sum(v * v, axis=axis, keepdims=keepdims)), dims, False)
+    def normMax(self, *dims): return self._reduce(lambda v, axis, keepdims: jnp.max(jnp.abs(v), axis=axis, keepdims=keepdims), dims, False)
+
+    def sumNumber(self) -> float:  return float(jnp.sum(self._value))
+    def meanNumber(self) -> float: return float(jnp.mean(self._value))
+    def maxNumber(self) -> float:  return float(jnp.max(self._value))
+    def minNumber(self) -> float:  return float(jnp.min(self._value))
+    def norm2Number(self) -> float: return float(jnp.sqrt(jnp.sum(self._value * self._value)))
+    def norm1Number(self) -> float: return float(jnp.sum(jnp.abs(self._value)))
+
+    def cumsum(self, dim: int = 0): return NDArray(jnp.cumsum(self._value, axis=dim))
+
+    # ------------------------------------------------------------- shape ops
+    def reshape(self, *shape) -> "NDArray":
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return NDArray(jnp.reshape(self._value, shape))
+
+    def ravel(self) -> "NDArray":
+        return NDArray(jnp.ravel(self._value))
+
+    def flatten(self) -> "NDArray":
+        return self.ravel()
+
+    def broadcast(self, *shape) -> "NDArray":
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return NDArray(jnp.broadcast_to(self._value, shape))
+
+    def repeat(self, dim: int, n: int) -> "NDArray":
+        return NDArray(jnp.repeat(self._value, n, axis=dim))
+
+    def tile(self, *reps) -> "NDArray":
+        return NDArray(jnp.tile(self._value, reps))
+
+    def squeeze(self, axis=None) -> "NDArray":
+        return NDArray(jnp.squeeze(self._value, axis=axis))
+
+    def expandDims(self, axis: int) -> "NDArray":
+        return NDArray(jnp.expand_dims(self._value, axis))
+
+    def dup(self) -> "NDArray":
+        """Detached copy (ref: INDArray.dup)."""
+        return NDArray(self._value)
+
+    def castTo(self, dtype: DataType) -> "NDArray":
+        return NDArray(self._value.astype(dtype.jnp))
+
+    # ------------------------------------------------------------- indexing
+    def __getitem__(self, idx) -> "NDArray":
+        idx = tuple(_unwrap(i) for i in idx) if isinstance(idx, tuple) else _unwrap(idx)
+        return NDArray(self._value[idx], base=self, index=idx)
+
+    def __setitem__(self, idx, value) -> None:
+        idx = tuple(_unwrap(i) for i in idx) if isinstance(idx, tuple) else _unwrap(idx)
+        self._set_value(self._value.at[idx].set(jnp.asarray(_unwrap(value), self._value.dtype)))
+
+    def getRow(self, i: int) -> "NDArray":
+        return self[i]
+
+    def getColumn(self, i: int) -> "NDArray":
+        return self[:, i]
+
+    def putRow(self, i: int, row) -> "NDArray":
+        self[i] = row
+        return self
+
+    def putColumn(self, i: int, col) -> "NDArray":
+        self[:, i] = col
+        return self
+
+    def getScalar(self, *indices) -> float:
+        return float(self._value[tuple(indices)])
+
+    def getDouble(self, *indices) -> float:
+        return float(self._value[tuple(indices)])
+
+    def getInt(self, *indices) -> int:
+        return int(self._value[tuple(indices)])
+
+    def putScalar(self, *args) -> "NDArray":
+        *indices, value = args
+        if len(indices) == 1 and isinstance(indices[0], (tuple, list)):
+            indices = list(indices[0])
+        self._set_value(self._value.at[tuple(indices)].set(jnp.asarray(value, self._value.dtype)))
+        return self
+
+    def slice_(self, i: int, dim: int = 0) -> "NDArray":
+        idx = (slice(None),) * dim + (i,)
+        return self[idx]
+
+    def tensorAlongDimension(self, index: int, *dims) -> "NDArray":
+        """TAD equivalent — kept only for API familiarity; implemented as a
+        transpose+reshape+index (ref: libnd4j TAD, SURVEY.md §2.1)."""
+        dims = tuple(d if d >= 0 else d + self.rank() for d in dims)
+        other = tuple(d for d in range(self.rank()) if d not in dims)
+        perm = other + dims
+        moved = jnp.transpose(self._value, perm)
+        lead = int(np.prod([self.shape[d] for d in other])) if other else 1
+        moved = jnp.reshape(moved, (lead,) + tuple(self.shape[d] for d in dims))
+        return NDArray(moved[index])
+
+    # ------------------------------------------------------------- misc math
+    def _unary(self, fn, inplace=False):
+        res = fn(self._value)
+        return self._set_value(res) if inplace else NDArray(res)
+
+    def abs(self):   return self._unary(jnp.abs)
+    def exp(self):   return self._unary(jnp.exp)
+    def log(self):   return self._unary(jnp.log)
+    def sqrt(self):  return self._unary(jnp.sqrt)
+    def tanh(self):  return self._unary(jnp.tanh)
+    def sigmoid(self): return self._unary(jax.nn.sigmoid)
+    def relu(self):  return self._unary(jax.nn.relu)
+    def sin(self):   return self._unary(jnp.sin)
+    def cos(self):   return self._unary(jnp.cos)
+    def floor(self): return self._unary(jnp.floor)
+    def ceil(self):  return self._unary(jnp.ceil)
+    def round(self): return self._unary(jnp.round)
+    def sign(self):  return self._unary(jnp.sign)
+    def clip(self, lo, hi): return self._unary(lambda v: jnp.clip(v, lo, hi))
+
+    # ------------------------------------------------------------- protocol
+    def __len__(self) -> int:
+        return self.shape[0] if self.shape else 1
+
+    def __float__(self) -> float:
+        return float(self._value)
+
+    def __int__(self) -> int:
+        return int(self._value)
+
+    def __bool__(self) -> bool:
+        if self.length() != 1:
+            raise ValueError("Truth value of multi-element NDArray is ambiguous")
+        return bool(self._value)
+
+    def __repr__(self) -> str:
+        return f"NDArray(shape={self.shape}, dtype={self.dtype.name})\n{np.asarray(self._value)!r}"
+
+    def __array__(self, dtype=None):
+        arr = np.asarray(self._value)
+        return arr.astype(dtype) if dtype is not None else arr
+
+    def equalsWithEps(self, other, eps: float = 1e-5) -> bool:
+        o = _unwrap(other)
+        if tuple(jnp.shape(o)) != self.shape:
+            return False
+        return bool(jnp.all(jnp.abs(self._value.astype(jnp.float32) - jnp.asarray(o, jnp.float32)) <= eps))
+
+    def equals(self, other) -> bool:
+        return self.equalsWithEps(other, 1e-5)
+
+
+jax.tree_util.register_pytree_node(
+    NDArray,
+    lambda nd: ((nd.jax(),), None),
+    lambda aux, children: NDArray(children[0]),
+)

@@ -1,0 +1,1 @@
+from deeplearning4j_tpu.utils.environment import Environment  # noqa: F401

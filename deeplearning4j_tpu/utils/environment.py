@@ -1,0 +1,100 @@
+"""Central runtime environment / flag registry.
+
+Reference parity: ND4J centralises every ``-D``/env knob in
+``org.nd4j.common.config.{ND4JSystemProperties,ND4JEnvironmentVars}`` and
+bridges JVM state to libnd4j's ``include/system/Environment.h`` via
+``Nd4j.getEnvironment()`` (SURVEY.md §5 "Config / flag system").
+
+Here the registry is a single process-wide :class:`Environment` singleton.
+Every knob has (a) a typed attribute, (b) an environment-variable override
+(``DL4J_TPU_*``), and (c) a docstring row in :data:`KNOBS` so the full
+registry is introspectable (``Environment.describe()``).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field, fields
+from typing import Any
+
+
+def _env(name: str, default: Any, typ: type) -> Any:
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    if typ is bool:
+        return raw.strip().lower() in ("1", "true", "yes", "on")
+    return typ(raw)
+
+
+@dataclass
+class Environment:
+    """Process-wide runtime flags (singleton via :meth:`get`)."""
+
+    # -- debug / verbosity (ref: libnd4j Environment::setDebug/setVerbose) --
+    debug: bool = field(default_factory=lambda: _env("DL4J_TPU_DEBUG", False, bool))
+    verbose: bool = field(default_factory=lambda: _env("DL4J_TPU_VERBOSE", False, bool))
+
+    # -- numerics (ref: OpExecutioner ProfilingMode NAN_PANIC/INF_PANIC) --
+    nan_panic: bool = field(default_factory=lambda: _env("DL4J_TPU_NAN_PANIC", False, bool))
+    inf_panic: bool = field(default_factory=lambda: _env("DL4J_TPU_INF_PANIC", False, bool))
+
+    # -- precision policy: compute dtype for matmul/conv on the MXU --
+    # bf16 matmuls with f32 accumulation are the TPU-native default; set to
+    # "float32" ("highest") to force full-precision MXU passes.
+    matmul_precision: str = field(
+        default_factory=lambda: _env("DL4J_TPU_MATMUL_PRECISION", "bfloat16", str)
+    )
+
+    # -- profiling (ref: OpProfiler / ProfilingListener) --
+    profiling: bool = field(default_factory=lambda: _env("DL4J_TPU_PROFILING", False, bool))
+    profile_dir: str = field(default_factory=lambda: _env("DL4J_TPU_PROFILE_DIR", "/tmp/dl4j_tpu_profile", str))
+
+    # -- compile cache --
+    compile_cache_dir: str = field(
+        default_factory=lambda: _env("DL4J_TPU_COMPILE_CACHE", "", str)
+    )
+
+    # -- data pipeline --
+    prefetch_buffer: int = field(default_factory=lambda: _env("DL4J_TPU_PREFETCH", 2, int))
+    loader_threads: int = field(default_factory=lambda: _env("DL4J_TPU_LOADER_THREADS", 4, int))
+
+    _instance = None
+    _lock = threading.Lock()
+
+    @classmethod
+    def get(cls) -> "Environment":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    @classmethod
+    def reset(cls) -> None:
+        with cls._lock:
+            cls._instance = None
+
+    def describe(self) -> str:
+        """Human-readable registry of every knob and its current value."""
+        rows = []
+        for f in fields(self):
+            if f.name.startswith("_"):
+                continue
+            env_var = "DL4J_TPU_" + f.name.upper()
+            rows.append(f"{f.name:<22} {env_var:<28} = {getattr(self, f.name)!r}")
+        return "\n".join(rows)
+
+
+KNOBS = {
+    "debug": "Verbose per-op debug logging (ref: libnd4j Environment::setDebug)",
+    "verbose": "Extra execution logging (ref: Environment::setVerbose)",
+    "nan_panic": "Raise if any op output contains NaN (ref: ProfilingMode.NAN_PANIC)",
+    "inf_panic": "Raise if any op output contains Inf (ref: ProfilingMode.INF_PANIC)",
+    "matmul_precision": "MXU compute precision: bfloat16|tensorfloat32|float32",
+    "profiling": "Enable per-op profiling (ref: OpProfiler)",
+    "profile_dir": "Directory for Chrome-trace profiles (ref: ProfilingListener)",
+    "compile_cache_dir": "Persistent XLA compile cache directory",
+    "prefetch_buffer": "Async iterator prefetch depth (ref: AsyncDataSetIterator)",
+    "loader_threads": "Host data-loading threads (ref: libnd4j Threads, data only)",
+}

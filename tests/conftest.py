@@ -1,0 +1,33 @@
+"""Test configuration: force CPU backend with 8 virtual devices.
+
+Reference parity: the reference runs one test suite against N backends
+(platform-tests with nd4j-native vs nd4j-cuda — SURVEY.md §4). Here the
+suite runs on the CPU backend with a virtual 8-device mesh so every
+sharding/parallelism test exercises real SPMD partitioning without TPU
+hardware; the same code paths run unchanged on a real TPU slice.
+"""
+
+import os
+
+# Must be set before jax is imported anywhere.
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("DL4J_TPU_MATMUL_PRECISION", "float32")
+
+import jax  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    return jax.devices()
+
+
+@pytest.fixture(autouse=True)
+def _fixed_seed():
+    from deeplearning4j_tpu.linalg import factory
+    factory.setSeed(12345)
+    yield

@@ -1,0 +1,195 @@
+"""NDArray / factory tests (ref: nd4j NDArrayTests / NDArrayTestsFortran style)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.linalg import DataType, NDArray, nd
+
+
+class TestFactory:
+    def test_zeros_ones(self):
+        z = nd.zeros(2, 3)
+        assert z.shape == (2, 3)
+        assert z.sumNumber() == 0.0
+        o = nd.ones(4)
+        assert o.sumNumber() == 4.0
+
+    def test_create_reshape(self):
+        a = nd.create([1, 2, 3, 4, 5, 6], shape=(2, 3))
+        assert a.shape == (2, 3)
+        assert a.getDouble(1, 2) == 6.0
+
+    def test_arange_linspace(self):
+        assert nd.arange(5).numpy().tolist() == [0, 1, 2, 3, 4]
+        ls = nd.linspace(0, 1, 5)
+        np.testing.assert_allclose(ls.numpy(), [0, 0.25, 0.5, 0.75, 1.0], atol=1e-6)
+
+    def test_rng_determinism(self):
+        nd.setSeed(42)
+        a = nd.randn(3, 3)
+        nd.setSeed(42)
+        b = nd.randn(3, 3)
+        assert a.equals(b)
+        c = nd.randn(3, 3)
+        assert not b.equals(c)
+
+    def test_rng_state_save_restore(self):
+        rng = nd.Random(7)
+        _ = nd.rand(2, 2, rng=rng)
+        state = rng.getState()
+        a = nd.rand(2, 2, rng=rng)
+        rng.setState(state)
+        b = nd.rand(2, 2, rng=rng)
+        assert a.equals(b)
+
+    def test_one_hot(self):
+        oh = nd.oneHot([0, 2], 3)
+        np.testing.assert_allclose(oh.numpy(), [[1, 0, 0], [0, 0, 1]])
+
+
+class TestNDArrayOps:
+    def test_add_broadcast(self):
+        a = nd.ones(2, 3)
+        b = nd.create([1, 2, 3])
+        c = a.add(b)
+        np.testing.assert_allclose(c.numpy(), [[2, 3, 4], [2, 3, 4]])
+
+    def test_inplace_mutation_visible(self):
+        a = nd.ones(2, 2)
+        alias = a
+        a.addi(1.0)
+        assert alias.sumNumber() == 8.0
+
+    def test_mmul(self):
+        a = nd.create([[1, 2], [3, 4]])
+        b = nd.create([[5, 6], [7, 8]])
+        c = a.mmul(b)
+        np.testing.assert_allclose(c.numpy(), [[19, 22], [43, 50]])
+
+    def test_mmul_transpose_flags(self):
+        a = nd.randn(3, 4)
+        b = nd.randn(3, 5)
+        c = a.mmul(b, transpose_a=True)
+        np.testing.assert_allclose(c.numpy(), a.numpy().T @ b.numpy(), atol=1e-5)
+
+    def test_reductions(self):
+        a = nd.create([[1.0, 2.0], [3.0, 4.0]])
+        assert a.sumNumber() == 10.0
+        np.testing.assert_allclose(a.sum(0).numpy(), [4, 6])
+        np.testing.assert_allclose(a.mean(1).numpy(), [1.5, 3.5])
+        assert a.maxNumber() == 4.0
+        assert int(a.argMax(1).numpy()[0]) == 1
+
+    def test_std_is_sample_std(self):
+        a = nd.create([1.0, 2.0, 3.0, 4.0])
+        assert abs(float(a.std().numpy()) - np.std([1, 2, 3, 4], ddof=1)) < 1e-6
+
+    def test_norms(self):
+        a = nd.create([3.0, -4.0])
+        assert a.norm2Number() == pytest.approx(5.0)
+        assert a.norm1Number() == pytest.approx(7.0)
+
+    def test_view_writeback(self):
+        a = nd.zeros(3, 3)
+        row = a.getRow(1)
+        row.assign(nd.ones(3))
+        np.testing.assert_allclose(a.numpy()[1], [1, 1, 1])
+        assert a.sumNumber() == 3.0
+
+    def test_putscalar_getrow(self):
+        a = nd.zeros(2, 2)
+        a.putScalar(0, 1, 5.0)
+        assert a.getDouble(0, 1) == 5.0
+
+    def test_setitem(self):
+        a = nd.zeros(4)
+        a[1:3] = 7.0
+        np.testing.assert_allclose(a.numpy(), [0, 7, 7, 0])
+
+    def test_dup_detaches(self):
+        a = nd.ones(2)
+        b = a.dup()
+        b.addi(1.0)
+        assert a.sumNumber() == 2.0
+        assert b.sumNumber() == 4.0
+
+    def test_cast(self):
+        a = nd.create([1.7, 2.3])
+        b = a.castTo(DataType.INT32)
+        assert b.dtype == DataType.INT32
+        assert b.numpy().tolist() == [1, 2]
+
+    def test_transpose_permute(self):
+        a = nd.randn(2, 3, 4)
+        assert a.permute(2, 0, 1).shape == (4, 2, 3)
+        # no-args transpose reverses ALL dims (ref: INDArray.transpose)
+        assert a.transpose().shape == (4, 3, 2)
+
+    def test_view_reads_through_base_mutation(self):
+        a = nd.zeros(3, 3)
+        row = a.getRow(1)
+        a.addi(1.0)
+        np.testing.assert_allclose(row.numpy(), [1, 1, 1])
+        row.addi(1.0)  # must compute from fresh base data
+        np.testing.assert_allclose(a.numpy()[1], [2, 2, 2])
+        np.testing.assert_allclose(a.numpy()[0], [1, 1, 1])
+
+    def test_sibling_views_no_clobber(self):
+        a = nd.zeros(2, 2)
+        r0, r1 = a.getRow(0), a.getRow(1)
+        r0.assign(nd.ones(2))
+        r1.assign(nd.create([2.0, 2.0]))
+        np.testing.assert_allclose(a.numpy(), [[1, 1], [2, 2]])
+        np.testing.assert_allclose(r0.numpy(), [1, 1])
+
+    def test_argmax_multi_dims(self):
+        a = nd.arange(24).reshape(2, 3, 4)
+        am = a.argMax(1, 2)
+        assert am.shape == (2,)
+        assert am.numpy().tolist() == [11, 11]
+
+    def test_shuffle_inplace(self):
+        a = nd.arange(16).reshape(8, 2)
+        before = a.numpy().copy()
+        ret = nd.shuffle(a)
+        assert ret is a
+        assert sorted(a.numpy()[:, 0].tolist()) == sorted(before[:, 0].tolist())
+
+    def test_inplace_shape_mismatch_raises(self):
+        a = nd.ones(1, 3)
+        with pytest.raises(ValueError, match="cannot change shape"):
+            a.addi(nd.ones(2, 3))
+
+    def test_concat_stack(self):
+        a, b = nd.ones(2, 2), nd.zeros(2, 2)
+        assert nd.concat(0, a, b).shape == (4, 2)
+        assert nd.concat(1, a, b).shape == (2, 4)
+        assert nd.stack(0, a, b).shape == (2, 2, 2)
+
+    def test_comparisons(self):
+        a = nd.create([1.0, 5.0, 3.0])
+        mask = a.gt(2.0)
+        assert mask.dtype == DataType.BOOL
+        assert mask.numpy().tolist() == [False, True, True]
+
+    def test_tensor_along_dimension(self):
+        a = nd.arange(24).reshape(2, 3, 4)
+        t = a.tensorAlongDimension(0, 1, 2)
+        assert t.shape == (3, 4)
+        np.testing.assert_allclose(t.numpy(), a.numpy()[0])
+
+    def test_operator_overloads(self):
+        a = nd.create([2.0, 4.0])
+        np.testing.assert_allclose((a + 1).numpy(), [3, 5])
+        np.testing.assert_allclose((1 - a).numpy(), [-1, -3])
+        np.testing.assert_allclose((a / 2).numpy(), [1, 2])
+        np.testing.assert_allclose((a @ nd.create([[1.0], [1.0]])).numpy(), [6])
+
+
+class TestEnvironment:
+    def test_registry_describe(self):
+        from deeplearning4j_tpu.utils.environment import Environment, KNOBS
+        env = Environment.get()
+        desc = env.describe()
+        for knob in KNOBS:
+            assert knob in desc
