@@ -1,0 +1,17 @@
+"""Op layer — the declarable-op surface as StableHLO subgraph builders.
+
+Reference parity: libnd4j ``include/ops/`` (SURVEY.md §2.1). See
+``registry.py`` for the name→builder registry and the PlatformHelper-style
+Pallas override seam.
+"""
+
+from deeplearning4j_tpu.ops import (  # noqa: F401
+    activations,
+    attention,
+    convolution,
+    losses,
+    normalization,
+    recurrent,
+    registry,
+)
+from deeplearning4j_tpu.ops.registry import exec_op, get, all_ops, has  # noqa: F401

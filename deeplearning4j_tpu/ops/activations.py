@@ -1,0 +1,171 @@
+"""Activation functions — the full DL4J activation surface.
+
+Reference parity: ``org.nd4j.linalg.activations.Activation`` enum +
+``impl.Activation*`` classes (SURVEY.md §2.2 "DL4J layers" use these), and
+the libnd4j transform ops behind them (``libnd4j/include/ops/ops.h``).
+
+TPU-native: every activation is a pure jnp function; XLA fuses them into
+the surrounding matmul/conv — there is no per-activation kernel to write
+(SURVEY.md §2.1 "Legacy op loops → one generic emitter per family").
+No hand-written derivatives anywhere: autodiff is program-level.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["get", "Activation", "ACTIVATIONS"]
+
+
+def identity(x):
+    return x
+
+
+def relu(x):
+    return jax.nn.relu(x)
+
+
+def relu6(x):
+    return jnp.minimum(jax.nn.relu(x), 6.0)
+
+
+def leakyrelu(x, alpha: float = 0.01):
+    return jnp.where(x >= 0, x, alpha * x)
+
+
+def elu(x, alpha: float = 1.0):
+    return jax.nn.elu(x, alpha)
+
+
+def selu(x):
+    return jax.nn.selu(x)
+
+
+def gelu(x):
+    # ref: ActivationGELU uses the tanh approximation
+    return jax.nn.gelu(x, approximate=True)
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def hardsigmoid(x):
+    return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+def hardtanh(x):
+    return jnp.clip(x, -1.0, 1.0)
+
+
+def tanh(x):
+    return jnp.tanh(x)
+
+
+def rationaltanh(x):
+    # ref: ActivationRationalTanh — 1.7159 * tanh(2x/3) rational approximation
+    return 1.7159 * jnp.tanh(2.0 * x / 3.0)
+
+
+def rectifiedtanh(x):
+    return jnp.maximum(0.0, jnp.tanh(x))
+
+
+def softmax(x, axis: int = -1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+def logsoftmax(x, axis: int = -1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+def softplus(x):
+    return jax.nn.softplus(x)
+
+
+def softsign(x):
+    return jax.nn.soft_sign(x)
+
+
+def swish(x):
+    return x * jax.nn.sigmoid(x)
+
+
+def mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+def cube(x):
+    return x * x * x
+
+
+def thresholdedrelu(x, theta: float = 1.0):
+    return jnp.where(x > theta, x, 0.0)
+
+
+def prelu(x, alpha):
+    """Parametric ReLU — alpha is a learned array broadcast against x."""
+    return jnp.where(x >= 0, x, alpha * x)
+
+
+ACTIVATIONS = {
+    "identity": identity,
+    "linear": identity,
+    "relu": relu,
+    "relu6": relu6,
+    "leakyrelu": leakyrelu,
+    "elu": elu,
+    "selu": selu,
+    "gelu": gelu,
+    "sigmoid": sigmoid,
+    "hardsigmoid": hardsigmoid,
+    "hardtanh": hardtanh,
+    "tanh": tanh,
+    "rationaltanh": rationaltanh,
+    "rectifiedtanh": rectifiedtanh,
+    "softmax": softmax,
+    "logsoftmax": logsoftmax,
+    "softplus": softplus,
+    "softsign": softsign,
+    "swish": swish,
+    "mish": mish,
+    "cube": cube,
+    "thresholdedrelu": thresholdedrelu,
+}
+
+
+def get(name):
+    """Resolve an activation by name (case-insensitive) or pass a callable through."""
+    if callable(name):
+        return name
+    key = str(name).lower()
+    if key not in ACTIVATIONS:
+        raise ValueError(f"Unknown activation '{name}'. Known: {sorted(ACTIVATIONS)}")
+    return ACTIVATIONS[key]
+
+
+class Activation:
+    """Enum-style accessors mirroring ``org.nd4j.linalg.activations.Activation``."""
+
+    IDENTITY = "identity"
+    RELU = "relu"
+    RELU6 = "relu6"
+    LEAKYRELU = "leakyrelu"
+    ELU = "elu"
+    SELU = "selu"
+    GELU = "gelu"
+    SIGMOID = "sigmoid"
+    HARDSIGMOID = "hardsigmoid"
+    HARDTANH = "hardtanh"
+    TANH = "tanh"
+    RATIONALTANH = "rationaltanh"
+    RECTIFIEDTANH = "rectifiedtanh"
+    SOFTMAX = "softmax"
+    LOGSOFTMAX = "logsoftmax"
+    SOFTPLUS = "softplus"
+    SOFTSIGN = "softsign"
+    SWISH = "swish"
+    MISH = "mish"
+    CUBE = "cube"
+    THRESHOLDEDRELU = "thresholdedrelu"

@@ -1,0 +1,399 @@
+"""Convolution / pooling ops.
+
+Reference parity: libnd4j declarable ops ``conv1d/conv2d/conv3dnew/
+deconv2d/depthwise_conv2d/sconv2d/maxpool2d/avgpool2d/pnormpool2d/
+upsampling2d/...`` under ``libnd4j/include/ops/declarable/generic/convo``
+and their helpers (im2col+GEMM / oneDNN / cuDNN) — SURVEY.md §2.1.
+
+TPU-native: every conv lowers to ONE ``lax.conv_general_dilated`` (XLA maps
+it onto the MXU; no im2col, no vendor-library dispatch — "XLA *is* the
+vendor path on TPU"). Layout is carried as a dimension-numbers string so
+NCHW (the reference's default) and NHWC (TPU-preferred) are both first-class.
+
+Padding semantics follow DL4J's ``ConvolutionMode``:
+- ``truncate`` (reference default): explicit pad, output floor-divided.
+- ``same``: XLA SAME padding (stride-aware).
+- ``causal`` (conv1d): left-pad (kernel-1)*dilation.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+IntOrPair = Union[int, Sequence[int]]
+
+
+def _pair(v: IntOrPair, n: int = 2) -> Tuple[int, ...]:
+    if isinstance(v, (tuple, list)):
+        assert len(v) == n, f"expected {n} values, got {v}"
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+def _conv_dims(ndim: int, data_format: str):
+    """Build (lhs, rhs, out) dimension-number strings for lax conv."""
+    spatial = "DHW"[-ndim:]
+    if data_format.upper() in ("NCHW", "NCW", "NCDHW", "CHANNELS_FIRST"):
+        lhs = "NC" + spatial
+    else:
+        lhs = "N" + spatial + "C"
+    rhs = "OI" + spatial
+    return (lhs, rhs, lhs)
+
+
+def _acc_type(x):
+    """bf16 inputs accumulate in fp32 on the MXU (consistent across ranks)."""
+    return jnp.float32 if x.dtype == jnp.bfloat16 else None
+
+
+def _padding(mode: str, kernel, stride, dilation, pad):
+    mode = mode.lower()
+    if mode == "same":
+        return "SAME"
+    if mode == "causal":
+        # conv1d only: left-pad so output depends only on past timesteps
+        return [((k - 1) * d, 0) for k, d in zip(kernel, dilation)]
+    # truncate / strict: explicit symmetric padding
+    return [(p, p) for p in pad]
+
+
+def conv2d(x, w, b=None, *, kernel=None, stride: IntOrPair = 1, pad: IntOrPair = 0,
+           dilation: IntOrPair = 1, mode: str = "truncate", data_format: str = "NCHW",
+           groups: int = 1):
+    """2D convolution (ref: libnd4j ``conv2d`` declarable op).
+
+    ``w`` layout: ``[outC, inC/groups, kH, kW]`` (OIHW), matching the
+    reference's weight layout for conv layers.
+    """
+    stride, pad, dilation = _pair(stride), _pair(pad), _pair(dilation)
+    kernel = _pair(kernel) if kernel is not None else tuple(w.shape[2:])
+    dims = _conv_dims(2, data_format)
+    out = lax.conv_general_dilated(
+        x, w,
+        window_strides=stride,
+        padding=_padding(mode, kernel, stride, dilation, pad),
+        rhs_dilation=dilation,
+        dimension_numbers=dims,
+        feature_group_count=groups,
+        preferred_element_type=_acc_type(x),
+    )
+    if out.dtype != x.dtype:
+        out = out.astype(x.dtype)
+    if b is not None:
+        out = out + _bias_reshape(b, 2, data_format)
+    return out
+
+
+def conv1d(x, w, b=None, *, stride: int = 1, pad: int = 0, dilation: int = 1,
+           mode: str = "truncate", data_format: str = "NCW", groups: int = 1):
+    """1D convolution (ref: ``conv1d``); supports causal mode."""
+    stride_, pad_, dil_ = (int(stride),), (int(pad),), (int(dilation),)
+    kernel = (int(w.shape[2]),)
+    dims = _conv_dims(1, data_format)
+    out = lax.conv_general_dilated(
+        x, w, stride_, _padding(mode, kernel, stride_, dil_, pad_),
+        rhs_dilation=dil_, dimension_numbers=dims, feature_group_count=groups,
+        preferred_element_type=_acc_type(x))
+    if out.dtype != x.dtype:
+        out = out.astype(x.dtype)
+    if b is not None:
+        out = out + _bias_reshape(b, 1, data_format)
+    return out
+
+
+def conv3d(x, w, b=None, *, stride: IntOrPair = 1, pad: IntOrPair = 0,
+           dilation: IntOrPair = 1, mode: str = "truncate", data_format: str = "NCDHW"):
+    """3D convolution (ref: ``conv3dnew``)."""
+    stride, pad, dilation = _pair(stride, 3), _pair(pad, 3), _pair(dilation, 3)
+    kernel = tuple(w.shape[2:])
+    dims = _conv_dims(3, data_format)
+    out = lax.conv_general_dilated(
+        x, w, stride, _padding(mode, kernel, stride, dilation, pad),
+        rhs_dilation=dilation, dimension_numbers=dims,
+        preferred_element_type=_acc_type(x))
+    if out.dtype != x.dtype:
+        out = out.astype(x.dtype)
+    if b is not None:
+        out = out + _bias_reshape(b, 3, data_format)
+    return out
+
+
+def deconv2d(x, w, b=None, *, stride: IntOrPair = 1, pad: IntOrPair = 0,
+             mode: str = "truncate", data_format: str = "NCHW"):
+    """Transposed convolution (ref: ``deconv2d``).
+
+    ``w`` layout ``[outC, inC, kH, kW]`` like conv2d; implemented as the
+    gradient of conv2d via lhs dilation.
+    """
+    stride, pad = _pair(stride), _pair(pad)
+    kh, kw = int(w.shape[2]), int(w.shape[3])
+    dims = _conv_dims(2, data_format)
+    # transpose conv = conv with lhs_dilation=stride and a spatially-flipped
+    # kernel; w is already [outC, inC, kH, kW] = OIHW for that conv
+    w_t = jnp.flip(w, axis=(2, 3))
+    if mode.lower() == "same":
+        # SAME deconv: output = input*stride. Invert the forward SAME conv's
+        # padding p_f = max(k - s, 0): transpose pad = k - 1 - p_f_split.
+        def same_pad(k, s):
+            p_f = max(k - s, 0)
+            return (k - 1 - p_f // 2, k - 1 - (p_f - p_f // 2))
+        padding = [same_pad(kh, stride[0]), same_pad(kw, stride[1])]
+    else:
+        padding = [(kh - 1 - pad[0], kh - 1 - pad[0]), (kw - 1 - pad[1], kw - 1 - pad[1])]
+    out = lax.conv_general_dilated(
+        x, w_t, window_strides=(1, 1), padding=padding,
+        lhs_dilation=stride, dimension_numbers=dims,
+        preferred_element_type=_acc_type(x))
+    if out.dtype != x.dtype:
+        out = out.astype(x.dtype)
+    if b is not None:
+        out = out + _bias_reshape(b, 2, data_format)
+    return out
+
+
+def depthwise_conv2d(x, w, b=None, *, stride: IntOrPair = 1, pad: IntOrPair = 0,
+                     dilation: IntOrPair = 1, mode: str = "truncate",
+                     data_format: str = "NCHW"):
+    """Depthwise conv (ref: ``depthwise_conv2d``). ``w``: [depthMult, inC, kH, kW]."""
+    in_c = x.shape[1] if data_format.upper().startswith("NC") else x.shape[-1]
+    mult = w.shape[0]
+    # lax wants OIHW with feature_group_count=in_c and O = in_c*mult, I=1
+    w_g = jnp.reshape(jnp.transpose(w, (1, 0, 2, 3)), (in_c * mult, 1) + tuple(w.shape[2:]))
+    return conv2d(x, w_g, b, stride=stride, pad=pad, dilation=dilation, mode=mode,
+                  data_format=data_format, groups=in_c)
+
+
+def separable_conv2d(x, w_depth, w_point, b=None, *, stride: IntOrPair = 1,
+                     pad: IntOrPair = 0, dilation: IntOrPair = 1,
+                     mode: str = "truncate", data_format: str = "NCHW"):
+    """Separable conv (ref: ``sconv2d``): depthwise then 1x1 pointwise."""
+    y = depthwise_conv2d(x, w_depth, None, stride=stride, pad=pad, dilation=dilation,
+                         mode=mode, data_format=data_format)
+    return conv2d(y, w_point, b, stride=1, pad=0, mode="truncate", data_format=data_format)
+
+
+def _bias_reshape(b, ndim_spatial: int, data_format: str):
+    if data_format.upper().startswith("NC"):
+        return jnp.reshape(b, (1, -1) + (1,) * ndim_spatial)
+    return jnp.reshape(b, (1,) + (1,) * ndim_spatial + (-1,))
+
+
+# ------------------------------------------------------------------ pooling
+def _pool(x, kind: str, kernel, stride, pad, mode, data_format, ndim, pnorm=2):
+    kernel = _pair(kernel, ndim)
+    stride = _pair(stride, ndim)
+    pad = _pair(pad, ndim)
+    cf = data_format.upper().startswith("NC")
+    if cf:
+        window = (1, 1) + kernel
+        strides = (1, 1) + stride
+        padding = ("SAME" if mode.lower() == "same"
+                   else [(0, 0), (0, 0)] + [(p, p) for p in pad])
+    else:
+        window = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+        padding = ("SAME" if mode.lower() == "same"
+                   else [(0, 0)] + [(p, p) for p in pad] + [(0, 0)])
+
+    if kind == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        return lax.reduce_window(x, jnp.asarray(init, x.dtype), lax.max, window, strides, padding)
+    if kind == "avg":
+        summed = lax.reduce_window(x, jnp.asarray(0, x.dtype), lax.add, window, strides, padding)
+        if mode.lower() == "same" or any(pad):
+            # divide by the actual window size (exclude padding) — matches the
+            # reference's avgpool with padding excluded from the count
+            ones = jnp.ones(x.shape, x.dtype)
+            counts = lax.reduce_window(ones, jnp.asarray(0, x.dtype), lax.add, window, strides, padding)
+            return summed / counts
+        return summed / float(jnp.prod(jnp.asarray(kernel)))
+    if kind == "pnorm":
+        p = float(pnorm)
+        summed = lax.reduce_window(jnp.abs(x) ** p, jnp.asarray(0, x.dtype), lax.add,
+                                   window, strides, padding)
+        return summed ** (1.0 / p)
+    raise ValueError(kind)
+
+
+def maxpool2d(x, *, kernel: IntOrPair, stride: IntOrPair = None, pad: IntOrPair = 0,
+              mode: str = "truncate", data_format: str = "NCHW"):
+    """Max pooling (ref: ``maxpool2d``)."""
+    stride = stride if stride is not None else kernel
+    return _pool(x, "max", kernel, stride, pad, mode, data_format, 2)
+
+
+def avgpool2d(x, *, kernel: IntOrPair, stride: IntOrPair = None, pad: IntOrPair = 0,
+              mode: str = "truncate", data_format: str = "NCHW"):
+    """Average pooling (ref: ``avgpool2d``)."""
+    stride = stride if stride is not None else kernel
+    return _pool(x, "avg", kernel, stride, pad, mode, data_format, 2)
+
+
+def pnormpool2d(x, *, kernel: IntOrPair, stride: IntOrPair = None, pad: IntOrPair = 0,
+                pnorm: int = 2, mode: str = "truncate", data_format: str = "NCHW"):
+    """P-norm pooling (ref: ``pnormpool2d``)."""
+    stride = stride if stride is not None else kernel
+    return _pool(x, "pnorm", kernel, stride, pad, mode, data_format, 2, pnorm)
+
+
+def maxpool1d(x, *, kernel: int, stride: int = None, pad: int = 0,
+              mode: str = "truncate", data_format: str = "NCW"):
+    stride = stride if stride is not None else kernel
+    return _pool(x, "max", kernel, stride, pad, mode, data_format, 1)
+
+
+def avgpool1d(x, *, kernel: int, stride: int = None, pad: int = 0,
+              mode: str = "truncate", data_format: str = "NCW"):
+    stride = stride if stride is not None else kernel
+    return _pool(x, "avg", kernel, stride, pad, mode, data_format, 1)
+
+
+def maxpool3d(x, *, kernel: IntOrPair, stride: IntOrPair = None, pad: IntOrPair = 0,
+              mode: str = "truncate", data_format: str = "NCDHW"):
+    stride = stride if stride is not None else kernel
+    return _pool(x, "max", kernel, stride, pad, mode, data_format, 3)
+
+
+def avgpool3d(x, *, kernel: IntOrPair, stride: IntOrPair = None, pad: IntOrPair = 0,
+              mode: str = "truncate", data_format: str = "NCDHW"):
+    stride = stride if stride is not None else kernel
+    return _pool(x, "avg", kernel, stride, pad, mode, data_format, 3)
+
+
+def global_pool(x, pooling_type: str = "avg", data_format: str = "NCHW",
+                keepdims: bool = False, pnorm: int = 2, mask=None):
+    """Global pooling over all spatial/time dims (ref: ``GlobalPoolingLayer``).
+
+    Supports masked mean/max for variable-length sequences ([N,C,T] + mask
+    [N,T]) — masking is first-class in the reference (SURVEY.md §5).
+    """
+    cf = data_format.upper().startswith("NC")
+    axes = tuple(range(2, x.ndim)) if cf else tuple(range(1, x.ndim - 1))
+    if mask is not None:
+        m = mask
+        while m.ndim < x.ndim:
+            m = jnp.expand_dims(m, 1 if cf else -1)
+        if pooling_type == "avg":
+            s = jnp.sum(x * m, axis=axes, keepdims=keepdims)
+            n = jnp.sum(m, axis=axes, keepdims=keepdims)
+            return s / jnp.maximum(n, 1e-8)
+        if pooling_type == "max":
+            neg = jnp.asarray(-jnp.inf, x.dtype)
+            return jnp.max(jnp.where(m > 0, x, neg), axis=axes, keepdims=keepdims)
+        if pooling_type == "sum":
+            return jnp.sum(x * m, axis=axes, keepdims=keepdims)
+    if pooling_type == "avg":
+        return jnp.mean(x, axis=axes, keepdims=keepdims)
+    if pooling_type == "max":
+        return jnp.max(x, axis=axes, keepdims=keepdims)
+    if pooling_type == "sum":
+        return jnp.sum(x, axis=axes, keepdims=keepdims)
+    if pooling_type == "pnorm":
+        return jnp.sum(jnp.abs(x) ** pnorm, axis=axes, keepdims=keepdims) ** (1.0 / pnorm)
+    raise ValueError(pooling_type)
+
+
+# -------------------------------------------------------------- resampling
+def upsampling2d(x, scale: IntOrPair = 2, data_format: str = "NCHW"):
+    """Nearest-neighbour upsampling (ref: ``upsampling2d``)."""
+    sh, sw = _pair(scale)
+    if data_format.upper().startswith("NC"):
+        x = jnp.repeat(x, sh, axis=2)
+        return jnp.repeat(x, sw, axis=3)
+    x = jnp.repeat(x, sh, axis=1)
+    return jnp.repeat(x, sw, axis=2)
+
+
+def space_to_depth(x, block_size: int, data_format: str = "NCHW"):
+    """(ref: ``space_to_depth``)"""
+    b = block_size
+    if data_format.upper().startswith("NC"):
+        n, c, h, w = x.shape
+        x = jnp.reshape(x, (n, c, h // b, b, w // b, b))
+        x = jnp.transpose(x, (0, 3, 5, 1, 2, 4))
+        return jnp.reshape(x, (n, c * b * b, h // b, w // b))
+    n, h, w, c = x.shape
+    x = jnp.reshape(x, (n, h // b, b, w // b, b, c))
+    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+    return jnp.reshape(x, (n, h // b, w // b, c * b * b))
+
+
+def depth_to_space(x, block_size: int, data_format: str = "NCHW"):
+    """(ref: ``depth_to_space``)"""
+    b = block_size
+    if data_format.upper().startswith("NC"):
+        n, c, h, w = x.shape
+        x = jnp.reshape(x, (n, b, b, c // (b * b), h, w))
+        x = jnp.transpose(x, (0, 3, 4, 1, 5, 2))
+        return jnp.reshape(x, (n, c // (b * b), h * b, w * b))
+    n, h, w, c = x.shape
+    x = jnp.reshape(x, (n, h, w, b, b, c // (b * b)))
+    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+    return jnp.reshape(x, (n, h * b, w * b, c // (b * b)))
+
+
+def zero_padding2d(x, pad, data_format: str = "NCHW"):
+    """(ref: ``ZeroPaddingLayer``) pad = ((top,bottom),(left,right)) or int."""
+    if isinstance(pad, int):
+        pad = ((pad, pad), (pad, pad))
+    elif isinstance(pad[0], int):
+        pad = ((pad[0], pad[0]), (pad[1], pad[1]))
+    if data_format.upper().startswith("NC"):
+        cfg = [(0, 0), (0, 0), tuple(pad[0]), tuple(pad[1])]
+    else:
+        cfg = [(0, 0), tuple(pad[0]), tuple(pad[1]), (0, 0)]
+    return jnp.pad(x, cfg)
+
+
+def cropping2d(x, crop, data_format: str = "NCHW"):
+    """(ref: ``Cropping2D``)"""
+    if isinstance(crop, int):
+        crop = ((crop, crop), (crop, crop))
+    elif isinstance(crop[0], int):
+        crop = ((crop[0], crop[0]), (crop[1], crop[1]))
+    (t, bm), (l, r) = crop
+    if data_format.upper().startswith("NC"):
+        h, w = x.shape[2], x.shape[3]
+        return x[:, :, t:h - bm, l:w - r]
+    h, w = x.shape[1], x.shape[2]
+    return x[:, t:h - bm, l:w - r, :]
+
+
+def conv_output_size(size: int, kernel: int, stride: int, pad: int,
+                     dilation: int = 1, mode: str = "truncate") -> int:
+    """Shape inference for conv/pool (ref: ``InputType`` propagation /
+    ``ConvolutionUtils.getOutputSize``)."""
+    if mode.lower() == "same":
+        return -(-size // stride)  # ceil
+    eff_k = kernel + (kernel - 1) * (dilation - 1)
+    if mode.lower() == "causal":
+        return size  # causal left-pad keeps length (stride 1)
+    return (size + 2 * pad - eff_k) // stride + 1
+
+
+# ------------------------------------------------------- parity helpers
+def im2col(x, kernel: IntOrPair, stride: IntOrPair = 1, pad: IntOrPair = 0,
+           dilation: IntOrPair = 1):
+    """im2col kept for API parity only (ref: libnd4j helpers::im2col); the
+    conv path never uses it on TPU. x: [N,C,H,W] -> [N, C, kH, kW, oH, oW]."""
+    kh, kw = _pair(kernel)
+    sh, sw = _pair(stride)
+    ph, pw = _pair(pad)
+    dh, dw = _pair(dilation)
+    n, c, h, w = x.shape
+    xp = jnp.pad(x, [(0, 0), (0, 0), (ph, ph), (pw, pw)])
+    oh = (h + 2 * ph - (kh + (kh - 1) * (dh - 1))) // sh + 1
+    ow = (w + 2 * pw - (kw + (kw - 1) * (dw - 1))) // sw + 1
+    patches = []
+    for i in range(kh):
+        for j in range(kw):
+            patch = lax.dynamic_slice(
+                xp, (0, 0, i * dh, j * dw), (n, c, (oh - 1) * sh + 1, (ow - 1) * sw + 1))
+            patches.append(patch[:, :, ::sh, ::sw])
+    out = jnp.stack(patches, axis=2)  # [N, C, kH*kW, oH, oW]
+    return jnp.reshape(out, (n, c, kh, kw, oh, ow))

@@ -1,0 +1,131 @@
+"""Normalization ops: batchnorm, layernorm, LRN, dropout.
+
+Reference parity: libnd4j ``batchnorm`` / ``layer_norm`` / ``lrn`` /
+``dropout`` declarable ops and DL4J's ``BatchNormalization`` /
+``LocalResponseNormalization`` / ``DropoutLayer`` (SURVEY.md §2.2).
+
+TPU-native: pure functions; train-mode batchnorm returns updated running
+stats functionally (no mutation), so the whole step stays jittable.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def batch_norm(x, gamma, beta, mean, var, *, eps: float = 1e-5,
+               axis: int = 1) -> jnp.ndarray:
+    """Inference-mode batchnorm (ref: libnd4j ``batchnorm``).
+
+    ``axis`` is the channel axis (1 for NCHW — the reference's default).
+    """
+    shape = [1] * x.ndim
+    shape[axis] = -1
+    mean = jnp.reshape(mean, shape)
+    var = jnp.reshape(var, shape)
+    g = jnp.reshape(gamma, shape) if gamma is not None else 1.0
+    b = jnp.reshape(beta, shape) if beta is not None else 0.0
+    inv = jax.lax.rsqrt(var + eps)
+    return (x - mean) * inv * g + b
+
+
+def batch_norm_train(x, gamma, beta, running_mean, running_var, *,
+                     eps: float = 1e-5, decay: float = 0.9, axis: int = 1
+                     ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Training-mode batchnorm: normalize by batch stats, return
+    (out, new_running_mean, new_running_var).
+
+    ``decay`` matches DL4J's BatchNormalization ``decay`` (default 0.9):
+    new_running = decay * running + (1-decay) * batch_stat.
+    """
+    axes = tuple(i for i in range(x.ndim) if i != axis)
+    m = jnp.mean(x, axis=axes)
+    v = jnp.var(x, axis=axes)
+    out = batch_norm(x, gamma, beta, m, v, eps=eps, axis=axis)
+    new_mean = decay * running_mean + (1.0 - decay) * m
+    new_var = decay * running_var + (1.0 - decay) * v
+    return out, new_mean, new_var
+
+
+def layer_norm(x, gain, bias=None, *, axis=-1, eps: float = 1e-5):
+    """Layer norm (ref: libnd4j ``layer_norm``)."""
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    m = jnp.mean(x, axis=axes, keepdims=True)
+    v = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - m) * jax.lax.rsqrt(v + eps)
+    if gain is not None:
+        out = out * gain
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def rms_norm(x, gain, *, axis=-1, eps: float = 1e-6):
+    """RMSNorm — TPU-era extension used by the transformer zoo models."""
+    ms = jnp.mean(jnp.square(x), axis=axis, keepdims=True)
+    out = x * jax.lax.rsqrt(ms + eps)
+    return out * gain if gain is not None else out
+
+
+def lrn(x, *, depth: int = 5, alpha: float = 1e-4, beta: float = 0.75,
+        bias: float = 1.0, data_format: str = "NCHW"):
+    """Local response normalization across channels (ref: libnd4j ``lrn``,
+    DL4J LocalResponseNormalization; AlexNet uses this)."""
+    c_axis = 1 if data_format.upper().startswith("NC") else x.ndim - 1
+    sq = jnp.square(x)
+    c = x.shape[c_axis]
+    # sum over a window of `depth` channels centred at each channel
+    half = depth // 2
+    pad_cfg = [(0, 0)] * x.ndim
+    pad_cfg[c_axis] = (half, depth - 1 - half)
+    padded = jnp.pad(sq, pad_cfg)
+    window = [1] * x.ndim
+    window[c_axis] = depth
+    summed = jax.lax.reduce_window(padded, jnp.asarray(0, x.dtype), jax.lax.add,
+                                   tuple(window), (1,) * x.ndim,
+                                   [(0, 0)] * x.ndim)
+    return x / (bias + alpha * summed) ** beta
+
+
+def dropout(x, rate: float, rng_key, *, train: bool = True):
+    """Inverted dropout (ref: DL4J ``Dropout`` — NOTE the reference's
+    Dropout(p) keeps with probability p; here ``rate`` is the DROP
+    probability, the modern convention; the nn layer adapts)."""
+    if not train or rate <= 0.0:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(rng_key, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+
+def alpha_dropout(x, rate: float, rng_key, *, train: bool = True):
+    """SELU-compatible alpha dropout (ref: DL4J ``AlphaDropout``)."""
+    if not train or rate <= 0.0:
+        return x
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(rng_key, keep, x.shape)
+    a = (keep + alpha_p ** 2 * keep * (1 - keep)) ** -0.5
+    b = -a * alpha_p * (1 - keep)
+    return (a * jnp.where(mask, x, alpha_p) + b).astype(x.dtype)
+
+
+def gaussian_dropout(x, rate: float, rng_key, *, train: bool = True):
+    """(ref: DL4J ``GaussianDropout``)"""
+    if not train or rate <= 0.0:
+        return x
+    stddev = (rate / (1.0 - rate)) ** 0.5
+    noise = 1.0 + stddev * jax.random.normal(rng_key, x.shape, x.dtype)
+    return x * noise
+
+
+def gaussian_noise(x, stddev: float, rng_key, *, train: bool = True):
+    """(ref: DL4J ``GaussianNoise``)"""
+    if not train or stddev <= 0.0:
+        return x
+    return x + stddev * jax.random.normal(rng_key, x.shape, x.dtype)

@@ -1,0 +1,473 @@
+"""Op registry — the declarable-op surface.
+
+Reference parity: libnd4j's ``OpRegistrator`` over ~500 ``DeclarableOp``s
+(``libnd4j/include/ops/declarable/``, ``CustomOperations.h``) and the JVM
+mirror classes in ``org.nd4j.linalg.api.ops`` (SURVEY.md §2.1/§2.2).
+
+TPU-native design: an op here is a *StableHLO subgraph builder* — a pure
+function of jax arrays that XLA compiles/fuses — NOT a kernel. Ten-ish
+generic families (elementwise map, pairwise, reduce, index-reduce,
+broadcast, shape, gather/scatter, random, nn, linalg) replace the
+reference's ~10k per-dtype kernel instantiations (SURVEY.md §7).
+
+The registry serves three purposes:
+1. name → callable dispatch for the graph engine (autodiff/) and for
+   eager ``execOp`` calls (the ``Nd4j.exec(DynamicCustomOp)`` seam);
+2. an auditable inventory of the op surface for parity checking;
+3. a ``PlatformHelper``-style override hook (ref: libnd4j
+   ``platform/{mkldnn,cudnn}``): ``register_platform_override(name, fn)``
+   lets a Pallas kernel shadow the generic lowering at dispatch time.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.ops import activations as _act
+from deeplearning4j_tpu.ops import attention as _attn
+from deeplearning4j_tpu.ops import convolution as _conv
+from deeplearning4j_tpu.ops import losses as _loss
+from deeplearning4j_tpu.ops import normalization as _norm
+from deeplearning4j_tpu.ops import recurrent as _rnn
+
+_REGISTRY: Dict[str, Callable] = {}
+_PLATFORM_OVERRIDES: Dict[str, Callable] = {}
+
+
+def _sigmoid_derivative(x):
+    s = jax.nn.sigmoid(x)
+    return s * (1 - s)
+
+
+def register(name: str, fn: Callable = None):
+    """Register an op (decorator or direct)."""
+    if fn is None:
+        def deco(f):
+            _REGISTRY[name] = f
+            return f
+        return deco
+    _REGISTRY[name] = fn
+    return fn
+
+
+def register_platform_override(name: str, fn: Callable) -> None:
+    """Shadow a generic op with a platform-specific (e.g. Pallas) impl
+    (ref: libnd4j PlatformHelper dispatch)."""
+    if name not in _REGISTRY:
+        raise KeyError(f"cannot override unknown op '{name}'")
+    _PLATFORM_OVERRIDES[name] = fn
+
+
+def clear_platform_override(name: str) -> None:
+    _PLATFORM_OVERRIDES.pop(name, None)
+
+
+def get(name: str) -> Callable:
+    """Resolve an op by name, honouring platform overrides."""
+    if name in _PLATFORM_OVERRIDES:
+        return _PLATFORM_OVERRIDES[name]
+    if name not in _REGISTRY:
+        raise KeyError(f"Unknown op '{name}' ({len(_REGISTRY)} registered)")
+    return _REGISTRY[name]
+
+
+def has(name: str) -> bool:
+    return name in _REGISTRY
+
+
+def all_ops():
+    return sorted(_REGISTRY)
+
+
+def exec_op(name: str, *args, **kwargs):
+    """Eager single-op execution (ref: ``Nd4j.exec(DynamicCustomOp)`` →
+    OpExecutioner → execCustomOp2). jax caches the per-shape compiled
+    program, so repeated eager calls don't recompile."""
+    return get(name)(*args, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# Family: elementwise transforms (ref: transform {same,strict,float,bool} loops)
+# ---------------------------------------------------------------------------
+_TRANSFORMS = {
+    "abs": jnp.abs, "neg": jnp.negative, "exp": jnp.exp, "expm1": jnp.expm1,
+    "log": jnp.log, "log1p": jnp.log1p, "log2": jnp.log2, "log10": jnp.log10,
+    "sqrt": jnp.sqrt, "rsqrt": lax.rsqrt, "square": jnp.square,
+    "cube": lambda x: x * x * x, "reciprocal": jnp.reciprocal,
+    "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan,
+    "asin": jnp.arcsin, "acos": jnp.arccos, "atan": jnp.arctan,
+    "sinh": jnp.sinh, "cosh": jnp.cosh, "tanh": jnp.tanh,
+    "asinh": jnp.arcsinh, "acosh": jnp.arccosh, "atanh": jnp.arctanh,
+    "erf": jax.scipy.special.erf, "erfc": jax.scipy.special.erfc,
+    "floor": jnp.floor, "ceil": jnp.ceil, "round": jnp.round, "rint": jnp.rint,
+    "sign": jnp.sign, "isnan": jnp.isnan, "isinf": jnp.isinf,
+    "isfinite": jnp.isfinite, "not": jnp.logical_not,
+    "sigmoid": jax.nn.sigmoid, "sigmoid_derivative": _sigmoid_derivative,
+    "softplus": jax.nn.softplus, "softsign": jax.nn.soft_sign,
+    "identity": lambda x: x,
+}
+for _n, _f in _TRANSFORMS.items():
+    register(_n, _f)
+# the activation surface has ONE source of truth: activations.ACTIVATIONS
+for _n, _f in _act.ACTIVATIONS.items():
+    register(_n, _f)
+register("hard_sigmoid", _act.hardsigmoid)
+register("hard_tanh", _act.hardtanh)
+register("rational_tanh", _act.rationaltanh)
+register("rectified_tanh", _act.rectifiedtanh)
+
+# ---------------------------------------------------------------------------
+# Family: pairwise / broadcast binary (ref: pairwise + broadcast loops)
+# ---------------------------------------------------------------------------
+_PAIRWISE = {
+    "add": jnp.add, "subtract": jnp.subtract, "multiply": jnp.multiply,
+    "divide": jnp.divide, "reversesubtract": lambda a, b: b - a,
+    "reversedivide": lambda a, b: b / a, "pow": jnp.power,
+    "floordiv": jnp.floor_divide, "mod": jnp.mod, "fmod": jnp.fmod,
+    "maximum": jnp.maximum, "minimum": jnp.minimum,
+    "atan2": jnp.arctan2, "squared_subtract": lambda a, b: jnp.square(a - b),
+    "greater": jnp.greater, "greater_equal": jnp.greater_equal,
+    "less": jnp.less, "less_equal": jnp.less_equal,
+    "equals": jnp.equal, "not_equals": jnp.not_equal,
+    "boolean_and": jnp.logical_and, "boolean_or": jnp.logical_or,
+    "boolean_xor": jnp.logical_xor,
+    "bitwise_and": jnp.bitwise_and, "bitwise_or": jnp.bitwise_or,
+    "bitwise_xor": jnp.bitwise_xor, "left_shift": jnp.left_shift,
+    "right_shift": jnp.right_shift,
+}
+for _n, _f in _PAIRWISE.items():
+    register(_n, _f)
+
+# ---------------------------------------------------------------------------
+# Family: reductions (ref: reduce {float,same,bool,long} + indexreduce +
+# summarystats loops)
+# ---------------------------------------------------------------------------
+def _red(fn):
+    def op(x, axis=None, keepdims=False):
+        return fn(x, axis=_axes(axis), keepdims=keepdims)
+    return op
+
+
+def _axes(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, int):
+        return (axis,)
+    return tuple(int(a) for a in axis)
+
+
+_REDUCE = {
+    "reduce_sum": jnp.sum, "reduce_mean": jnp.mean, "reduce_max": jnp.max,
+    "reduce_min": jnp.min, "reduce_prod": jnp.prod,
+    "reduce_norm1": lambda x, axis=None, keepdims=False: jnp.sum(jnp.abs(x), axis=axis, keepdims=keepdims),
+    "reduce_norm2": lambda x, axis=None, keepdims=False: jnp.sqrt(jnp.sum(x * x, axis=axis, keepdims=keepdims)),
+    "reduce_norm_max": lambda x, axis=None, keepdims=False: jnp.max(jnp.abs(x), axis=axis, keepdims=keepdims),
+    "reduce_sqnorm": lambda x, axis=None, keepdims=False: jnp.sum(x * x, axis=axis, keepdims=keepdims),
+    "reduce_logsumexp": lambda x, axis=None, keepdims=False: jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdims),
+    "all": jnp.all, "any": jnp.any,
+    "count_nonzero": lambda x, axis=None, keepdims=False: jnp.count_nonzero(x, axis=axis, keepdims=keepdims),
+    "count_zero": lambda x, axis=None, keepdims=False: jnp.sum(x == 0, axis=axis, keepdims=keepdims),
+}
+for _n, _f in _REDUCE.items():
+    register(_n, _red(_f))
+
+register("argmax", lambda x, axis=None: jnp.argmax(x, axis=axis))
+register("argmin", lambda x, axis=None: jnp.argmin(x, axis=axis))
+register("argamax", lambda x, axis=None: jnp.argmax(jnp.abs(x), axis=axis))
+register("argamin", lambda x, axis=None: jnp.argmin(jnp.abs(x), axis=axis))
+
+
+@register("moments")
+def _moments(x, axis=None, keepdims=False):
+    """(ref: libnd4j ``moments`` — returns mean and variance)"""
+    return jnp.mean(x, axis=_axes(axis), keepdims=keepdims), \
+        jnp.var(x, axis=_axes(axis), keepdims=keepdims)
+
+
+@register("standardize")
+def _standardize(x, axis=-1):
+    m = jnp.mean(x, axis=axis, keepdims=True)
+    s = jnp.std(x, axis=axis, keepdims=True)
+    return (x - m) / jnp.maximum(s, 1e-8)
+
+# ---------------------------------------------------------------------------
+# Family: shape / gather-scatter (ref: declarable generic/shape, parity_ops)
+# ---------------------------------------------------------------------------
+register("reshape", lambda x, shape: jnp.reshape(x, shape))
+register("transpose", lambda x, perm=None: jnp.transpose(x, perm))
+register("permute", lambda x, perm: jnp.transpose(x, perm))
+register("expand_dims", lambda x, axis: jnp.expand_dims(x, axis))
+register("squeeze", lambda x, axis=None: jnp.squeeze(x, axis))
+register("concat", lambda arrs, axis=0: jnp.concatenate(arrs, axis=axis))
+register("stack", lambda arrs, axis=0: jnp.stack(arrs, axis=axis))
+register("unstack", lambda x, axis=0: [jnp.squeeze(s, axis) for s in jnp.split(x, x.shape[axis], axis)])
+register("split", lambda x, num, axis=0: jnp.split(x, num, axis=axis))
+register("split_v", lambda x, sizes, axis=0: jnp.split(x, list(jnp.cumsum(jnp.asarray(sizes))[:-1]), axis=axis))
+register("tile", lambda x, reps: jnp.tile(x, reps))
+register("repeat", lambda x, n, axis: jnp.repeat(x, n, axis=axis))
+register("flip", lambda x, axis: jnp.flip(x, axis))
+register("reverse", lambda x, axis: jnp.flip(x, axis))
+register("roll", lambda x, shift, axis=None: jnp.roll(x, shift, axis))
+register("pad", lambda x, paddings, mode="constant", value=0.0:
+         jnp.pad(x, paddings, mode=mode, constant_values=value) if mode == "constant"
+         else jnp.pad(x, paddings, mode=mode))
+register("gather", lambda x, idx, axis=0: jnp.take(x, idx, axis=axis))
+register("gather_nd", lambda x, idx: x[tuple(jnp.moveaxis(idx, -1, 0))])
+register("scatter_update", lambda x, idx, upd: x.at[idx].set(upd))
+register("scatter_add", lambda x, idx, upd: x.at[idx].add(upd))
+register("scatter_sub", lambda x, idx, upd: x.at[idx].add(-upd))
+register("scatter_max", lambda x, idx, upd: x.at[idx].max(upd))
+register("scatter_min", lambda x, idx, upd: x.at[idx].min(upd))
+register("slice", lambda x, begin, size: lax.dynamic_slice(x, begin, size))
+register("strided_slice", lambda x, begin, end, strides: x[tuple(slice(b, e, s) for b, e, s in zip(begin, end, strides))])
+register("where", lambda cond, x=None, y=None: jnp.where(cond, x, y) if x is not None else jnp.argwhere(cond))
+register("boolean_mask", lambda x, m: x[m])
+register("one_hot", lambda idx, depth, on=1.0, off=0.0, axis=-1:
+         jax.nn.one_hot(idx, depth, axis=axis) * (on - off) + off)
+register("eye", lambda n, m=None: jnp.eye(n, m))
+register("diag", jnp.diag)
+register("diag_part", jnp.diagonal)
+register("trace", jnp.trace)
+register("triu", jnp.triu)
+register("tril", jnp.tril)
+register("size", lambda x: x.size)
+register("shape_of", lambda x: jnp.asarray(x.shape, jnp.int32))
+register("rank", lambda x: x.ndim)
+register("linspace", jnp.linspace)
+register("range", jnp.arange)
+register("cast", lambda x, dtype: x.astype(dtype))
+register("assign", lambda x, y: jnp.broadcast_to(y, x.shape).astype(x.dtype))
+register("fill", lambda shape, value: jnp.full(shape, value))
+register("zeros_like", jnp.zeros_like)
+register("ones_like", jnp.ones_like)
+register("cumsum", lambda x, axis=0, exclusive=False, reverse=False:
+         _cum(jnp.cumsum, x, axis, exclusive, reverse, 0.0))
+register("cumprod", lambda x, axis=0, exclusive=False, reverse=False:
+         _cum(jnp.cumprod, x, axis, exclusive, reverse, 1.0))
+
+
+def _cum(fn, x, axis, exclusive, reverse, init):
+    if reverse:
+        x = jnp.flip(x, axis)
+    out = fn(x, axis=axis)
+    if exclusive:
+        out = jnp.concatenate(
+            [jnp.full(_exc_shape(x, axis), init, x.dtype),
+             jnp.take(out, jnp.arange(x.shape[axis] - 1), axis=axis)], axis=axis)
+    if reverse:
+        out = jnp.flip(out, axis)
+    return out
+
+
+def _exc_shape(x, axis):
+    s = list(x.shape)
+    s[axis] = 1
+    return tuple(s)
+
+
+@register("top_k")
+def _top_k(x, k, sorted=True):
+    return lax.top_k(x, k)
+
+
+@register("in_top_k")
+def _in_top_k(predictions, targets, k):
+    _, idx = lax.top_k(predictions, k)
+    return jnp.any(idx == targets[:, None], axis=-1)
+
+
+@register("unique")
+def _unique(x):
+    vals, idx = jnp.unique(x, return_inverse=True, size=x.size, fill_value=0)
+    return vals, idx
+
+
+@register("confusion_matrix")
+def _confusion_matrix(labels, pred, num_classes):
+    idx = labels.astype(jnp.int32) * num_classes + pred.astype(jnp.int32)
+    cm = jnp.bincount(idx, length=num_classes * num_classes)
+    return cm.reshape(num_classes, num_classes)
+
+
+@register("sequence_mask")
+def _sequence_mask(lengths, maxlen):
+    return (jnp.arange(maxlen)[None, :] < lengths[:, None])
+
+
+@register("reverse_sequence")
+def _reverse_sequence(x, lengths, seq_axis=1, batch_axis=0):
+    T = x.shape[seq_axis]
+    idx = jnp.arange(T)
+    def per_example(xi, li):
+        rev = jnp.where(idx < li, li - 1 - idx, idx)
+        return jnp.take(xi, rev, axis=seq_axis - 1 if seq_axis > batch_axis else seq_axis)
+    return jax.vmap(per_example, in_axes=(batch_axis, 0), out_axes=batch_axis)(x, lengths)
+
+# ---------------------------------------------------------------------------
+# Family: linalg (ref: generic/blas + helpers; GEMM → MXU dot_general)
+# ---------------------------------------------------------------------------
+register("matmul", lambda a, b, transpose_a=False, transpose_b=False:
+         jnp.matmul(jnp.swapaxes(a, -1, -2) if transpose_a else a,
+                    jnp.swapaxes(b, -1, -2) if transpose_b else b))
+register("mmul", lambda *a, **k: get("matmul")(*a, **k))
+register("batched_gemm", lambda *a, **k: get("matmul")(*a, **k))
+register("tensordot", jnp.tensordot)
+register("outer", jnp.outer)
+register("dot", jnp.vdot)
+register("cholesky", jnp.linalg.cholesky)
+register("qr", jnp.linalg.qr)
+register("svd", jnp.linalg.svd)
+register("matrix_inverse", jnp.linalg.inv)
+register("matrix_determinant", jnp.linalg.det)
+register("log_matrix_determinant", lambda x: jnp.linalg.slogdet(x)[1])
+register("solve", jnp.linalg.solve)
+register("triangular_solve", lambda a, b, lower=True:
+         jax.scipy.linalg.solve_triangular(a, b, lower=lower))
+register("lstsq", lambda a, b: jnp.linalg.lstsq(a, b)[0])
+register("matrix_diag", lambda d: jnp.apply_along_axis(jnp.diag, -1, d) if d.ndim > 1 else jnp.diag(d))
+register("norm", jnp.linalg.norm)
+register("cross", jnp.cross)
+
+# ---------------------------------------------------------------------------
+# Family: nn ops (conv/pool/norm/rnn/attention — defined in sibling modules)
+# ---------------------------------------------------------------------------
+register("conv1d", _conv.conv1d)
+register("conv2d", _conv.conv2d)
+register("conv3dnew", _conv.conv3d)
+register("conv3d", _conv.conv3d)
+register("deconv2d", _conv.deconv2d)
+register("depthwise_conv2d", _conv.depthwise_conv2d)
+register("sconv2d", _conv.separable_conv2d)
+register("maxpool2d", _conv.maxpool2d)
+register("avgpool2d", _conv.avgpool2d)
+register("pnormpool2d", _conv.pnormpool2d)
+register("maxpool3dnew", _conv.maxpool3d)
+register("avgpool3dnew", _conv.avgpool3d)
+register("upsampling2d", _conv.upsampling2d)
+register("space_to_depth", _conv.space_to_depth)
+register("depth_to_space", _conv.depth_to_space)
+register("im2col", _conv.im2col)
+register("batchnorm", _norm.batch_norm)
+register("layer_norm", _norm.layer_norm)
+register("rms_norm", _norm.rms_norm)
+register("lrn", _norm.lrn)
+register("dropout", _norm.dropout)
+register("lstmLayer", _rnn.lstm)
+register("lstmCell", _rnn.lstm_cell)
+register("gruCell", _rnn.gru_cell)
+register("gru", _rnn.gru)
+register("sru", _rnn.sru)
+register("sruCell", _rnn.sru_cell)
+register("simple_rnn", _rnn.simple_rnn)
+register("dot_product_attention", _attn.dot_product_attention)
+register("multi_head_dot_product_attention", _attn.multi_head_attention)
+register("flash_attention", _attn.flash_attention)
+register("softmax", lambda x, axis=-1: jax.nn.softmax(x, axis=axis))
+register("log_softmax", lambda x, axis=-1: jax.nn.log_softmax(x, axis=axis))
+register("prelu", _act.prelu)
+register("relu_layer", lambda x, w, b: jax.nn.relu(x @ w + b))
+register("xw_plus_b", lambda x, w, b: x @ w + b)
+register("bias_add", lambda x, b: x + b)
+register("embedding_lookup", lambda table, ids: jnp.take(table, ids, axis=0))
+
+# losses (ref: generic/loss)
+register("softmax_cross_entropy_loss", _loss.softmax_cross_entropy_logits)
+register("sigmoid_cross_entropy_loss", _loss.xent_logits)
+register("sparse_softmax_cross_entropy_loss", _loss.sparse_mcxent)
+register("mean_sqerr_loss", _loss.mse)
+register("absolute_difference_loss", _loss.l1)
+register("cosine_distance_loss", _loss.cosine_proximity)
+register("hinge_loss", _loss.hinge)
+register("huber_loss", lambda labels, preds, delta=1.0: jnp.mean(
+    jnp.where(jnp.abs(preds - labels) <= delta,
+              0.5 * jnp.square(preds - labels),
+              delta * jnp.abs(preds - labels) - 0.5 * delta ** 2)))
+register("log_loss", _loss.xent)
+register("l2_loss", lambda x: 0.5 * jnp.sum(x * x))
+
+# ---------------------------------------------------------------------------
+# Family: random (ref: declarable random ops; XLA Threefry — SURVEY §2.1 RNG)
+# ---------------------------------------------------------------------------
+register("random_uniform", lambda key, shape, minval=0.0, maxval=1.0, dtype=jnp.float32:
+         jax.random.uniform(key, shape, dtype, minval, maxval))
+register("random_normal", lambda key, shape, mean=0.0, stddev=1.0, dtype=jnp.float32:
+         mean + stddev * jax.random.normal(key, shape, dtype))
+register("random_bernoulli", lambda key, shape, p=0.5:
+         jax.random.bernoulli(key, p, shape))
+register("random_exponential", lambda key, shape, lam=1.0:
+         jax.random.exponential(key, shape) / lam)
+register("random_gamma", lambda key, shape, alpha=1.0:
+         jax.random.gamma(key, alpha, shape))
+register("random_poisson", lambda key, shape, lam=1.0:
+         jax.random.poisson(key, lam, shape))
+register("random_shuffle", lambda key, x, axis=0:
+         jax.random.permutation(key, x, axis=axis))
+register("random_multinomial", lambda key, logits, num_samples:
+         jax.random.categorical(key, logits[:, None, :],
+                                shape=(logits.shape[0], num_samples)))
+register("dropout_inverted", _norm.dropout)
+
+# ---------------------------------------------------------------------------
+# Family: image (ref: generic/parity_ops image ops; used by YOLO/zoo)
+# ---------------------------------------------------------------------------
+@register("resize_nearest_neighbor")
+def _resize_nn(x, size, data_format="NHWC"):
+    if data_format.upper().startswith("NC"):
+        shape = x.shape[:2] + tuple(size)
+        return jax.image.resize(x, shape, "nearest")
+    shape = (x.shape[0],) + tuple(size) + (x.shape[-1],)
+    return jax.image.resize(x, shape, "nearest")
+
+
+@register("resize_bilinear")
+def _resize_bilinear(x, size, data_format="NHWC"):
+    if data_format.upper().startswith("NC"):
+        shape = x.shape[:2] + tuple(size)
+        return jax.image.resize(x, shape, "bilinear")
+    shape = (x.shape[0],) + tuple(size) + (x.shape[-1],)
+    return jax.image.resize(x, shape, "bilinear")
+
+
+@register("non_max_suppression")
+def _nms(boxes, scores, max_out, iou_threshold=0.5, score_threshold=-jnp.inf):
+    """Greedy NMS over [N,4] boxes (y1,x1,y2,x2) — fixed-size output with
+    -1 padding, jit-friendly (ref: libnd4j ``non_max_suppression``; YOLO
+    postprocessing uses this)."""
+    n = boxes.shape[0]
+    y1, x1, y2, x2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    areas = jnp.maximum(y2 - y1, 0) * jnp.maximum(x2 - x1, 0)
+
+    def iou(i, j):
+        yy1 = jnp.maximum(y1[i], y1[j])
+        xx1 = jnp.maximum(x1[i], x1[j])
+        yy2 = jnp.minimum(y2[i], y2[j])
+        xx2 = jnp.minimum(x2[i], x2[j])
+        inter = jnp.maximum(yy2 - yy1, 0) * jnp.maximum(xx2 - xx1, 0)
+        return inter / jnp.maximum(areas[i] + areas[j] - inter, 1e-9)
+
+    order = jnp.argsort(-scores)
+    active = scores[order] > score_threshold
+
+    def body(k, state):
+        keep, active = state
+        cand = jnp.argmax(active)          # first still-active index
+        any_active = jnp.any(active)
+        keep = keep.at[k].set(jnp.where(any_active, order[cand], -1))
+        ious = jax.vmap(lambda j: iou(order[cand], order[j]))(jnp.arange(n))
+        suppress = (ious > iou_threshold) & any_active
+        active = active & ~suppress
+        active = active.at[cand].set(False)
+        return keep, active
+
+    keep0 = jnp.full((max_out,), -1, jnp.int32)
+    keep, _ = lax.fori_loop(0, max_out, body, (keep0, active))
+    return keep
+
+
+# meta info
+def summary() -> str:
+    return f"{len(_REGISTRY)} ops registered, {len(_PLATFORM_OVERRIDES)} platform overrides"

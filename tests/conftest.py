@@ -18,6 +18,11 @@ os.environ.setdefault("DL4J_TPU_MATMUL_PRECISION", "float32")
 
 import jax  # noqa: E402
 
+# The environment's TPU bootstrap (sitecustomize) pins jax_platforms to the
+# TPU plugin via jax.config, which trumps the env var — pin it back to CPU
+# after import so the suite runs on the 8-device virtual mesh.
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 
