@@ -1,0 +1,9 @@
+"""Graph/autodiff engine — the SameDiff equivalent, whole-program XLA
+compiled (ref: org.nd4j.autodiff.samediff; SURVEY.md §2.2, §3.3)."""
+
+from deeplearning4j_tpu.autodiff.samediff import (  # noqa: F401
+    SameDiff,
+    SDVariable,
+    TrainingConfig,
+    History,
+)

@@ -1,0 +1,203 @@
+"""Learning-rate (and value) schedules.
+
+Reference parity: ``org.nd4j.linalg.schedule.{ISchedule, FixedSchedule,
+StepSchedule, ExponentialSchedule, InverseSchedule, PolySchedule,
+SigmoidSchedule, MapSchedule, CycleSchedule, RampSchedule}``
+(SURVEY.md §2.2 "Training infra").
+
+TPU-native: ``valueAt(iteration, epoch)`` is pure jnp math on traced
+scalars, so the schedule evaluates INSIDE the compiled train step — no
+host round-trip per iteration (the reference recomputes on the JVM side
+each step).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class ISchedule:
+    """valueAt(iteration, epoch) -> value. Subclasses are stateless."""
+
+    def valueAt(self, iteration, epoch=0):
+        raise NotImplementedError
+
+    def __call__(self, iteration, epoch=0):
+        return self.valueAt(iteration, epoch)
+
+    def to_config(self):
+        d = {"@class": type(self).__name__}
+        d.update({k: v for k, v in self.__dict__.items()})
+        return d
+
+    @staticmethod
+    def from_config(d):
+        d = dict(d)
+        cls_name = d.pop("@class")
+        if cls_name == "RampSchedule":
+            return RampSchedule(ISchedule.from_config(d["base"]), d["num_iter"])
+        cls = _SCHEDULES[cls_name]
+        obj = cls.__new__(cls)
+        obj.__dict__.update(d)
+        return obj
+
+
+class FixedSchedule(ISchedule):
+    def __init__(self, value: float):
+        self.value = float(value)
+
+    def valueAt(self, iteration, epoch=0):
+        return self.value
+
+
+class StepSchedule(ISchedule):
+    """value * decayRate^floor(iter/step) (ref: StepSchedule)."""
+
+    def __init__(self, schedule_type: str = "iteration", initial_value: float = 0.1,
+                 decay_rate: float = 0.5, step: float = 1000):
+        self.schedule_type = schedule_type
+        self.initial_value = float(initial_value)
+        self.decay_rate = float(decay_rate)
+        self.step = float(step)
+
+    def valueAt(self, iteration, epoch=0):
+        t = iteration if self.schedule_type == "iteration" else epoch
+        return self.initial_value * self.decay_rate ** jnp.floor(t / self.step)
+
+
+class ExponentialSchedule(ISchedule):
+    """value * gamma^t (ref: ExponentialSchedule)."""
+
+    def __init__(self, schedule_type: str = "iteration", initial_value: float = 0.1,
+                 gamma: float = 0.999):
+        self.schedule_type = schedule_type
+        self.initial_value = float(initial_value)
+        self.gamma = float(gamma)
+
+    def valueAt(self, iteration, epoch=0):
+        t = iteration if self.schedule_type == "iteration" else epoch
+        return self.initial_value * self.gamma ** t
+
+
+class InverseSchedule(ISchedule):
+    """value / (1 + gamma*t)^power (ref: InverseSchedule)."""
+
+    def __init__(self, schedule_type: str = "iteration", initial_value: float = 0.1,
+                 gamma: float = 0.001, power: float = 1.0):
+        self.schedule_type = schedule_type
+        self.initial_value = float(initial_value)
+        self.gamma = float(gamma)
+        self.power = float(power)
+
+    def valueAt(self, iteration, epoch=0):
+        t = iteration if self.schedule_type == "iteration" else epoch
+        return self.initial_value / (1.0 + self.gamma * t) ** self.power
+
+
+class PolySchedule(ISchedule):
+    """value * (1 - t/maxIter)^power (ref: PolySchedule)."""
+
+    def __init__(self, schedule_type: str = "iteration", initial_value: float = 0.1,
+                 power: float = 1.0, max_iter: int = 10000):
+        self.schedule_type = schedule_type
+        self.initial_value = float(initial_value)
+        self.power = float(power)
+        self.max_iter = int(max_iter)
+
+    def valueAt(self, iteration, epoch=0):
+        t = iteration if self.schedule_type == "iteration" else epoch
+        frac = jnp.clip(t / self.max_iter, 0.0, 1.0)
+        return self.initial_value * (1.0 - frac) ** self.power
+
+
+class SigmoidSchedule(ISchedule):
+    """value / (1 + exp(gamma*(t - stepSize))) (ref: SigmoidSchedule)."""
+
+    def __init__(self, schedule_type: str = "iteration", initial_value: float = 0.1,
+                 gamma: float = 0.01, step_size: int = 1000):
+        self.schedule_type = schedule_type
+        self.initial_value = float(initial_value)
+        self.gamma = float(gamma)
+        self.step_size = int(step_size)
+
+    def valueAt(self, iteration, epoch=0):
+        t = iteration if self.schedule_type == "iteration" else epoch
+        return self.initial_value / (1.0 + jnp.exp(self.gamma * (t - self.step_size)))
+
+
+class MapSchedule(ISchedule):
+    """Piecewise-constant from {iteration: value} (ref: MapSchedule).
+    jit-friendly: lowered to a chain of wheres."""
+
+    def __init__(self, schedule_type: str = "iteration", values: dict = None):
+        self.schedule_type = schedule_type
+        self.values = {int(k): float(v) for k, v in (values or {}).items()}
+        if 0 not in self.values:
+            raise ValueError("MapSchedule requires a value for t=0")
+
+    def valueAt(self, iteration, epoch=0):
+        t = iteration if self.schedule_type == "iteration" else epoch
+        out = jnp.asarray(self.values[0], jnp.float32)
+        for k in sorted(self.values):
+            out = jnp.where(t >= k, self.values[k], out)
+        return out
+
+
+class CycleSchedule(ISchedule):
+    """1cycle policy (ref: CycleSchedule): ramp up to maxLR, down to
+    initial, then anneal to initial/100 over the final fraction."""
+
+    def __init__(self, schedule_type: str = "iteration", initial_value: float = 0.01,
+                 max_value: float = 0.1, cycle_length: int = 1000,
+                 annealing_length: int = 100, annealing_decay: float = 0.01):
+        self.schedule_type = schedule_type
+        self.initial_value = float(initial_value)
+        self.max_value = float(max_value)
+        self.cycle_length = int(cycle_length)
+        self.annealing_length = int(annealing_length)
+        self.annealing_decay = float(annealing_decay)
+
+    def valueAt(self, iteration, epoch=0):
+        t = iteration if self.schedule_type == "iteration" else epoch
+        ramp = (self.cycle_length - self.annealing_length) / 2
+        pos = t % self.cycle_length
+        up = self.initial_value + (self.max_value - self.initial_value) * (pos / jnp.maximum(ramp, 1))
+        down = self.max_value - (self.max_value - self.initial_value) * ((pos - ramp) / jnp.maximum(ramp, 1))
+        anneal_pos = (pos - 2 * ramp) / jnp.maximum(self.annealing_length, 1)
+        anneal = self.initial_value * (1.0 - (1.0 - self.annealing_decay) * anneal_pos)
+        out = jnp.where(pos < ramp, up, jnp.where(pos < 2 * ramp, down, anneal))
+        return out
+
+
+class RampSchedule(ISchedule):
+    """Linear warmup wrapper (ref: RampSchedule): scales an underlying
+    schedule by t/numIter for the first numIter steps."""
+
+    def __init__(self, base: ISchedule, num_iter: int):
+        self.base = base
+        self.num_iter = int(num_iter)
+
+    def valueAt(self, iteration, epoch=0):
+        scale = jnp.clip((iteration + 1) / self.num_iter, 0.0, 1.0)
+        return scale * self.base.valueAt(iteration, epoch)
+
+    def to_config(self):
+        return {"@class": "RampSchedule", "base": self.base.to_config(),
+                "num_iter": self.num_iter}
+
+    @staticmethod
+    def _from_config(d):
+        return RampSchedule(ISchedule.from_config(d["base"]), d["num_iter"])
+
+
+_SCHEDULES = {c.__name__: c for c in
+              [FixedSchedule, StepSchedule, ExponentialSchedule, InverseSchedule,
+               PolySchedule, SigmoidSchedule, MapSchedule, CycleSchedule,
+               RampSchedule]}
+
+
+def resolve(lr) -> ISchedule:
+    """Accept a float or an ISchedule."""
+    if isinstance(lr, ISchedule):
+        return lr
+    return FixedSchedule(float(lr))

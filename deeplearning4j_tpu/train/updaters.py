@@ -1,0 +1,299 @@
+"""Updaters (optimizers) — the full ND4J updater surface.
+
+Reference parity: ``org.nd4j.linalg.learning.config.{Sgd, Adam, AdamW,
+Nesterovs, RmsProp, AdaGrad, AdaDelta, AdaMax, AMSGrad, Nadam, NoOp}`` and
+the paired ``org.nd4j.linalg.learning.*Updater`` state machines
+(SURVEY.md §2.2 "Training infra"). Same update math, same defaults.
+
+TPU-native: each updater is a pure ``(grad, state, lr, t) -> (update,
+state')`` function over pytrees — the whole optimizer step fuses into the
+compiled train step (the reference mutates flat state vectors op-by-op
+through JNI). The returned ``update`` is SUBTRACTED from params, matching
+the reference's ``params.subi(update)`` contract (SURVEY.md §3.1).
+
+State is a dict of arrays shaped like the param — checkpointable exactly
+like the reference's updater-state binary (ModelSerializer parity).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.train.schedules import ISchedule, resolve
+
+Update = Any
+State = Dict[str, Any]
+
+
+class IUpdater:
+    """Config object; ``init_state(param)`` + ``apply(grad, state, lr, t)``.
+
+    ``lr`` resolves through a schedule at trace time; ``t`` is the traced
+    iteration counter.
+    """
+
+    #: default learning rate if none given (mirrors each ref config's default)
+    DEFAULT_LR = 0.001
+    has_state = True
+
+    def __init__(self, learning_rate=None):
+        self.learning_rate = resolve(self.DEFAULT_LR if learning_rate is None else learning_rate)
+
+    def lr_at(self, t, epoch=0):
+        return self.learning_rate.valueAt(t, epoch)
+
+    def init_state(self, param) -> State:
+        return {}
+
+    def apply(self, grad, state: State, lr, t) -> Tuple[Update, State]:
+        raise NotImplementedError
+
+    # -- config (de)serialization, ModelSerializer parity --
+    def to_config(self):
+        d = {"@class": type(self).__name__}
+        for k, v in self.__dict__.items():
+            d[k] = v.to_config() if isinstance(v, ISchedule) else v
+        return d
+
+    @staticmethod
+    def from_config(d):
+        d = dict(d)
+        cls = UPDATERS[d.pop("@class")]
+        obj = cls.__new__(cls)
+        for k, v in d.items():
+            if k == "learning_rate" and isinstance(v, dict):
+                v = ISchedule.from_config(v)
+            setattr(obj, k, v)
+        return obj
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.__dict__})"
+
+
+class Sgd(IUpdater):
+    """update = lr * g (ref: SgdUpdater)."""
+
+    DEFAULT_LR = 0.1
+    has_state = False
+
+    def apply(self, grad, state, lr, t):
+        return lr * grad, state
+
+
+class NoOp(IUpdater):
+    """Frozen params (ref: NoOpUpdater)."""
+
+    has_state = False
+
+    def __init__(self, learning_rate=None):
+        super().__init__(0.0)
+
+    def apply(self, grad, state, lr, t):
+        return jnp.zeros_like(grad), state
+
+
+class Adam(IUpdater):
+    """ref: AdamUpdater — alpha_t = lr*sqrt(1-b2^t)/(1-b1^t)."""
+
+    DEFAULT_LR = 0.001
+
+    def __init__(self, learning_rate=None, beta1: float = 0.9,
+                 beta2: float = 0.999, epsilon: float = 1e-8):
+        super().__init__(learning_rate)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def init_state(self, param):
+        return {"m": jnp.zeros_like(param), "v": jnp.zeros_like(param)}
+
+    def apply(self, grad, state, lr, t):
+        t1 = t + 1
+        m = self.beta1 * state["m"] + (1 - self.beta1) * grad
+        v = self.beta2 * state["v"] + (1 - self.beta2) * jnp.square(grad)
+        alpha = lr * jnp.sqrt(1 - self.beta2 ** t1) / (1 - self.beta1 ** t1)
+        update = alpha * m / (jnp.sqrt(v) + self.epsilon)
+        return update, {"m": m, "v": v}
+
+
+class AdamW(Adam):
+    """Adam + decoupled weight decay (ref: AdamW/... config). The decay
+    term is added by the trainer via ``weight_decay_update`` because it
+    needs the param value."""
+
+    def __init__(self, learning_rate=None, beta1: float = 0.9,
+                 beta2: float = 0.999, epsilon: float = 1e-8,
+                 weight_decay: float = 0.0):
+        super().__init__(learning_rate, beta1, beta2, epsilon)
+        self.weight_decay = weight_decay
+
+    def weight_decay_update(self, param, lr):
+        return lr * self.weight_decay * param
+
+
+class AMSGrad(Adam):
+    """ref: AMSGradUpdater — keeps max of v."""
+
+    def init_state(self, param):
+        return {"m": jnp.zeros_like(param), "v": jnp.zeros_like(param),
+                "vhat": jnp.zeros_like(param)}
+
+    def apply(self, grad, state, lr, t):
+        t1 = t + 1
+        m = self.beta1 * state["m"] + (1 - self.beta1) * grad
+        v = self.beta2 * state["v"] + (1 - self.beta2) * jnp.square(grad)
+        vhat = jnp.maximum(state["vhat"], v)
+        alpha = lr * jnp.sqrt(1 - self.beta2 ** t1) / (1 - self.beta1 ** t1)
+        update = alpha * m / (jnp.sqrt(vhat) + self.epsilon)
+        return update, {"m": m, "v": v, "vhat": vhat}
+
+
+class AdaMax(Adam):
+    """ref: AdaMaxUpdater — infinity-norm variant."""
+
+    def init_state(self, param):
+        return {"m": jnp.zeros_like(param), "u": jnp.zeros_like(param)}
+
+    def apply(self, grad, state, lr, t):
+        t1 = t + 1
+        m = self.beta1 * state["m"] + (1 - self.beta1) * grad
+        u = jnp.maximum(self.beta2 * state["u"], jnp.abs(grad))
+        update = (lr / (1 - self.beta1 ** t1)) * m / (u + self.epsilon)
+        return update, {"m": m, "u": u}
+
+
+class Nadam(Adam):
+    """ref: NadamUpdater — Nesterov-accelerated Adam."""
+
+    def apply(self, grad, state, lr, t):
+        t1 = t + 1
+        m = self.beta1 * state["m"] + (1 - self.beta1) * grad
+        v = self.beta2 * state["v"] + (1 - self.beta2) * jnp.square(grad)
+        m_hat = m / (1 - self.beta1 ** t1)
+        v_hat = v / (1 - self.beta2 ** t1)
+        update = lr * (self.beta1 * m_hat + (1 - self.beta1) * grad / (1 - self.beta1 ** t1)) \
+            / (jnp.sqrt(v_hat) + self.epsilon)
+        return update, {"m": m, "v": v}
+
+
+class Nesterovs(IUpdater):
+    """ref: NesterovsUpdater (Bengio formulation):
+    v' = mu*v - lr*g; applied step = mu²*v - (1+mu)*lr*g."""
+
+    DEFAULT_LR = 0.1
+
+    def __init__(self, learning_rate=None, momentum: float = 0.9):
+        super().__init__(learning_rate)
+        self.momentum = momentum
+
+    def init_state(self, param):
+        return {"v": jnp.zeros_like(param)}
+
+    def apply(self, grad, state, lr, t):
+        mu = self.momentum
+        v_new = mu * state["v"] - lr * grad
+        update = -(mu * v_new - lr * grad)  # params -= update → += mu²v - (1+mu)lr g
+        return update, {"v": v_new}
+
+
+class RmsProp(IUpdater):
+    """ref: RmsPropUpdater."""
+
+    DEFAULT_LR = 0.1
+
+    def __init__(self, learning_rate=None, rms_decay: float = 0.95,
+                 epsilon: float = 1e-8):
+        super().__init__(learning_rate)
+        self.rms_decay, self.epsilon = rms_decay, epsilon
+
+    def init_state(self, param):
+        return {"g2": jnp.zeros_like(param)}
+
+    def apply(self, grad, state, lr, t):
+        g2 = self.rms_decay * state["g2"] + (1 - self.rms_decay) * jnp.square(grad)
+        update = lr * grad / (jnp.sqrt(g2) + self.epsilon)
+        return update, {"g2": g2}
+
+
+class AdaGrad(IUpdater):
+    """ref: AdaGradUpdater."""
+
+    DEFAULT_LR = 0.1
+
+    def __init__(self, learning_rate=None, epsilon: float = 1e-6):
+        super().__init__(learning_rate)
+        self.epsilon = epsilon
+
+    def init_state(self, param):
+        return {"h": jnp.zeros_like(param)}
+
+    def apply(self, grad, state, lr, t):
+        h = state["h"] + jnp.square(grad)
+        update = lr * grad / (jnp.sqrt(h) + self.epsilon)
+        return update, {"h": h}
+
+
+class AdaDelta(IUpdater):
+    """ref: AdaDeltaUpdater — LR-free."""
+
+    has_state = True
+
+    def __init__(self, rho: float = 0.95, epsilon: float = 1e-6):
+        super().__init__(1.0)
+        self.rho, self.epsilon = rho, epsilon
+
+    def init_state(self, param):
+        return {"Eg2": jnp.zeros_like(param), "Ex2": jnp.zeros_like(param)}
+
+    def apply(self, grad, state, lr, t):
+        rho, eps = self.rho, self.epsilon
+        Eg2 = rho * state["Eg2"] + (1 - rho) * jnp.square(grad)
+        update = grad * jnp.sqrt(state["Ex2"] + eps) / jnp.sqrt(Eg2 + eps)
+        Ex2 = rho * state["Ex2"] + (1 - rho) * jnp.square(update)
+        return update, {"Eg2": Eg2, "Ex2": Ex2}
+
+
+UPDATERS = {c.__name__: c for c in
+            [Sgd, NoOp, Adam, AdamW, AMSGrad, AdaMax, Nadam, Nesterovs,
+             RmsProp, AdaGrad, AdaDelta]}
+
+
+# ---------------------------------------------------------------- gradient ops
+def clip_by_value(grads, clip: float):
+    """ref: GradientNormalization.ClipElementWiseAbsoluteValue."""
+    return jax.tree_util.tree_map(lambda g: jnp.clip(g, -clip, clip), grads)
+
+
+def clip_by_norm(grads, max_norm: float):
+    """Per-tensor L2 clip (ref: ClipL2PerLayer/PerParamType)."""
+    def clip(g):
+        n = jnp.sqrt(jnp.sum(jnp.square(g)))
+        return g * jnp.minimum(1.0, max_norm / jnp.maximum(n, 1e-12))
+    return jax.tree_util.tree_map(clip, grads)
+
+
+def clip_by_global_norm(grads, max_norm: float):
+    """Global-norm clip over the whole gradient pytree."""
+    leaves = jax.tree_util.tree_leaves(grads)
+    gn = jnp.sqrt(sum(jnp.sum(jnp.square(g)) for g in leaves))
+    scale = jnp.minimum(1.0, max_norm / jnp.maximum(gn, 1e-12))
+    return jax.tree_util.tree_map(lambda g: g * scale, grads)
+
+
+def renormalize_l2(grads):
+    """ref: GradientNormalization.RenormalizeL2PerLayer — divide by norm."""
+    def renorm(g):
+        n = jnp.sqrt(jnp.sum(jnp.square(g)))
+        return g / jnp.maximum(n, 1e-12)
+    return jax.tree_util.tree_map(renorm, grads)
+
+
+def apply_regularization(param, grad, l1: float = 0.0, l2: float = 0.0):
+    """ref semantics: L1/L2 fold into the gradient BEFORE the updater
+    (SURVEY.md §3.1 'gradient clipping/L2 → updater math')."""
+    if l2 > 0:
+        grad = grad + l2 * param
+    if l1 > 0:
+        grad = grad + l1 * jnp.sign(param)
+    return grad

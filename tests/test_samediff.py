@@ -1,0 +1,282 @@
+"""Graph engine tests (SameDiff equivalent).
+
+Reference test-strategy parity (SURVEY.md §4): eager-vs-graph equality,
+numeric gradient checks, serialization round-trips, training convergence.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.autodiff import SameDiff, TrainingConfig
+from deeplearning4j_tpu.train import updaters
+
+
+class TestGraphBasics:
+    def test_forward_matches_eager(self):
+        sd = SameDiff.create()
+        x = sd.placeHolder("x", shape=(None, 4))
+        w = sd.var("w", np.ones((4, 3), np.float32))
+        b = sd.var("b", np.zeros((3,), np.float32))
+        out = sd.nn.softmax(x.mmul(w).add(b), name="out")
+        data = np.random.RandomState(0).randn(2, 4).astype(np.float32)
+        res = sd.output({"x": data}, ["out"])["out"]
+        want = jax.nn.softmax(data @ np.ones((4, 3), np.float32))
+        np.testing.assert_allclose(res, want, rtol=1e-5)
+
+    def test_fluent_arith(self):
+        sd = SameDiff.create()
+        a = sd.var("a", np.asarray([1.0, 2.0]))
+        b = sd.var("b", np.asarray([3.0, 4.0]))
+        c = (a + b) * 2.0 - 1.0
+        np.testing.assert_allclose(c.eval(), [7.0, 11.0])
+
+    def test_reductions_and_shapes(self):
+        sd = SameDiff.create()
+        x = sd.var("x", np.arange(6, dtype=np.float32).reshape(2, 3))
+        s = x.sum(1)
+        m = x.mean()
+        r = x.reshape(3, 2).transpose(1, 0)
+        np.testing.assert_allclose(s.eval(), [3.0, 12.0])
+        assert float(m.eval()) == 2.5
+        assert r.eval().shape == (2, 3)
+
+    def test_duplicate_names_uniquified(self):
+        sd = SameDiff.create()
+        a = sd.var("a", np.ones(2))
+        x1 = a.add(1.0)
+        x2 = a.add(1.0)
+        assert x1.name != x2.name
+
+    def test_variable_update_invalidate(self):
+        sd = SameDiff.create()
+        a = sd.var("a", np.asarray(1.0))
+        out = a.mul(2.0)
+        assert float(out.eval()) == 2.0
+        sd.getVariable("a").setArray(np.asarray(5.0))
+        assert float(out.eval()) == 10.0
+
+
+class TestGradients:
+    def test_gradcheck_mlp(self):
+        """Finite-difference through a small graph in fp64 (SURVEY §4)."""
+        with jax.enable_x64(True):
+            sd = SameDiff.create()
+            rng = np.random.RandomState(1)
+            x_data = rng.randn(4, 3)
+            y_data = np.eye(2)[rng.randint(0, 2, 4)]
+            x = sd.placeHolder("x", shape=(None, 3), dtype=jnp.float64)
+            labels = sd.placeHolder("labels", shape=(None, 2), dtype=jnp.float64)
+            w1 = sd.var("w1", rng.randn(3, 5) * 0.5)
+            b1 = sd.var("b1", np.zeros(5))
+            w2 = sd.var("w2", rng.randn(5, 2) * 0.5)
+            h = sd.nn.tanh(x.mmul(w1).add(b1))
+            logits = h.mmul(w2)
+            loss = sd.loss.softmaxCrossEntropy(labels, logits, name="loss")
+            sd.setLossVariables("loss")
+            phs = {"x": x_data, "labels": y_data}
+            grads = sd.calculateGradients(phs, ["w1", "w2", "b1"])
+
+            def loss_at(vname, arr):
+                old = sd._variables[vname]
+                sd._variables = dict(sd._variables, **{vname: arr})
+                v = float(sd.output(phs, ["loss"])["loss"])
+                sd._variables = dict(sd._variables, **{vname: old})
+                return v
+
+            eps = 1e-6
+            for vname in ["w1", "b1", "w2"]:
+                arr = np.asarray(sd._variables[vname])
+                flat_g = np.asarray(grads[vname]).ravel()
+                for idx in range(0, arr.size, max(1, arr.size // 5)):
+                    pert = arr.copy().ravel()
+                    pert[idx] += eps
+                    fp = loss_at(vname, jnp.asarray(pert.reshape(arr.shape)))
+                    pert[idx] -= 2 * eps
+                    fm = loss_at(vname, jnp.asarray(pert.reshape(arr.shape)))
+                    fd = (fp - fm) / (2 * eps)
+                    np.testing.assert_allclose(flat_g[idx], fd, rtol=1e-4, atol=1e-7)
+
+
+class TestTraining:
+    def _xor_sd(self, seed=42):
+        sd = SameDiff.create()
+        rng = np.random.RandomState(seed)
+        x = sd.placeHolder("x", shape=(None, 2))
+        labels = sd.placeHolder("labels", shape=(None, 2))
+        w1 = sd.var("w1", rng.randn(2, 8).astype(np.float32))
+        b1 = sd.var("b1", np.zeros(8, np.float32))
+        w2 = sd.var("w2", rng.randn(8, 2).astype(np.float32))
+        b2 = sd.var("b2", np.zeros(2, np.float32))
+        h = sd.nn.tanh(x.mmul(w1).add(b1))
+        logits = h.mmul(w2).add(b2).rename("logits")
+        sd.loss.softmaxCrossEntropy(labels, logits, name="loss")
+        sd.setLossVariables("loss")
+        return sd
+
+    XOR_X = np.asarray([[0, 0], [0, 1], [1, 0], [1, 1]], np.float32)
+    XOR_Y = np.asarray([[1, 0], [0, 1], [0, 1], [1, 0]], np.float32)
+
+    def test_fit_xor_converges(self):
+        sd = self._xor_sd()
+        sd.setTrainingConfig(TrainingConfig(
+            updater=updaters.Adam(0.05),
+            data_set_feature_mapping=["x"], data_set_label_mapping=["labels"]))
+        hist = sd.fit(data={"x": self.XOR_X, "labels": self.XOR_Y}, epochs=300)
+        assert hist.loss_curve[-1] < 0.05, hist.loss_curve[-1]
+        preds = sd.output({"x": self.XOR_X}, ["logits"])["logits"]
+        assert (np.argmax(preds, 1) == np.argmax(self.XOR_Y, 1)).all()
+
+    @pytest.mark.parametrize("updater_cls", [
+        updaters.Sgd, updaters.Adam, updaters.AdamW, updaters.Nesterovs,
+        updaters.RmsProp, updaters.AdaGrad, updaters.AdaMax,
+        updaters.AMSGrad, updaters.Nadam])
+    def test_every_updater_reduces_loss(self, updater_cls):
+        sd = self._xor_sd()
+        sd.setTrainingConfig(TrainingConfig(
+            updater=updater_cls(0.02),
+            data_set_feature_mapping=["x"], data_set_label_mapping=["labels"]))
+        hist = sd.fit(data={"x": self.XOR_X, "labels": self.XOR_Y}, epochs=60)
+        assert hist.loss_curve[-1] < hist.loss_curve[0]
+
+    def test_adadelta_reduces_loss(self):
+        sd = self._xor_sd()
+        sd.setTrainingConfig(TrainingConfig(
+            updater=updaters.AdaDelta(),
+            data_set_feature_mapping=["x"], data_set_label_mapping=["labels"]))
+        hist = sd.fit(data={"x": self.XOR_X, "labels": self.XOR_Y}, epochs=60)
+        assert hist.loss_curve[-1] < hist.loss_curve[0]
+
+    def test_l2_and_clipping(self):
+        sd = self._xor_sd()
+        sd.setTrainingConfig(TrainingConfig(
+            updater=updaters.Sgd(0.1), l2=1e-3, clip_global_norm=1.0,
+            data_set_feature_mapping=["x"], data_set_label_mapping=["labels"]))
+        hist = sd.fit(data={"x": self.XOR_X, "labels": self.XOR_Y}, epochs=50)
+        assert hist.loss_curve[-1] < hist.loss_curve[0]
+
+    def test_tuple_batches_via_mapping(self):
+        sd = self._xor_sd()
+        sd.setTrainingConfig(TrainingConfig(
+            updater=updaters.Adam(0.05),
+            data_set_feature_mapping=["x"], data_set_label_mapping=["labels"]))
+        batches = [(self.XOR_X, self.XOR_Y)] * 50
+        hist = sd.fit(iterator=batches)
+        assert hist.loss_curve[-1] < hist.loss_curve[0]
+
+
+class TestControlFlow:
+    def test_while_loop(self):
+        sd = SameDiff.create()
+        i0 = sd.constant(jnp.asarray(0.0), name="i0")
+        acc0 = sd.constant(jnp.asarray(1.0), name="acc0")
+        i_out, acc_out = sd.while_loop(
+            lambda i, acc: i < 5,
+            lambda i, acc: (i + 1, acc * 2),
+            [i0, acc0])
+        assert float(acc_out.eval()) == 32.0
+
+    def test_while_loop_single_var(self):
+        sd = SameDiff.create()
+        i0 = sd.constant(jnp.asarray(0.0), name="j0")
+        out = sd.while_loop(lambda i: i < 5, lambda i: (i + 1,), [i0])
+        assert float(out.eval()) == 5.0
+
+    def test_cond(self):
+        sd = SameDiff.create()
+        p = sd.constant(jnp.asarray(True), name="p")
+        a = sd.constant(jnp.asarray(2.0), name="a")
+        out = sd.cond(p, lambda v: v * 10, lambda v: v - 1, [a])
+        assert float(out.eval()) == 20.0
+
+
+class TestSerialization:
+    def test_save_load_roundtrip(self, tmp_path):
+        sd = TestTraining()._xor_sd()
+        sd.setTrainingConfig(TrainingConfig(
+            updater=updaters.Adam(0.05),
+            data_set_feature_mapping=["x"], data_set_label_mapping=["labels"]))
+        hist = sd.fit(data={"x": TestTraining.XOR_X, "labels": TestTraining.XOR_Y},
+                      epochs=30)
+        path = str(tmp_path / "model.sdz")
+        sd.save(path)
+
+        sd2 = SameDiff.load(path)
+        # exact forward parity after round-trip
+        out1 = sd.output({"x": TestTraining.XOR_X}, ["logits"])["logits"]
+        out2 = sd2.output({"x": TestTraining.XOR_X}, ["logits"])["logits"]
+        np.testing.assert_allclose(out1, out2, rtol=1e-6)
+        # training resumes with updater state (exact-resume contract,
+        # ref: ModelSerializer updater-state binary)
+        h1 = sd.fit(data={"x": TestTraining.XOR_X, "labels": TestTraining.XOR_Y}, epochs=1)
+        h2 = sd2.fit(data={"x": TestTraining.XOR_X, "labels": TestTraining.XOR_Y}, epochs=1)
+        np.testing.assert_allclose(h1.loss_curve[-1], h2.loss_curve[-1], rtol=1e-5)
+
+    def test_schedule_roundtrip(self):
+        from deeplearning4j_tpu.train import schedules
+        s = schedules.StepSchedule("iteration", 0.1, 0.5, 100)
+        s2 = schedules.ISchedule.from_config(s.to_config())
+        assert float(s2.valueAt(250)) == pytest.approx(0.025)
+
+    def test_ramp_schedule_roundtrip(self):
+        from deeplearning4j_tpu.train import schedules
+        r = schedules.RampSchedule(schedules.FixedSchedule(1.0), 10)
+        r2 = schedules.ISchedule.from_config(r.to_config())
+        assert float(r2.valueAt(4)) == pytest.approx(0.5)
+
+    def test_rng_nodes_roundtrip(self, tmp_path):
+        sd = SameDiff.create()
+        x = sd.placeHolder("x", shape=(None, 4))
+        d = sd.nn.dropout(x, 0.5, name="d")
+        u = sd.random.uniform(0.0, 1.0, (3,), name="u")
+        path = str(tmp_path / "rng.sdz")
+        sd.save(path)
+        sd2 = SameDiff.load(path)
+        data = np.ones((2, 4), np.float32)
+        # inference mode: dropout is identity
+        out = sd2.output({"x": data}, ["d"])["d"]
+        np.testing.assert_allclose(out, data)
+        # train mode executes the rng path
+        out_t = sd2.output({"x": data}, ["d"], train=True)["d"]
+        assert out_t.shape == (2, 4)
+        uv = sd2.output({}, ["u"])["u"]
+        assert uv.shape == (3,) and (np.asarray(uv) >= 0).all()
+
+    def test_cast_roundtrip(self, tmp_path):
+        import jax.numpy as jnp
+        sd = SameDiff.create()
+        a = sd.var("a", np.asarray([1.5, 2.5], np.float32))
+        c = a.castTo(jnp.int32).rename("c")
+        path = str(tmp_path / "cast.sdz")
+        sd.save(path)
+        sd2 = SameDiff.load(path)
+        out = sd2.output({}, ["c"])["c"]
+        assert out.dtype == jnp.int32
+
+    def test_grad_cache_invalidated_on_loss_change(self):
+        sd = SameDiff.create()
+        x = sd.var("x", np.asarray(3.0))
+        a = x.mul(2.0).rename("lossA")   # dA/dx = 2
+        b = x.mul(x).rename("lossB")     # dB/dx = 2x = 6
+        sd.setLossVariables("lossA")
+        g1 = sd.calculateGradients({}, ["x"])["x"]
+        assert float(g1) == pytest.approx(2.0)
+        sd.setLossVariables("lossB")
+        g2 = sd.calculateGradients({}, ["x"])["x"]
+        assert float(g2) == pytest.approx(6.0)
+
+
+class TestSchedules:
+    def test_values(self):
+        from deeplearning4j_tpu.train import schedules
+        assert float(schedules.ExponentialSchedule("iteration", 1.0, 0.9).valueAt(2)) == pytest.approx(0.81)
+        assert float(schedules.PolySchedule("iteration", 1.0, 2.0, 100).valueAt(50)) == pytest.approx(0.25)
+        assert float(schedules.InverseSchedule("iteration", 1.0, 1.0, 1.0).valueAt(1)) == pytest.approx(0.5)
+        m = schedules.MapSchedule("iteration", {0: 0.1, 10: 0.01})
+        assert float(m.valueAt(5)) == pytest.approx(0.1)
+        assert float(m.valueAt(15)) == pytest.approx(0.01)
+        r = schedules.RampSchedule(schedules.FixedSchedule(1.0), 10)
+        assert float(r.valueAt(4)) == pytest.approx(0.5)
