@@ -239,12 +239,8 @@ class SDNN(_Namespace):
         return self._rec("layer_norm", ins, name=name, axis=axis)
 
     def batchNorm(self, x, mean, var, gamma, beta, eps=1e-5, axis=1, name=None):
-        return self.sd._record_fn(
-            "batchnorm",
-            lambda xx, m, v, g, b, eps, axis: op_registry.get("batchnorm")(
-                xx, g, b, m, v, eps=eps, axis=axis),
-            [self.sd._as_var(v).name for v in (x, mean, var, gamma, beta)],
-            name=name, attrs={"eps": eps, "axis": axis})
+        return self._rec("batchnorm_sd", [x, mean, var, gamma, beta],
+                         name=name, eps=eps, axis=axis)
 
     def dropout(self, x, rate, name=None):
         """Dropout with the graph's per-step RNG stream (active only when
@@ -312,19 +308,11 @@ class SDCNN(_Namespace):
 class SDRNN(_Namespace):
     """ref: ops.SDRNN."""
 
-    def lstmLayer(self, x_tnc, w_ih, w_hh, b, name=None, n_out=2):
-        v = self.sd._record_fn(
-            "lstmLayer",
-            lambda x, wi, wh, bb: op_registry.get("lstmLayer")(x, wi, wh, bb)[0],
-            [self.sd._as_var(i).name for i in (x_tnc, w_ih, w_hh, b)], name=name)
-        return v
+    def lstmLayer(self, x_tnc, w_ih, w_hh, b, name=None):
+        return self._rec("lstmLayer_out", [x_tnc, w_ih, w_hh, b], name=name)
 
     def gru(self, x_tnc, w_ih, w_hh, b_ih, b_hh, name=None):
-        return self.sd._record_fn(
-            "gru",
-            lambda x, wi, wh, bi, bh: op_registry.get("gru")(x, wi, wh, bi, bh)[0],
-            [self.sd._as_var(i).name for i in (x_tnc, w_ih, w_hh, b_ih, b_hh)],
-            name=name)
+        return self._rec("gru_out", [x_tnc, w_ih, w_hh, b_ih, b_hh], name=name)
 
 
 class SDLoss(_Namespace):
@@ -334,9 +322,7 @@ class SDLoss(_Namespace):
         return self._rec("mean_sqerr_loss", [labels, preds], name=name)
 
     def meanSquaredError(self, labels, preds, name=None):
-        return self.sd._record_fn("mse", loss_ops.mse,
-                                  [self.sd._as_var(labels).name, self.sd._as_var(preds).name],
-                                  name=name)
+        return self._rec("mean_sqerr_loss", [labels, preds], name=name)
 
     def softmaxCrossEntropy(self, labels, logits, name=None):
         return self._rec("softmax_cross_entropy_loss", [labels, logits], name=name)
@@ -501,9 +487,13 @@ class SameDiff:
     def _unique(self, base: str) -> str:
         if base not in self._vars and base not in self._placeholders:
             return base
-        n = self._name_counter.get(base, 0) + 1
-        self._name_counter[base] = n
-        return f"{base}_{n}"
+        n = self._name_counter.get(base, 0)
+        while True:
+            n += 1
+            cand = f"{base}_{n}"
+            if cand not in self._vars and cand not in self._placeholders:
+                self._name_counter[base] = n
+                return cand
 
     def placeHolder(self, name: str, shape=None, dtype=jnp.float32) -> SDVariable:
         v = SDVariable(self, name, "PLACEHOLDER", tuple(shape) if shape else None, dtype)
@@ -691,18 +681,26 @@ class SameDiff:
     def calculateGradients(self, placeholders: Dict[str, Any],
                            wrt: Sequence[str] = None) -> Dict[str, jax.Array]:
         """ref: SameDiff.calculateGradients — here ONE reverse-mode program
-        (jax.grad) instead of createGradFunction's doDiff graph walk."""
+        (jax.grad) instead of createGradFunction's doDiff graph walk.
+        ``wrt`` may name variables AND placeholders (input gradients), like
+        the reference."""
         wrt = list(wrt) if wrt else list(self._variables)
         phs = {k: jnp.asarray(v) for k, v in (placeholders or {}).items()}
+        unknown = [k for k in wrt if k not in self._variables and k not in phs]
+        if unknown:
+            raise ValueError(f"calculateGradients: {unknown} are neither "
+                             f"variables nor provided placeholders")
         key = ("grad", tuple(self._loss_variables), tuple(wrt),
                tuple(sorted((k, v.shape, str(v.dtype)) for k, v in phs.items())))
         if key not in self._grad_cache:
             total = self._total_loss_fn()
-            gfn = jax.jit(jax.grad(total), static_argnames=("train",))
+            gfn = jax.jit(jax.grad(total, argnums=(0, 2)),
+                          static_argnames=("train",))
             self._grad_cache[key] = gfn
-        grads = self._grad_cache[key](self._variables, self._constants, phs,
-                                      jax.random.PRNGKey(self._step), False)
-        return {k: grads[k] for k in wrt}
+        var_g, ph_g = self._grad_cache[key](self._variables, self._constants, phs,
+                                            jax.random.PRNGKey(self._step), False)
+        merged = {**ph_g, **var_g}
+        return {k: merged[k] for k in wrt}
 
     # ------------------------------------------------------------- training
     def setTrainingConfig(self, cfg: TrainingConfig):

@@ -1,0 +1,16 @@
+"""Datasets/ETL (ref: DataVec + deeplearning4j-data — SURVEY.md §2.2)."""
+
+from deeplearning4j_tpu.data.dataset import (  # noqa: F401
+    AsyncDataSetIterator,
+    DataSet,
+    DataSetIterator,
+    ImagePreProcessingScaler,
+    ListDataSetIterator,
+    MultiDataSet,
+    NormalizerMinMaxScaler,
+    NormalizerStandardize,
+)
+from deeplearning4j_tpu.data.iterators import (  # noqa: F401
+    IrisDataSetIterator,
+    MnistDataSetIterator,
+)

@@ -1,0 +1,342 @@
+"""DataSet containers + iterator contract + normalizers.
+
+Reference parity: ``org.nd4j.linalg.dataset.{DataSet, MultiDataSet}``,
+``api.iterator.DataSetIterator``, preprocessors ``NormalizerStandardize``,
+``NormalizerMinMaxScaler``, ``ImagePreProcessingScaler`` (SURVEY.md §2.2
+"DataSet API"), and ``AsyncDataSetIterator`` (background prefetch,
+§2.2 "Iterators").
+
+TPU-native: arrays stay as numpy on host until the train step moves a
+batch to device; AsyncDataSetIterator double-buffers host→device transfer
+behind compute.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+
+class DataSet:
+    """Features + labels (+ masks) batch container (ref: DataSet)."""
+
+    def __init__(self, features=None, labels=None,
+                 features_mask=None, labels_mask=None):
+        self.features = np.asarray(features) if features is not None else None
+        self.labels = np.asarray(labels) if labels is not None else None
+        self.features_mask = np.asarray(features_mask) if features_mask is not None else None
+        self.labels_mask = np.asarray(labels_mask) if labels_mask is not None else None
+
+    def getFeatures(self):
+        return self.features
+
+    def getLabels(self):
+        return self.labels
+
+    def numExamples(self) -> int:
+        return 0 if self.features is None else self.features.shape[0]
+
+    def splitTestAndTrain(self, fraction_or_n) -> "SplitTestAndTrain":
+        n = self.numExamples()
+        n_train = int(fraction_or_n * n) if isinstance(fraction_or_n, float) \
+            else int(fraction_or_n)
+        def cut(a, lo, hi):
+            return a[lo:hi] if a is not None else None
+        train = DataSet(cut(self.features, 0, n_train), cut(self.labels, 0, n_train),
+                        cut(self.features_mask, 0, n_train), cut(self.labels_mask, 0, n_train))
+        test = DataSet(cut(self.features, n_train, n), cut(self.labels, n_train, n),
+                       cut(self.features_mask, n_train, n), cut(self.labels_mask, n_train, n))
+        return SplitTestAndTrain(train, test)
+
+    def shuffle(self, seed: int = None):
+        rng = np.random.RandomState(seed)
+        perm = rng.permutation(self.numExamples())
+        for attr in ("features", "labels", "features_mask", "labels_mask"):
+            a = getattr(self, attr)
+            if a is not None:
+                setattr(self, attr, a[perm])
+
+    def batchBy(self, batch_size: int) -> List["DataSet"]:
+        out = []
+        for i in range(0, self.numExamples(), batch_size):
+            sl = slice(i, i + batch_size)
+            out.append(DataSet(
+                self.features[sl],
+                self.labels[sl] if self.labels is not None else None,
+                self.features_mask[sl] if self.features_mask is not None else None,
+                self.labels_mask[sl] if self.labels_mask is not None else None))
+        return out
+
+    @staticmethod
+    def merge(datasets: Sequence["DataSet"]) -> "DataSet":
+        def cat(attr):
+            arrs = [getattr(d, attr) for d in datasets]
+            if any(a is None for a in arrs):
+                return None
+            return np.concatenate(arrs, axis=0)
+        return DataSet(cat("features"), cat("labels"),
+                       cat("features_mask"), cat("labels_mask"))
+
+
+class SplitTestAndTrain:
+    def __init__(self, train: DataSet, test: DataSet):
+        self.train = train
+        self.test = test
+
+    def getTrain(self):
+        return self.train
+
+    def getTest(self):
+        return self.test
+
+
+class MultiDataSet:
+    """Multiple features/labels arrays (ref: MultiDataSet) — the
+    ComputationGraph batch container."""
+
+    def __init__(self, features: Sequence, labels: Sequence,
+                 features_masks: Sequence = None, labels_masks: Sequence = None):
+        as_list = lambda x: [np.asarray(a) for a in x] if x is not None else None
+        self.features = as_list(features if isinstance(features, (list, tuple)) else [features])
+        self.labels = as_list(labels if isinstance(labels, (list, tuple)) else [labels])
+        self.features_masks = as_list(features_masks)
+        self.labels_masks = as_list(labels_masks)
+
+    def numExamples(self):
+        return self.features[0].shape[0]
+
+
+class DataSetIterator:
+    """Iterator contract (ref: DataSetIterator): python-iterable over
+    DataSet minibatches, restartable via reset()."""
+
+    def __iter__(self) -> Iterator[DataSet]:
+        self.reset()
+        return self
+
+    def __next__(self) -> DataSet:
+        if not self.hasNext():
+            raise StopIteration
+        return self.next()
+
+    def hasNext(self) -> bool:
+        raise NotImplementedError
+
+    def next(self) -> DataSet:
+        raise NotImplementedError
+
+    def reset(self):
+        raise NotImplementedError
+
+    def batch(self) -> int:
+        raise NotImplementedError
+
+    def setPreProcessor(self, pre):
+        self._pre = pre
+
+    def _apply_pre(self, ds: DataSet) -> DataSet:
+        pre = getattr(self, "_pre", None)
+        if pre is not None:
+            pre.transform(ds)
+        return ds
+
+
+class ListDataSetIterator(DataSetIterator):
+    """Iterate an in-memory DataSet in minibatches (ref: ListDataSetIterator)."""
+
+    def __init__(self, data: DataSet, batch_size: int = 32, shuffle: bool = False,
+                 seed: int = 12345):
+        self.data = data
+        self.batch_size = batch_size
+        self._shuffle = shuffle
+        self._seed = seed
+        self._epoch = 0
+        self.reset()
+
+    def reset(self):
+        if self._shuffle:
+            rng = np.random.RandomState(self._seed + self._epoch)
+            self._order = rng.permutation(self.data.numExamples())
+            self._epoch += 1
+        else:
+            self._order = np.arange(self.data.numExamples())
+        self._pos = 0
+
+    def hasNext(self):
+        return self._pos < self.data.numExamples()
+
+    def next(self):
+        idx = self._order[self._pos:self._pos + self.batch_size]
+        self._pos += self.batch_size
+        d = self.data
+        ds = DataSet(
+            d.features[idx],
+            d.labels[idx] if d.labels is not None else None,
+            d.features_mask[idx] if d.features_mask is not None else None,
+            d.labels_mask[idx] if d.labels_mask is not None else None)
+        return self._apply_pre(ds)
+
+    def batch(self):
+        return self.batch_size
+
+    def totalOutcomes(self):
+        return self.data.labels.shape[1] if self.data.labels is not None else 0
+
+    def inputColumns(self):
+        return int(np.prod(self.data.features.shape[1:]))
+
+
+class AsyncDataSetIterator(DataSetIterator):
+    """Background prefetch wrapper (ref: AsyncDataSetIterator — the
+    process-internal thread boundary in SURVEY.md §3.1)."""
+
+    _END = object()
+
+    def __init__(self, base: DataSetIterator, prefetch: int = 2):
+        self.base = base
+        self.prefetch = prefetch
+        self._queue = None
+        self._thread = None
+        self._next_item = None
+        self._stop = None
+        self.reset()
+
+    def _worker(self, q, stop):
+        try:
+            while not stop.is_set() and self.base.hasNext():
+                item = self.base.next()
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+        finally:
+            try:
+                q.put_nowait(self._END)
+            except queue.Full:
+                pass
+
+    def reset(self):
+        # stop + drain the previous worker before touching self.base, or two
+        # threads race on the underlying iterator and drop batches
+        if self._thread is not None and self._thread.is_alive():
+            self._stop.set()
+            while self._thread.is_alive():
+                try:
+                    self._queue.get_nowait()
+                except queue.Empty:
+                    pass
+                self._thread.join(timeout=0.05)
+        self.base.reset()
+        self._stop = threading.Event()
+        self._queue = queue.Queue(maxsize=self.prefetch)
+        self._thread = threading.Thread(target=self._worker,
+                                        args=(self._queue, self._stop),
+                                        daemon=True)
+        self._thread.start()
+        self._next_item = self._queue.get()
+
+    def hasNext(self):
+        return self._next_item is not self._END
+
+    def next(self):
+        item = self._next_item
+        self._next_item = self._queue.get()
+        return item
+
+    def batch(self):
+        return self.base.batch()
+
+
+# ------------------------------------------------------------------ normalizers
+class NormalizerStandardize:
+    """Zero-mean unit-variance (ref: NormalizerStandardize): fit, transform,
+    revert; serializable state."""
+
+    def __init__(self):
+        self.mean = None
+        self.std = None
+
+    def fit(self, data):
+        feats = data.features if isinstance(data, DataSet) else np.asarray(data)
+        axes = tuple(i for i in range(feats.ndim) if i != 1) if feats.ndim > 2 else (0,)
+        self.mean = feats.mean(axis=axes, keepdims=True)[0] if feats.ndim <= 2 \
+            else feats.mean(axis=axes)
+        self.std = feats.std(axis=axes, keepdims=True)[0] if feats.ndim <= 2 \
+            else feats.std(axis=axes)
+        self.std = np.where(self.std < 1e-8, 1.0, self.std)
+
+    def transform(self, data):
+        feats = data.features if isinstance(data, DataSet) else data
+        mean, std = self.mean, self.std
+        if feats.ndim > 2:  # broadcast over channel axis
+            shape = [1] * feats.ndim
+            shape[1] = -1
+            mean = mean.reshape(shape)
+            std = std.reshape(shape)
+        out = (feats - mean) / std
+        if isinstance(data, DataSet):
+            data.features = out
+            return data
+        return out
+
+    def revert(self, data):
+        feats = data.features if isinstance(data, DataSet) else data
+        out = feats * self.std + self.mean
+        if isinstance(data, DataSet):
+            data.features = out
+            return data
+        return out
+
+    def state(self):
+        return {"mean": self.mean, "std": self.std}
+
+    def load_state(self, d):
+        self.mean, self.std = d["mean"], d["std"]
+
+
+class NormalizerMinMaxScaler:
+    """Scale to [min, max] (ref: NormalizerMinMaxScaler)."""
+
+    def __init__(self, min_range: float = 0.0, max_range: float = 1.0):
+        self.min_range, self.max_range = min_range, max_range
+        self.data_min = None
+        self.data_max = None
+
+    def fit(self, data):
+        feats = data.features if isinstance(data, DataSet) else np.asarray(data)
+        flat = feats.reshape(feats.shape[0], -1)
+        self.data_min = flat.min()
+        self.data_max = flat.max()
+
+    def transform(self, data):
+        feats = data.features if isinstance(data, DataSet) else data
+        denom = max(self.data_max - self.data_min, 1e-8)
+        out = (feats - self.data_min) / denom * (self.max_range - self.min_range) \
+            + self.min_range
+        if isinstance(data, DataSet):
+            data.features = out
+            return data
+        return out
+
+
+class ImagePreProcessingScaler:
+    """Pixel [0, 255] -> [a, b] (ref: ImagePreProcessingScaler)."""
+
+    def __init__(self, a: float = 0.0, b: float = 1.0):
+        self.a, self.b = a, b
+
+    def fit(self, data):
+        pass
+
+    def transform(self, data):
+        feats = data.features if isinstance(data, DataSet) else data
+        out = feats / 255.0 * (self.b - self.a) + self.a
+        if isinstance(data, DataSet):
+            data.features = out
+            return data
+        return out

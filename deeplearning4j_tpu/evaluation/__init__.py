@@ -1,0 +1,10 @@
+"""Metrics (ref: org.nd4j.evaluation — SURVEY.md §2.2)."""
+
+from deeplearning4j_tpu.evaluation.evaluation import (  # noqa: F401
+    ConfusionMatrix,
+    Evaluation,
+    EvaluationBinary,
+    RegressionEvaluation,
+    ROC,
+    ROCMultiClass,
+)

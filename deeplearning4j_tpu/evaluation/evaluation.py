@@ -1,0 +1,320 @@
+"""Evaluation / metrics — streaming accumulators that merge across workers.
+
+Reference parity: ``org.nd4j.evaluation.classification.{Evaluation, ROC,
+ROCBinary, EvaluationBinary, ConfusionMatrix, EvaluationCalibration}`` and
+``regression.RegressionEvaluation`` (SURVEY.md §2.2 "Evaluation").
+
+Semantics preserved: streaming ``eval(labels, predictions)`` accumulation;
+``merge(other)`` for distributed eval (the Spark path in the reference;
+the mesh path here); accuracy/precision/recall/f1 definitions with
+per-class and macro averages.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class ConfusionMatrix:
+    """ref: org.nd4j.evaluation.classification.ConfusionMatrix."""
+
+    def __init__(self, num_classes: int):
+        self.num_classes = num_classes
+        self.matrix = np.zeros((num_classes, num_classes), np.int64)
+
+    def add(self, actual: np.ndarray, predicted: np.ndarray):
+        idx = actual.astype(np.int64) * self.num_classes + predicted.astype(np.int64)
+        counts = np.bincount(idx, minlength=self.num_classes ** 2)
+        self.matrix += counts.reshape(self.num_classes, self.num_classes)
+
+    def getCount(self, actual: int, predicted: int) -> int:
+        return int(self.matrix[actual, predicted])
+
+    def merge(self, other: "ConfusionMatrix"):
+        self.matrix += other.matrix
+
+
+class Evaluation:
+    """Multi-class classification metrics (ref: Evaluation)."""
+
+    def __init__(self, num_classes: int = None, labels: List[str] = None):
+        self.num_classes = num_classes or (len(labels) if labels else None)
+        self.label_names = labels
+        self.confusion: Optional[ConfusionMatrix] = None
+        self._examples = 0
+
+    def _ensure(self, n):
+        if self.confusion is None:
+            self.num_classes = self.num_classes or n
+            self.confusion = ConfusionMatrix(self.num_classes)
+
+    def eval(self, labels, predictions, mask=None):
+        """labels/predictions: [N, C] probabilities/one-hot, or [N] ints;
+        time series [N, C, T] are flattened over time with mask applied
+        (reference semantics)."""
+        labels = np.asarray(labels)
+        predictions = np.asarray(predictions)
+        if labels.ndim == 3:  # [N, C, T] -> [N*T, C] with mask [N, T]
+            n, c, t = labels.shape
+            labels = labels.transpose(0, 2, 1).reshape(-1, c)
+            predictions = predictions.transpose(0, 2, 1).reshape(-1, c)
+            if mask is not None:
+                keep = np.asarray(mask).reshape(-1) > 0
+                labels, predictions = labels[keep], predictions[keep]
+        elif mask is not None:
+            keep = np.asarray(mask).reshape(-1) > 0
+            labels, predictions = labels[keep], predictions[keep]
+        actual = labels.argmax(1) if labels.ndim == 2 else labels.astype(np.int64)
+        pred = predictions.argmax(1) if predictions.ndim == 2 else predictions.astype(np.int64)
+        n_cls = labels.shape[1] if labels.ndim == 2 else int(max(actual.max(), pred.max())) + 1
+        self._ensure(n_cls)
+        self.confusion.add(actual, pred)
+        self._examples += len(actual)
+
+    # -- metrics --
+    def _tp(self, c): return self.confusion.matrix[c, c]
+    def _fp(self, c): return self.confusion.matrix[:, c].sum() - self._tp(c)
+    def _fn(self, c): return self.confusion.matrix[c, :].sum() - self._tp(c)
+
+    def accuracy(self) -> float:
+        m = self.confusion.matrix
+        return float(np.trace(m) / max(m.sum(), 1))
+
+    def precision(self, cls: int = None) -> float:
+        if cls is not None:
+            tp, fp = self._tp(cls), self._fp(cls)
+            return float(tp / max(tp + fp, 1))
+        vals = [self.precision(c) for c in range(self.num_classes)
+                if (self.confusion.matrix[:, c].sum() + self.confusion.matrix[c, :].sum()) > 0]
+        return float(np.mean(vals)) if vals else 0.0
+
+    def recall(self, cls: int = None) -> float:
+        if cls is not None:
+            tp, fn = self._tp(cls), self._fn(cls)
+            return float(tp / max(tp + fn, 1))
+        vals = [self.recall(c) for c in range(self.num_classes)
+                if (self.confusion.matrix[:, c].sum() + self.confusion.matrix[c, :].sum()) > 0]
+        return float(np.mean(vals)) if vals else 0.0
+
+    def f1(self, cls: int = None) -> float:
+        p, r = self.precision(cls), self.recall(cls)
+        return float(2 * p * r / max(p + r, 1e-12))
+
+    def falsePositiveRate(self, cls: int) -> float:
+        fp = self._fp(cls)
+        tn = self.confusion.matrix.sum() - self._tp(cls) - self._fp(cls) - self._fn(cls)
+        return float(fp / max(fp + tn, 1))
+
+    def matthewsCorrelation(self, cls: int) -> float:
+        tp, fp, fn = self._tp(cls), self._fp(cls), self._fn(cls)
+        tn = self.confusion.matrix.sum() - tp - fp - fn
+        denom = np.sqrt(float((tp + fp) * (tp + fn) * (tn + fp) * (tn + fn)))
+        return float((tp * tn - fp * fn) / denom) if denom > 0 else 0.0
+
+    def merge(self, other: "Evaluation"):
+        """Distributed-eval merge (ref: IEvaluation.merge, used by Spark)."""
+        if other.confusion is None:
+            return
+        self._ensure(other.num_classes)
+        self.confusion.merge(other.confusion)
+        self._examples += other._examples
+
+    def stats(self) -> str:
+        lines = [
+            "========================Evaluation Metrics========================",
+            f" # of classes:    {self.num_classes}",
+            f" Examples:        {self._examples}",
+            f" Accuracy:        {self.accuracy():.4f}",
+            f" Precision:       {self.precision():.4f}",
+            f" Recall:          {self.recall():.4f}",
+            f" F1 Score:        {self.f1():.4f}",
+            "=================================================================",
+        ]
+        return "\n".join(lines)
+
+
+class EvaluationBinary:
+    """Per-output independent binary metrics (ref: EvaluationBinary)."""
+
+    def __init__(self, threshold: float = 0.5):
+        self.threshold = threshold
+        self.tp = self.fp = self.tn = self.fn = None
+
+    def eval(self, labels, predictions, mask=None):
+        labels = np.asarray(labels)
+        preds = (np.asarray(predictions) >= self.threshold).astype(np.int64)
+        lab = (labels >= 0.5).astype(np.int64)
+        if self.tp is None:
+            n = labels.shape[-1]
+            self.tp = np.zeros(n, np.int64)
+            self.fp = np.zeros(n, np.int64)
+            self.tn = np.zeros(n, np.int64)
+            self.fn = np.zeros(n, np.int64)
+        w = np.ones_like(lab) if mask is None else np.asarray(mask).astype(np.int64)
+        self.tp += ((preds == 1) & (lab == 1) & (w > 0)).sum(0)
+        self.fp += ((preds == 1) & (lab == 0) & (w > 0)).sum(0)
+        self.tn += ((preds == 0) & (lab == 0) & (w > 0)).sum(0)
+        self.fn += ((preds == 0) & (lab == 1) & (w > 0)).sum(0)
+
+    def accuracy(self, output: int = None) -> float:
+        tp, fp, tn, fn = self.tp, self.fp, self.tn, self.fn
+        if output is not None:
+            tp, fp, tn, fn = tp[output], fp[output], tn[output], fn[output]
+        else:
+            tp, fp, tn, fn = tp.sum(), fp.sum(), tn.sum(), fn.sum()
+        return float((tp + tn) / max(tp + tn + fp + fn, 1))
+
+    def precision(self, output: int) -> float:
+        return float(self.tp[output] / max(self.tp[output] + self.fp[output], 1))
+
+    def recall(self, output: int) -> float:
+        return float(self.tp[output] / max(self.tp[output] + self.fn[output], 1))
+
+    def merge(self, other: "EvaluationBinary"):
+        if other.tp is None:
+            return
+        if self.tp is None:
+            self.tp, self.fp = other.tp.copy(), other.fp.copy()
+            self.tn, self.fn = other.tn.copy(), other.fn.copy()
+        else:
+            self.tp += other.tp
+            self.fp += other.fp
+            self.tn += other.tn
+            self.fn += other.fn
+
+
+class ROC:
+    """Binary ROC/AUC by threshold steps (ref: ROC with thresholdSteps)."""
+
+    def __init__(self, threshold_steps: int = 100):
+        self.steps = threshold_steps
+        self.tp = np.zeros(threshold_steps + 1, np.int64)
+        self.fp = np.zeros(threshold_steps + 1, np.int64)
+        self.pos = 0
+        self.neg = 0
+
+    def eval(self, labels, predictions):
+        labels = np.asarray(labels).reshape(-1)
+        probs = np.asarray(predictions).reshape(-1)
+        thresholds = np.linspace(0.0, 1.0, self.steps + 1)
+        pos = labels >= 0.5
+        self.pos += int(pos.sum())
+        self.neg += int((~pos).sum())
+        for i, t in enumerate(thresholds):
+            sel = probs >= t
+            self.tp[i] += int((sel & pos).sum())
+            self.fp[i] += int((sel & ~pos).sum())
+
+    def calculateAUC(self) -> float:
+        tpr = self.tp / max(self.pos, 1)
+        fpr = self.fp / max(self.neg, 1)
+        order = np.argsort(fpr)
+        return float(abs(np.trapezoid(tpr[order], fpr[order])))
+
+    def merge(self, other: "ROC"):
+        self.tp += other.tp
+        self.fp += other.fp
+        self.pos += other.pos
+        self.neg += other.neg
+
+
+class ROCMultiClass:
+    """One-vs-all ROC per class (ref: ROCMultiClass)."""
+
+    def __init__(self, threshold_steps: int = 100):
+        self.steps = threshold_steps
+        self.rocs: Dict[int, ROC] = {}
+
+    def eval(self, labels, predictions):
+        labels = np.asarray(labels)
+        preds = np.asarray(predictions)
+        for c in range(labels.shape[1]):
+            self.rocs.setdefault(c, ROC(self.steps)).eval(labels[:, c], preds[:, c])
+
+    def calculateAUC(self, cls: int) -> float:
+        return self.rocs[cls].calculateAUC()
+
+
+class RegressionEvaluation:
+    """Per-column regression metrics (ref: RegressionEvaluation): MSE, MAE,
+    RMSE, RSE, PC (Pearson), R²."""
+
+    def __init__(self, n_columns: int = None):
+        self.n = n_columns
+        self._init_done = False
+
+    def _ensure(self, n):
+        if not self._init_done:
+            self.n = self.n or n
+            z = lambda: np.zeros(self.n, np.float64)
+            self.sum_sq_err = z()
+            self.sum_abs_err = z()
+            self.sum_label = z()
+            self.sum_label_sq = z()
+            self.sum_pred = z()
+            self.sum_pred_sq = z()
+            self.sum_label_pred = z()
+            self.count = 0
+            self._init_done = True
+
+    def eval(self, labels, predictions, mask=None):
+        labels = np.asarray(labels, np.float64)
+        preds = np.asarray(predictions, np.float64)
+        if labels.ndim == 1:
+            labels, preds = labels[:, None], preds[:, None]
+        self._ensure(labels.shape[1])
+        if mask is not None:
+            keep = np.asarray(mask).reshape(-1) > 0
+            labels, preds = labels[keep], preds[keep]
+        err = preds - labels
+        self.sum_sq_err += (err ** 2).sum(0)
+        self.sum_abs_err += np.abs(err).sum(0)
+        self.sum_label += labels.sum(0)
+        self.sum_label_sq += (labels ** 2).sum(0)
+        self.sum_pred += preds.sum(0)
+        self.sum_pred_sq += (preds ** 2).sum(0)
+        self.sum_label_pred += (labels * preds).sum(0)
+        self.count += labels.shape[0]
+
+    def meanSquaredError(self, col: int = 0) -> float:
+        return float(self.sum_sq_err[col] / max(self.count, 1))
+
+    def meanAbsoluteError(self, col: int = 0) -> float:
+        return float(self.sum_abs_err[col] / max(self.count, 1))
+
+    def rootMeanSquaredError(self, col: int = 0) -> float:
+        return float(np.sqrt(self.meanSquaredError(col)))
+
+    def pearsonCorrelation(self, col: int = 0) -> float:
+        n = self.count
+        num = n * self.sum_label_pred[col] - self.sum_label[col] * self.sum_pred[col]
+        den = np.sqrt(max(n * self.sum_label_sq[col] - self.sum_label[col] ** 2, 0)) * \
+            np.sqrt(max(n * self.sum_pred_sq[col] - self.sum_pred[col] ** 2, 0))
+        return float(num / den) if den > 0 else 0.0
+
+    def rSquared(self, col: int = 0) -> float:
+        mean_label = self.sum_label[col] / max(self.count, 1)
+        ss_tot = self.sum_label_sq[col] - self.count * mean_label ** 2
+        return float(1.0 - self.sum_sq_err[col] / ss_tot) if ss_tot > 0 else 0.0
+
+    def merge(self, other: "RegressionEvaluation"):
+        if not getattr(other, "_init_done", False):
+            return
+        if not self._init_done:
+            self.__dict__.update({k: (v.copy() if isinstance(v, np.ndarray) else v)
+                                  for k, v in other.__dict__.items()})
+            return
+        for k in ("sum_sq_err", "sum_abs_err", "sum_label", "sum_label_sq",
+                  "sum_pred", "sum_pred_sq", "sum_label_pred"):
+            setattr(self, k, getattr(self, k) + getattr(other, k))
+        self.count += other.count
+
+    def stats(self) -> str:
+        cols = range(self.n)
+        return "\n".join(
+            f"col {c}: MSE={self.meanSquaredError(c):.6f} "
+            f"MAE={self.meanAbsoluteError(c):.6f} "
+            f"RMSE={self.rootMeanSquaredError(c):.6f} "
+            f"R2={self.rSquared(c):.4f}" for c in cols)

@@ -1,0 +1,10 @@
+"""NN layer/config/network API (ref: deeplearning4j-nn — SURVEY.md §2.2)."""
+
+from deeplearning4j_tpu.nn.config import (  # noqa: F401
+    InputType,
+    ListBuilder,
+    MultiLayerConfiguration,
+    NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.nn import layers  # noqa: F401
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork  # noqa: F401

@@ -1,0 +1,252 @@
+"""Network configuration — NeuralNetConfiguration equivalent.
+
+Reference parity: ``org.deeplearning4j.nn.conf.{NeuralNetConfiguration,
+MultiLayerConfiguration, inputs.InputType}`` and the builder pattern +
+InputType propagation that computes every layer's in/out shapes pre-build
+(SURVEY.md §2.2 "DL4J NN config").
+
+TPU-native: configs are plain typed objects, JSON-serializable like the
+reference's; the built network compiles its whole step with XLA. Input
+preprocessors (FeedForwardToCnn etc.) are inserted automatically during
+``setInputType`` propagation, mirroring the reference.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+
+class InputType:
+    """Shape metadata propagated through layers (ref: conf.inputs.InputType).
+
+    Kinds: ``ff`` (size,), ``cnn`` (channels, height, width — NCHW like the
+    reference), ``cnn_flat`` (flattened image rows), ``rnn`` (size, timesteps).
+    """
+
+    def __init__(self, kind: str, **dims):
+        self.kind = kind
+        self.dims = dims
+
+    @staticmethod
+    def feedForward(size: int) -> "InputType":
+        return InputType("ff", size=int(size))
+
+    @staticmethod
+    def convolutional(height: int, width: int, channels: int) -> "InputType":
+        return InputType("cnn", height=int(height), width=int(width),
+                         channels=int(channels))
+
+    @staticmethod
+    def convolutionalFlat(height: int, width: int, depth: int) -> "InputType":
+        return InputType("cnn_flat", height=int(height), width=int(width),
+                         channels=int(depth))
+
+    @staticmethod
+    def recurrent(size: int, timeseries_length: int = -1) -> "InputType":
+        return InputType("rnn", size=int(size), timesteps=int(timeseries_length))
+
+    def __getattr__(self, item):
+        try:
+            return self.dims[item]
+        except KeyError:
+            raise AttributeError(item)
+
+    def arrayElementsPerExample(self) -> int:
+        if self.kind == "ff":
+            return self.dims["size"]
+        if self.kind in ("cnn", "cnn_flat"):
+            return self.dims["height"] * self.dims["width"] * self.dims["channels"]
+        if self.kind == "rnn":
+            return self.dims["size"] * max(self.dims["timesteps"], 1)
+        raise ValueError(self.kind)
+
+    def to_config(self):
+        return {"kind": self.kind, **self.dims}
+
+    @staticmethod
+    def from_config(d):
+        d = dict(d)
+        return InputType(d.pop("kind"), **d)
+
+    def __repr__(self):
+        return f"InputType({self.kind}, {self.dims})"
+
+    def __eq__(self, other):
+        return isinstance(other, InputType) and self.kind == other.kind \
+            and self.dims == other.dims
+
+
+class NeuralNetConfiguration:
+    """Global training/defaults config + the ``.list()`` builder entry
+    (ref: NeuralNetConfiguration.Builder)."""
+
+    class Builder:
+        def __init__(self):
+            self._seed = 12345
+            self._updater = None
+            self._weight_init = "xavier"
+            self._activation = "identity"
+            self._l1 = 0.0
+            self._l2 = 0.0
+            self._grad_norm = None           # None | 'clip_value' | 'clip_l2' | 'clip_global' | 'renorm'
+            self._grad_norm_threshold = 1.0
+            self._dtype = "float32"
+
+        def seed(self, s):
+            self._seed = int(s)
+            return self
+
+        def updater(self, u):
+            self._updater = u
+            return self
+
+        def weightInit(self, w):
+            self._weight_init = w
+            return self
+
+        def activation(self, a):
+            self._activation = a
+            return self
+
+        def l1(self, v):
+            self._l1 = float(v)
+            return self
+
+        def l2(self, v):
+            self._l2 = float(v)
+            return self
+
+        def dataType(self, dt):
+            self._dtype = str(dt)
+            return self
+
+        def gradientNormalization(self, kind, threshold: float = 1.0):
+            self._grad_norm = kind
+            self._grad_norm_threshold = float(threshold)
+            return self
+
+        def miniBatch(self, b: bool):
+            return self
+
+        def list(self) -> "ListBuilder":
+            return ListBuilder(self._freeze())
+
+        def graphBuilder(self):
+            from deeplearning4j_tpu.nn.graph import GraphBuilder
+            return GraphBuilder(self._freeze())
+
+        def _freeze(self) -> "NeuralNetConfiguration":
+            from deeplearning4j_tpu.train.updaters import Sgd
+            cfg = NeuralNetConfiguration()
+            cfg.seed = self._seed
+            cfg.updater = self._updater or Sgd(0.1)
+            cfg.weight_init = self._weight_init
+            cfg.activation = self._activation
+            cfg.l1 = self._l1
+            cfg.l2 = self._l2
+            cfg.grad_norm = self._grad_norm
+            cfg.grad_norm_threshold = self._grad_norm_threshold
+            cfg.dtype = self._dtype
+            return cfg
+
+    def __init__(self):
+        from deeplearning4j_tpu.train.updaters import Sgd
+        self.seed = 12345
+        self.updater = Sgd(0.1)
+        self.weight_init = "xavier"
+        self.activation = "identity"
+        self.l1 = 0.0
+        self.l2 = 0.0
+        self.grad_norm = None
+        self.grad_norm_threshold = 1.0
+        self.dtype = "float32"
+
+    def to_config(self):
+        return {"seed": self.seed, "updater": self.updater.to_config(),
+                "weight_init": self.weight_init, "activation": self.activation,
+                "l1": self.l1, "l2": self.l2, "grad_norm": self.grad_norm,
+                "grad_norm_threshold": self.grad_norm_threshold,
+                "dtype": self.dtype}
+
+    @staticmethod
+    def from_config(d):
+        from deeplearning4j_tpu.train.updaters import IUpdater
+        cfg = NeuralNetConfiguration()
+        cfg.__dict__.update({k: v for k, v in d.items() if k != "updater"})
+        cfg.updater = IUpdater.from_config(d["updater"])
+        return cfg
+
+
+class ListBuilder:
+    """Sequential-network builder (ref: NeuralNetConfiguration.ListBuilder)."""
+
+    def __init__(self, base: NeuralNetConfiguration):
+        self.base = base
+        self.layers: List[Any] = []
+        self.input_type: Optional[InputType] = None
+
+    def layer(self, *args):
+        """.layer(conf) or .layer(idx, conf)"""
+        conf = args[-1]
+        self.layers.append(conf)
+        return self
+
+    def setInputType(self, it: InputType):
+        self.input_type = it
+        return self
+
+    def inputType(self, it: InputType):
+        return self.setInputType(it)
+
+    def build(self) -> "MultiLayerConfiguration":
+        return MultiLayerConfiguration(self.base, list(self.layers), self.input_type)
+
+
+class MultiLayerConfiguration:
+    """ref: org.deeplearning4j.nn.conf.MultiLayerConfiguration — the built,
+    serializable model spec with propagated InputTypes."""
+
+    def __init__(self, base: NeuralNetConfiguration, layers: List[Any],
+                 input_type: Optional[InputType]):
+        self.base = base
+        self.layers = layers
+        self.input_type = input_type
+        self.preprocessors: Dict[int, Any] = {}
+        self.layer_input_types: List[InputType] = []
+        if input_type is not None:
+            self._propagate_input_types()
+
+    def _propagate_input_types(self):
+        """InputType propagation + automatic preprocessor insertion
+        (ref: MultiLayerConfiguration.Builder.setInputType →
+        getPreProcessorForInputType + layer.getOutputType)."""
+        from deeplearning4j_tpu.nn import preprocessors as pp
+        cur = self.input_type
+        self.preprocessors = {}
+        self.layer_input_types = []
+        for i, layer in enumerate(self.layers):
+            pre = pp.preprocessor_for(cur, layer)
+            if pre is not None:
+                self.preprocessors[i] = pre
+                cur = pre.output_type(cur)
+            layer.set_defaults(self.base)
+            layer.infer_nin(cur)
+            self.layer_input_types.append(cur)
+            cur = layer.output_type(cur)
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "base": self.base.to_config(),
+            "layers": [l.to_config() for l in self.layers],
+            "input_type": self.input_type.to_config() if self.input_type else None,
+        })
+
+    @staticmethod
+    def from_json(s: str) -> "MultiLayerConfiguration":
+        from deeplearning4j_tpu.nn import layers as L
+        d = json.loads(s)
+        base = NeuralNetConfiguration.from_config(d["base"])
+        layers = [L.layer_from_config(lc) for lc in d["layers"]]
+        it = InputType.from_config(d["input_type"]) if d["input_type"] else None
+        return MultiLayerConfiguration(base, layers, it)

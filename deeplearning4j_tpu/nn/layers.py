@@ -1,0 +1,896 @@
+"""Layer configurations + functional forward passes — the DL4J layer zoo.
+
+Reference parity: ``org.deeplearning4j.nn.conf.layers.*`` (configs) and
+``org.deeplearning4j.nn.layers.*`` (implementations) — SURVEY.md §2.2
+"DL4J layers". Weight layouts match the reference: dense W [nIn, nOut],
+bias [nOut]; conv W [nOut, nIn, kH, kW]; recurrent input W [nIn, 4H].
+Recurrent data layout is the reference's [N, channels, T] (NCW).
+
+TPU-native: NO hand-written ``backpropGradient`` anywhere — each layer is
+a pure ``apply(params, state, x, train, key)`` traced into the network's
+single compiled step; autodiff is program-level (SURVEY.md §7 item 4).
+Layer-level ``dropout`` follows the reference's semantics: the value is
+the RETAIN probability, applied to the layer's input.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.autodiff.samediff import _initialize
+from deeplearning4j_tpu.nn.config import InputType
+from deeplearning4j_tpu.ops import activations as act
+from deeplearning4j_tpu.ops import convolution as conv_ops
+from deeplearning4j_tpu.ops import losses as loss_ops
+from deeplearning4j_tpu.ops import normalization as norm_ops
+from deeplearning4j_tpu.ops import recurrent as rnn_ops
+
+
+def _pair(v):
+    if isinstance(v, (tuple, list)):
+        return tuple(int(x) for x in v)
+    return (int(v), int(v))
+
+
+class Layer:
+    """Base layer config. Subclasses define params + forward."""
+
+    input_kind: Optional[str] = "ff"
+    has_params = True
+
+    def __init__(self, nOut: int = None, nIn: int = None, activation: str = None,
+                 weightInit: str = None, biasInit: float = 0.0,
+                 dropOut: float = 0.0, l1: float = None, l2: float = None,
+                 name: str = None):
+        self.nOut = nOut
+        self.nIn = nIn
+        self.activation = activation
+        self.weight_init = weightInit
+        self.bias_init = biasInit
+        self.dropout = dropOut       # RETAIN probability (reference semantics)
+        self.l1 = l1
+        self.l2 = l2
+        self.name = name or type(self).__name__
+
+    # -- config plumbing --
+    def set_defaults(self, base):
+        if self.activation is None:
+            self.activation = base.activation
+        if self.weight_init is None:
+            self.weight_init = base.weight_init
+        if self.l1 is None:
+            self.l1 = base.l1
+        if self.l2 is None:
+            self.l2 = base.l2
+
+    def infer_nin(self, it: InputType):
+        if self.nIn is None and it.kind in ("ff", "cnn_flat"):
+            self.nIn = it.arrayElementsPerExample()
+        elif self.nIn is None and it.kind == "cnn":
+            self.nIn = it.channels
+        elif self.nIn is None and it.kind == "rnn":
+            self.nIn = it.size
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.feedForward(self.nOut)
+
+    # -- runtime --
+    def initialize(self, key) -> Tuple[Dict, Dict]:
+        return {}, {}
+
+    def apply(self, params, state, x, train: bool, key):
+        raise NotImplementedError
+
+    def _maybe_dropout(self, x, train, key):
+        if self.dropout and self.dropout < 1.0:
+            return norm_ops.dropout(x, 1.0 - self.dropout, key, train=train)
+        return x
+
+    def n_params(self, params) -> int:
+        return sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params))
+
+    # -- serialization --
+    def to_config(self):
+        d = {"@class": type(self).__name__}
+        for k, v in self.__dict__.items():
+            if isinstance(v, tuple):
+                v = list(v)
+            d[k] = v
+        return d
+
+    @classmethod
+    def from_config(cls, d):
+        obj = cls.__new__(cls)
+        for k, v in d.items():
+            if k == "@class":
+                continue
+            if isinstance(v, list) and k in ("kernel", "stride", "padding",
+                                             "dilation", "scale", "crop"):
+                v = tuple(v)
+            setattr(obj, k, v)
+        return obj
+
+    def __repr__(self):
+        return f"{type(self).__name__}(nIn={self.nIn}, nOut={self.nOut})"
+
+
+class DenseLayer(Layer):
+    """ref: layers.feedforward.dense.DenseLayer — W [nIn, nOut], out = act(xW + b)."""
+
+    def __init__(self, nOut=None, hasBias: bool = True, **kw):
+        super().__init__(nOut=nOut, **kw)
+        self.has_bias = hasBias
+
+    def initialize(self, key):
+        params = {"W": _initialize((self.nIn, self.nOut), self.weight_init, key)}
+        if self.has_bias:
+            params["b"] = jnp.full((self.nOut,), self.bias_init, jnp.float32)
+        return params, {}
+
+    def apply(self, params, state, x, train, key):
+        x = self._maybe_dropout(x, train, key)
+        z = x @ params["W"]
+        if self.has_bias:
+            z = z + params["b"]
+        return act.get(self.activation)(z), state
+
+
+class EmbeddingLayer(Layer):
+    """ref: layers.feedforward.embedding.EmbeddingLayer — int indices [N] or
+    one-hot rows -> embedding vectors [N, nOut]."""
+
+    def __init__(self, nOut=None, hasBias: bool = False, **kw):
+        super().__init__(nOut=nOut, **kw)
+        self.has_bias = hasBias
+
+    def initialize(self, key):
+        params = {"W": _initialize((self.nIn, self.nOut), self.weight_init, key)}
+        if self.has_bias:
+            params["b"] = jnp.full((self.nOut,), self.bias_init, jnp.float32)
+        return params, {}
+
+    def apply(self, params, state, x, train, key):
+        if jnp.issubdtype(x.dtype, jnp.floating) and x.ndim == 2 and x.shape[1] == self.nIn:
+            out = x @ params["W"]  # one-hot rows
+        else:
+            idx = x.astype(jnp.int32)
+            if idx.ndim == 2 and idx.shape[1] == 1:
+                idx = idx[:, 0]
+            out = jnp.take(params["W"], idx, axis=0)
+        if self.has_bias:
+            out = out + params["b"]
+        return act.get(self.activation)(out), state
+
+
+class EmbeddingSequenceLayer(Layer):
+    """ref: EmbeddingSequenceLayer — [N, T] int -> [N, nOut, T] (NCW)."""
+
+    input_kind = None
+
+    def initialize(self, key):
+        return {"W": _initialize((self.nIn, self.nOut), self.weight_init, key)}, {}
+
+    def apply(self, params, state, x, train, key):
+        idx = x.astype(jnp.int32)
+        if idx.ndim == 3:  # [N, 1, T]
+            idx = idx[:, 0, :]
+        emb = jnp.take(params["W"], idx, axis=0)  # [N, T, nOut]
+        return jnp.transpose(emb, (0, 2, 1)), state
+
+    def output_type(self, it: InputType) -> InputType:
+        t = it.dims.get("timesteps", -1) if it.kind == "rnn" else it.dims.get("size", -1)
+        return InputType.recurrent(self.nOut, t)
+
+
+class ConvolutionLayer(Layer):
+    """ref: layers.convolution.ConvolutionLayer — NCHW, W [nOut, nIn, kH, kW]."""
+
+    input_kind = "cnn"
+
+    def __init__(self, kernelSize=(3, 3), stride=(1, 1), padding=(0, 0),
+                 nOut=None, dilation=(1, 1), convolutionMode: str = "truncate",
+                 hasBias: bool = True, **kw):
+        super().__init__(nOut=nOut, **kw)
+        self.kernel = _pair(kernelSize)
+        self.stride = _pair(stride)
+        self.padding = _pair(padding)
+        self.dilation = _pair(dilation)
+        self.mode = convolutionMode
+        self.has_bias = hasBias
+
+    class Builder:
+        def __init__(self, *kernel):
+            self._kw = {"kernelSize": kernel if kernel else (3, 3)}
+
+        def nIn(self, v): self._kw["nIn"] = v; return self
+        def nOut(self, v): self._kw["nOut"] = v; return self
+        def stride(self, *s): self._kw["stride"] = s; return self
+        def padding(self, *p): self._kw["padding"] = p; return self
+        def activation(self, a): self._kw["activation"] = a; return self
+        def convolutionMode(self, m): self._kw["convolutionMode"] = m; return self
+        def weightInit(self, w): self._kw["weightInit"] = w; return self
+        def name(self, n): self._kw["name"] = n; return self
+        def build(self): return ConvolutionLayer(**self._kw)
+
+    def initialize(self, key):
+        shape = (self.nOut, self.nIn) + self.kernel
+        params = {"W": _initialize(shape, self.weight_init, key)}
+        if self.has_bias:
+            params["b"] = jnp.full((self.nOut,), self.bias_init, jnp.float32)
+        return params, {}
+
+    def apply(self, params, state, x, train, key):
+        x = self._maybe_dropout(x, train, key)
+        out = conv_ops.conv2d(x, params["W"], params.get("b"),
+                              stride=self.stride, pad=self.padding,
+                              dilation=self.dilation, mode=self.mode)
+        return act.get(self.activation)(out), state
+
+    def output_type(self, it: InputType) -> InputType:
+        h = conv_ops.conv_output_size(it.height, self.kernel[0], self.stride[0],
+                                      self.padding[0], self.dilation[0], self.mode)
+        w = conv_ops.conv_output_size(it.width, self.kernel[1], self.stride[1],
+                                      self.padding[1], self.dilation[1], self.mode)
+        return InputType.convolutional(h, w, self.nOut)
+
+
+class Deconvolution2D(ConvolutionLayer):
+    """ref: layers.convolution.Deconvolution2DLayer."""
+
+    def apply(self, params, state, x, train, key):
+        out = conv_ops.deconv2d(x, params["W"], params.get("b"),
+                                stride=self.stride, pad=self.padding, mode=self.mode)
+        return act.get(self.activation)(out), state
+
+    def output_type(self, it: InputType) -> InputType:
+        if self.mode.lower() == "same":
+            h, w = it.height * self.stride[0], it.width * self.stride[1]
+        else:
+            h = (it.height - 1) * self.stride[0] + self.kernel[0] - 2 * self.padding[0]
+            w = (it.width - 1) * self.stride[1] + self.kernel[1] - 2 * self.padding[1]
+        return InputType.convolutional(h, w, self.nOut)
+
+
+class DepthwiseConvolution2D(ConvolutionLayer):
+    """ref: DepthwiseConvolution2DLayer — W [mult, nIn, kH, kW]."""
+
+    def __init__(self, depthMultiplier: int = 1, **kw):
+        super().__init__(**kw)
+        self.depth_multiplier = depthMultiplier
+
+    def infer_nin(self, it):
+        super().infer_nin(it)
+        if self.nOut is None:
+            self.nOut = self.nIn * self.depth_multiplier
+
+    def initialize(self, key):
+        shape = (self.depth_multiplier, self.nIn) + self.kernel
+        params = {"W": _initialize(shape, self.weight_init, key)}
+        if self.has_bias:
+            params["b"] = jnp.full((self.nOut,), self.bias_init, jnp.float32)
+        return params, {}
+
+    def apply(self, params, state, x, train, key):
+        out = conv_ops.depthwise_conv2d(x, params["W"], params.get("b"),
+                                        stride=self.stride, pad=self.padding,
+                                        dilation=self.dilation, mode=self.mode)
+        return act.get(self.activation)(out), state
+
+
+class SeparableConvolution2D(ConvolutionLayer):
+    """ref: SeparableConvolution2DLayer — depthwise + pointwise."""
+
+    def __init__(self, depthMultiplier: int = 1, **kw):
+        super().__init__(**kw)
+        self.depth_multiplier = depthMultiplier
+
+    def initialize(self, key):
+        k1, k2 = jax.random.split(key)
+        params = {
+            "Wd": _initialize((self.depth_multiplier, self.nIn) + self.kernel,
+                              self.weight_init, k1),
+            "Wp": _initialize((self.nOut, self.nIn * self.depth_multiplier, 1, 1),
+                              self.weight_init, k2),
+        }
+        if self.has_bias:
+            params["b"] = jnp.full((self.nOut,), self.bias_init, jnp.float32)
+        return params, {}
+
+    def apply(self, params, state, x, train, key):
+        out = conv_ops.separable_conv2d(x, params["Wd"], params["Wp"],
+                                        params.get("b"), stride=self.stride,
+                                        pad=self.padding, dilation=self.dilation,
+                                        mode=self.mode)
+        return act.get(self.activation)(out), state
+
+
+class SubsamplingLayer(Layer):
+    """ref: layers.subsampling.SubsamplingLayer (max/avg/pnorm pooling)."""
+
+    input_kind = "cnn"
+    has_params = False
+
+    def __init__(self, poolingType: str = "max", kernelSize=(2, 2), stride=(2, 2),
+                 padding=(0, 0), convolutionMode: str = "truncate", pnorm: int = 2, **kw):
+        super().__init__(**kw)
+        self.pooling = poolingType.lower()
+        self.kernel = _pair(kernelSize)
+        self.stride = _pair(stride)
+        self.padding = _pair(padding)
+        self.mode = convolutionMode
+        self.pnorm = pnorm
+
+    class Builder:
+        def __init__(self, poolingType="max", *kernel):
+            self._kw = {"poolingType": poolingType}
+            if kernel:
+                self._kw["kernelSize"] = kernel
+
+        def kernelSize(self, *k): self._kw["kernelSize"] = k; return self
+        def stride(self, *s): self._kw["stride"] = s; return self
+        def padding(self, *p): self._kw["padding"] = p; return self
+        def build(self): return SubsamplingLayer(**self._kw)
+
+    def infer_nin(self, it):
+        self.nIn = self.nOut = it.channels
+
+    def apply(self, params, state, x, train, key):
+        fn = {"max": conv_ops.maxpool2d, "avg": conv_ops.avgpool2d,
+              "pnorm": conv_ops.pnormpool2d}[self.pooling]
+        kw = {"kernel": self.kernel, "stride": self.stride, "pad": self.padding,
+              "mode": self.mode}
+        if self.pooling == "pnorm":
+            kw["pnorm"] = self.pnorm
+        return fn(x, **kw), state
+
+    def output_type(self, it: InputType) -> InputType:
+        h = conv_ops.conv_output_size(it.height, self.kernel[0], self.stride[0],
+                                      self.padding[0], 1, self.mode)
+        w = conv_ops.conv_output_size(it.width, self.kernel[1], self.stride[1],
+                                      self.padding[1], 1, self.mode)
+        return InputType.convolutional(h, w, it.channels)
+
+
+class BatchNormalization(Layer):
+    """ref: layers.normalization.BatchNormalization — running stats carried
+    functionally in layer state (decay default 0.9 like the reference)."""
+
+    input_kind = None
+    has_params = True
+
+    def __init__(self, decay: float = 0.9, eps: float = 1e-5, **kw):
+        super().__init__(**kw)
+        self.decay = decay
+        self.eps = eps
+
+    def infer_nin(self, it: InputType):
+        if it.kind == "cnn":
+            self.nIn = self.nOut = it.channels
+        else:
+            self.nIn = self.nOut = it.arrayElementsPerExample()
+
+    def initialize(self, key):
+        n = self.nIn
+        params = {"gamma": jnp.ones((n,)), "beta": jnp.zeros((n,))}
+        state = {"mean": jnp.zeros((n,)), "var": jnp.ones((n,))}
+        return params, state
+
+    def apply(self, params, state, x, train, key):
+        axis = 1 if x.ndim >= 3 else -1
+        if train:
+            out, new_mean, new_var = norm_ops.batch_norm_train(
+                x, params["gamma"], params["beta"], state["mean"], state["var"],
+                eps=self.eps, decay=self.decay, axis=axis if axis != -1 else x.ndim - 1)
+            return out, {"mean": new_mean, "var": new_var}
+        out = norm_ops.batch_norm(x, params["gamma"], params["beta"],
+                                  state["mean"], state["var"], eps=self.eps,
+                                  axis=axis if axis != -1 else x.ndim - 1)
+        return out, state
+
+    def output_type(self, it: InputType) -> InputType:
+        return it
+
+
+class LocalResponseNormalization(Layer):
+    """ref: layers.normalization.LocalResponseNormalization."""
+
+    input_kind = "cnn"
+    has_params = False
+
+    def __init__(self, n: int = 5, alpha: float = 1e-4, beta: float = 0.75,
+                 k: float = 2.0, **kw):
+        super().__init__(**kw)
+        self.n = n
+        self.alpha = alpha
+        self.beta = beta
+        self.k = k
+
+    def infer_nin(self, it):
+        self.nIn = self.nOut = it.channels
+
+    def apply(self, params, state, x, train, key):
+        return norm_ops.lrn(x, depth=self.n, alpha=self.alpha, beta=self.beta,
+                            bias=self.k), state
+
+    def output_type(self, it):
+        return it
+
+
+class ActivationLayer(Layer):
+    """ref: layers.ActivationLayer."""
+
+    input_kind = None
+    has_params = False
+
+    def __init__(self, activation="relu", **kw):
+        super().__init__(activation=activation, **kw)
+
+    def set_defaults(self, base):
+        pass  # keeps its own activation
+
+    def infer_nin(self, it):
+        self.nIn = self.nOut = it.arrayElementsPerExample()
+
+    def apply(self, params, state, x, train, key):
+        return act.get(self.activation)(x), state
+
+    def output_type(self, it):
+        return it
+
+
+class DropoutLayer(Layer):
+    """ref: layers.DropoutLayer — dropOut value is the RETAIN probability."""
+
+    input_kind = None
+    has_params = False
+
+    def __init__(self, dropOut=0.5, **kw):
+        super().__init__(dropOut=dropOut, **kw)
+
+    def infer_nin(self, it):
+        self.nIn = self.nOut = it.arrayElementsPerExample()
+
+    def apply(self, params, state, x, train, key):
+        return self._maybe_dropout(x, train, key), state
+
+    def output_type(self, it):
+        return it
+
+
+class ZeroPaddingLayer(Layer):
+    """ref: layers.ZeroPaddingLayer."""
+
+    input_kind = "cnn"
+    has_params = False
+
+    def __init__(self, padding=(1, 1), **kw):
+        super().__init__(**kw)
+        self.pad = _pair(padding) if isinstance(padding, (int,)) or len(padding) == 2 \
+            else tuple(padding)
+
+    def infer_nin(self, it):
+        self.nIn = self.nOut = it.channels
+
+    def apply(self, params, state, x, train, key):
+        return conv_ops.zero_padding2d(x, self.pad), state
+
+    def output_type(self, it):
+        p = self.pad
+        if isinstance(p[0], int):
+            return InputType.convolutional(it.height + 2 * p[0], it.width + 2 * p[1],
+                                           it.channels)
+        return InputType.convolutional(it.height + sum(p[0]), it.width + sum(p[1]),
+                                       it.channels)
+
+
+class Upsampling2D(Layer):
+    """ref: layers.Upsampling2D."""
+
+    input_kind = "cnn"
+    has_params = False
+
+    def __init__(self, size=2, **kw):
+        super().__init__(**kw)
+        self.scale = _pair(size)
+
+    def infer_nin(self, it):
+        self.nIn = self.nOut = it.channels
+
+    def apply(self, params, state, x, train, key):
+        return conv_ops.upsampling2d(x, self.scale), state
+
+    def output_type(self, it):
+        return InputType.convolutional(it.height * self.scale[0],
+                                       it.width * self.scale[1], it.channels)
+
+
+class Cropping2D(Layer):
+    """ref: layers.convolutional.Cropping2D."""
+
+    input_kind = "cnn"
+    has_params = False
+
+    def __init__(self, crop=(1, 1), **kw):
+        super().__init__(**kw)
+        self.crop = tuple(crop)
+
+    def infer_nin(self, it):
+        self.nIn = self.nOut = it.channels
+
+    def apply(self, params, state, x, train, key):
+        return conv_ops.cropping2d(x, self.crop), state
+
+    def output_type(self, it):
+        c = self.crop
+        if isinstance(c[0], int):
+            return InputType.convolutional(it.height - 2 * c[0], it.width - 2 * c[1],
+                                           it.channels)
+        return InputType.convolutional(it.height - sum(c[0]), it.width - sum(c[1]),
+                                       it.channels)
+
+
+class GlobalPoolingLayer(Layer):
+    """ref: layers.pooling.GlobalPoolingLayer — cnn [N,C,H,W] -> [N,C] or
+    rnn [N,C,T] -> [N,C]; supports masks for rnn input."""
+
+    input_kind = None
+    has_params = False
+
+    def __init__(self, poolingType: str = "max", **kw):
+        super().__init__(**kw)
+        self.pooling = poolingType.lower()
+
+    def infer_nin(self, it):
+        self.nIn = self.nOut = it.channels if it.kind == "cnn" else it.size \
+            if it.kind == "rnn" else it.arrayElementsPerExample()
+
+    def apply(self, params, state, x, train, key, mask=None):
+        return conv_ops.global_pool(x, self.pooling, data_format="NCHW",
+                                    mask=mask), state
+
+    def output_type(self, it):
+        n = it.channels if it.kind == "cnn" else it.size
+        return InputType.feedForward(n)
+
+
+# ------------------------------------------------------------------ recurrent
+class LSTM(Layer):
+    """ref: layers.recurrent.LSTM — input [N, nIn, T] -> [N, nOut, T].
+    Forget-gate bias initialized to 1.0 like the reference."""
+
+    input_kind = "rnn"
+
+    def __init__(self, nOut=None, forgetGateBiasInit: float = 1.0, **kw):
+        super().__init__(nOut=nOut, **kw)
+        self.forget_bias = forgetGateBiasInit
+        if self.activation is None:
+            self.activation = "tanh"
+
+    def set_defaults(self, base):
+        super().set_defaults(base)
+        if self.activation == "identity":
+            self.activation = "tanh"
+
+    def initialize(self, key):
+        k1, k2 = jax.random.split(key)
+        H = self.nOut
+        b = np.zeros((4 * H,), np.float32)
+        b[H:2 * H] = self.forget_bias  # gate order [i, f, g, o]
+        params = {
+            "W": _initialize((self.nIn, 4 * H), self.weight_init, k1),
+            "RW": _initialize((H, 4 * H), self.weight_init, k2),
+            "b": jnp.asarray(b),
+        }
+        return params, {}
+
+    def apply(self, params, state, x, train, key, mask=None):
+        x_tnc = jnp.transpose(x, (2, 0, 1))  # [N,C,T] -> [T,N,C]
+        mask_tn = jnp.transpose(mask, (1, 0)) if mask is not None else None
+        outs, _ = rnn_ops.lstm(x_tnc, params["W"], params["RW"], params["b"],
+                               mask_tn=mask_tn)
+        return jnp.transpose(outs, (1, 2, 0)), state  # [T,N,H] -> [N,H,T]
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.recurrent(self.nOut, it.dims.get("timesteps", -1))
+
+
+class GravesLSTM(LSTM):
+    """ref: layers.recurrent.GravesLSTM (legacy peephole variant; the
+    peephole connections are omitted — reference deprecated it in favor of
+    LSTM, and their effect is negligible; kept for API parity)."""
+
+
+class SimpleRnn(Layer):
+    """ref: layers.recurrent.SimpleRnn."""
+
+    input_kind = "rnn"
+
+    def __init__(self, nOut=None, **kw):
+        super().__init__(nOut=nOut, **kw)
+        if self.activation is None:
+            self.activation = "tanh"
+
+    def set_defaults(self, base):
+        super().set_defaults(base)
+        if self.activation == "identity":
+            self.activation = "tanh"
+
+    def initialize(self, key):
+        k1, k2 = jax.random.split(key)
+        params = {
+            "W": _initialize((self.nIn, self.nOut), self.weight_init, k1),
+            "RW": _initialize((self.nOut, self.nOut), self.weight_init, k2),
+            "b": jnp.zeros((self.nOut,)),
+        }
+        return params, {}
+
+    def apply(self, params, state, x, train, key, mask=None):
+        x_tnc = jnp.transpose(x, (2, 0, 1))
+        mask_tn = jnp.transpose(mask, (1, 0)) if mask is not None else None
+        outs, _ = rnn_ops.simple_rnn(x_tnc, params["W"], params["RW"], params["b"],
+                                     mask_tn=mask_tn,
+                                     activation=act.get(self.activation))
+        return jnp.transpose(outs, (1, 2, 0)), state
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.recurrent(self.nOut, it.dims.get("timesteps", -1))
+
+
+class Bidirectional(Layer):
+    """ref: layers.recurrent.Bidirectional — wraps a recurrent layer,
+    merge modes CONCAT/ADD/MUL/AVERAGE."""
+
+    input_kind = "rnn"
+
+    def __init__(self, rnn_layer: Layer, mode: str = "concat", **kw):
+        super().__init__(**kw)
+        self.fwd = rnn_layer
+        import copy
+        self.bwd = copy.deepcopy(rnn_layer)
+        self.mode = mode.lower()
+
+    def set_defaults(self, base):
+        self.fwd.set_defaults(base)
+        self.bwd.set_defaults(base)
+
+    def infer_nin(self, it):
+        self.fwd.infer_nin(it)
+        self.bwd.infer_nin(it)
+        self.nIn = self.fwd.nIn
+        self.nOut = self.fwd.nOut * (2 if self.mode == "concat" else 1)
+
+    def initialize(self, key):
+        k1, k2 = jax.random.split(key)
+        pf, _ = self.fwd.initialize(k1)
+        pb, _ = self.bwd.initialize(k2)
+        return {"fwd": pf, "bwd": pb}, {}
+
+    def apply(self, params, state, x, train, key, mask=None):
+        yf, _ = self.fwd.apply(params["fwd"], {}, x, train, key, mask=mask)
+        x_rev = jnp.flip(x, axis=2)
+        mask_rev = jnp.flip(mask, axis=1) if mask is not None else None
+        yb, _ = self.bwd.apply(params["bwd"], {}, x_rev, train, key, mask=mask_rev)
+        yb = jnp.flip(yb, axis=2)
+        if self.mode == "concat":
+            return jnp.concatenate([yf, yb], axis=1), state
+        if self.mode == "add":
+            return yf + yb, state
+        if self.mode == "mul":
+            return yf * yb, state
+        if self.mode == "average":
+            return 0.5 * (yf + yb), state
+        raise ValueError(self.mode)
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.recurrent(self.nOut, it.dims.get("timesteps", -1))
+
+    def to_config(self):
+        return {"@class": "Bidirectional", "mode": self.mode,
+                "fwd": self.fwd.to_config(), "name": self.name,
+                "nIn": self.nIn, "nOut": self.nOut}
+
+    @classmethod
+    def from_config(cls, d):
+        inner = layer_from_config(d["fwd"])
+        obj = Bidirectional(inner, mode=d["mode"])
+        obj.nIn, obj.nOut = d.get("nIn"), d.get("nOut")
+        return obj
+
+
+class LastTimeStep(Layer):
+    """ref: layers.recurrent.LastTimeStep — wraps an RNN layer, returns
+    its final (mask-aware) timestep as feedforward output."""
+
+    input_kind = "rnn"
+
+    def __init__(self, rnn_layer: Layer, **kw):
+        super().__init__(**kw)
+        self.inner = rnn_layer
+
+    def set_defaults(self, base):
+        self.inner.set_defaults(base)
+
+    def infer_nin(self, it):
+        self.inner.infer_nin(it)
+        self.nIn, self.nOut = self.inner.nIn, self.inner.nOut
+
+    def initialize(self, key):
+        return self.inner.initialize(key)
+
+    def apply(self, params, state, x, train, key, mask=None):
+        y, state = self.inner.apply(params, state, x, train, key, mask=mask)
+        if mask is not None:
+            # index of last active timestep per example
+            idx = jnp.maximum(jnp.sum(mask > 0, axis=1).astype(jnp.int32) - 1, 0)
+            return y[jnp.arange(y.shape[0]), :, idx], state
+        return y[:, :, -1], state
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.feedForward(self.inner.nOut)
+
+    def to_config(self):
+        return {"@class": "LastTimeStep", "inner": self.inner.to_config(),
+                "name": self.name, "nIn": self.nIn, "nOut": self.nOut}
+
+    @classmethod
+    def from_config(cls, d):
+        obj = LastTimeStep(layer_from_config(d["inner"]))
+        obj.nIn, obj.nOut = d.get("nIn"), d.get("nOut")
+        return obj
+
+
+# ------------------------------------------------------------------- outputs
+class BaseOutputLayer(Layer):
+    """Common loss plumbing (ref: BaseOutputLayer)."""
+
+    def __init__(self, lossFunction: str = "mcxent", **kw):
+        super().__init__(**kw)
+        self.loss_fn = lossFunction
+
+    def compute_loss(self, labels, preds, mask=None):
+        # the stable fused path when activation is softmax/sigmoid + matching loss
+        return loss_ops.get(self.loss_fn)(labels, preds, mask=mask)
+
+
+class OutputLayer(BaseOutputLayer):
+    """ref: layers.OutputLayer — dense + activation + loss."""
+
+    def __init__(self, nOut=None, lossFunction="mcxent", hasBias: bool = True, **kw):
+        super().__init__(lossFunction=lossFunction, nOut=nOut, **kw)
+        self.has_bias = hasBias
+        if self.activation is None:
+            self.activation = "softmax"
+
+    class Builder:
+        def __init__(self, lossFunction="mcxent"):
+            self._kw = {"lossFunction": lossFunction}
+
+        def nIn(self, v): self._kw["nIn"] = v; return self
+        def nOut(self, v): self._kw["nOut"] = v; return self
+        def activation(self, a): self._kw["activation"] = a; return self
+        def build(self): return OutputLayer(**self._kw)
+
+    def set_defaults(self, base):
+        super().set_defaults(base)
+        if self.activation == "identity":
+            self.activation = "softmax"
+
+    def initialize(self, key):
+        params = {"W": _initialize((self.nIn, self.nOut), self.weight_init, key)}
+        if self.has_bias:
+            params["b"] = jnp.full((self.nOut,), self.bias_init, jnp.float32)
+        return params, {}
+
+    def apply(self, params, state, x, train, key):
+        x = self._maybe_dropout(x, train, key)
+        z = x @ params["W"]
+        if self.has_bias:
+            z = z + params["b"]
+        return act.get(self.activation)(z), state
+
+    def pre_activation(self, params, x):
+        z = x @ params["W"]
+        if self.has_bias:
+            z = z + params["b"]
+        return z
+
+
+class LossLayer(BaseOutputLayer):
+    """ref: layers.LossLayer — activation + loss, no params."""
+
+    has_params = False
+    input_kind = None
+
+    def __init__(self, lossFunction="mcxent", **kw):
+        super().__init__(lossFunction=lossFunction, **kw)
+        if self.activation is None:
+            self.activation = "identity"
+
+    def infer_nin(self, it):
+        self.nIn = self.nOut = it.arrayElementsPerExample()
+
+    def apply(self, params, state, x, train, key):
+        return act.get(self.activation)(x), state
+
+    def output_type(self, it):
+        return it
+
+
+class RnnOutputLayer(BaseOutputLayer):
+    """ref: layers.recurrent.RnnOutputLayer — per-timestep dense + loss.
+    Input [N, nIn, T] -> [N, nOut, T]."""
+
+    input_kind = "rnn"
+
+    def __init__(self, nOut=None, lossFunction="mcxent", **kw):
+        super().__init__(lossFunction=lossFunction, nOut=nOut, **kw)
+        if self.activation is None:
+            self.activation = "softmax"
+
+    def set_defaults(self, base):
+        super().set_defaults(base)
+        if self.activation == "identity":
+            self.activation = "softmax"
+
+    def initialize(self, key):
+        return {"W": _initialize((self.nIn, self.nOut), self.weight_init, key),
+                "b": jnp.zeros((self.nOut,))}, {}
+
+    def apply(self, params, state, x, train, key):
+        # [N, C, T]: per-timestep projection = einsum over C
+        z = jnp.einsum("nct,ch->nht", x, params["W"]) + params["b"][None, :, None]
+        a = act.get(self.activation)(z, axis=1) if self.activation in ("softmax", "logsoftmax") \
+            else act.get(self.activation)(z)
+        return a, state
+
+    def compute_loss(self, labels, preds, mask=None):
+        """labels/preds [N, C, T]; mask [N, T]. Flatten time into batch
+        (reference scores per-timestep)."""
+        lab = jnp.reshape(jnp.transpose(labels, (0, 2, 1)), (-1, labels.shape[1]))
+        pre = jnp.reshape(jnp.transpose(preds, (0, 2, 1)), (-1, preds.shape[1]))
+        m = jnp.reshape(mask, (-1,)) if mask is not None else None
+        return loss_ops.get(self.loss_fn)(lab, pre, mask=m)
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.recurrent(self.nOut, it.dims.get("timesteps", -1))
+
+
+class PReLULayer(Layer):
+    """ref: layers.feedforward.PReLULayer."""
+
+    input_kind = None
+
+    def infer_nin(self, it):
+        self.nIn = self.nOut = it.arrayElementsPerExample()
+
+    def initialize(self, key):
+        return {"alpha": jnp.full((self.nIn,), 0.25)}, {}
+
+    def apply(self, params, state, x, train, key):
+        a = params["alpha"]
+        if x.ndim == 4:  # NCHW: alpha per channel plane
+            a = a.reshape(1, -1, 1, 1) if a.size == x.shape[1] else a.reshape((1,) + x.shape[1:])
+        return jnp.where(x >= 0, x, a * x), state
+
+    def output_type(self, it):
+        return it
+
+
+_LAYER_CLASSES = {}
+for _cls in [DenseLayer, EmbeddingLayer, EmbeddingSequenceLayer, ConvolutionLayer,
+             Deconvolution2D, DepthwiseConvolution2D, SeparableConvolution2D,
+             SubsamplingLayer, BatchNormalization, LocalResponseNormalization,
+             ActivationLayer, DropoutLayer, ZeroPaddingLayer, Upsampling2D,
+             Cropping2D, GlobalPoolingLayer, LSTM, GravesLSTM, SimpleRnn,
+             Bidirectional, LastTimeStep, OutputLayer, LossLayer, RnnOutputLayer,
+             PReLULayer]:
+    _LAYER_CLASSES[_cls.__name__] = _cls
+
+
+def layer_from_config(d: Dict) -> Layer:
+    cls = _LAYER_CLASSES[d["@class"]]
+    return cls.from_config(d)

@@ -1,0 +1,334 @@
+"""MultiLayerNetwork — the sequential network and its training loop.
+
+Reference parity: ``org.deeplearning4j.nn.multilayer.MultiLayerNetwork``
+and the Solver/StochasticGradientDescent step driver + TrainingListener
+bus (SURVEY.md §2.2 "Networks", call stack §3.1).
+
+TPU-native: ``fit`` compiles ONE XLA program per batch signature doing
+forward + loss + backward + regularization + clipping + updater — the
+reference's hundreds of JNI crossings per step become one dispatch
+(SURVEY.md §3.1 "the TPU rebuild amortizes it to ~1 crossing per step").
+Params/updater-state are pytrees; there is also a ``params()`` view
+returning the reference's single flat contiguous parameter vector.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import zipfile
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet, DataSetIterator
+from deeplearning4j_tpu.evaluation.evaluation import Evaluation, RegressionEvaluation
+from deeplearning4j_tpu.nn import layers as L
+from deeplearning4j_tpu.nn.config import MultiLayerConfiguration
+from deeplearning4j_tpu.train import updaters as upd
+
+_MASK_AWARE = (L.LSTM, L.SimpleRnn, L.Bidirectional, L.LastTimeStep,
+               L.GlobalPoolingLayer)
+
+
+class MultiLayerNetwork:
+    """Sequential network (ref: MultiLayerNetwork)."""
+
+    def __init__(self, conf: MultiLayerConfiguration):
+        self.conf = conf
+        self.layers = conf.layers
+        self._params: List[Dict] = []
+        self._states: List[Dict] = []
+        self._opt_state = None
+        self._iteration = 0
+        self._epoch = 0
+        self._listeners: List[Any] = []
+        self._train_step_cache = {}
+        self._fwd_cache = None
+        self._score = float("nan")
+        self._initialized = False
+
+    # ------------------------------------------------------------------ init
+    def init(self, seed: int = None):
+        """Initialize parameters (ref: MultiLayerNetwork.init)."""
+        seed = self.conf.base.seed if seed is None else seed
+        key = jax.random.PRNGKey(seed)
+        self._params, self._states = [], []
+        for layer in self.layers:
+            key, sub = jax.random.split(key)
+            p, s = layer.initialize(sub)
+            self._params.append(p)
+            self._states.append(s)
+        self._opt_state = None
+        self._train_step_cache = {}
+        self._fwd_cache = None
+        self._initialized = True
+        return self
+
+    # --------------------------------------------------------------- forward
+    def _forward(self, params, states, x, train: bool, key, fmask=None):
+        new_states = []
+        for i, layer in enumerate(self.layers):
+            if i in self.conf.preprocessors:
+                x = self.conf.preprocessors[i](x)
+            key, sub = jax.random.split(key)
+            if isinstance(layer, _MASK_AWARE):
+                x, ns = layer.apply(params[i], states[i], x, train, sub, mask=fmask)
+            else:
+                x, ns = layer.apply(params[i], states[i], x, train, sub)
+            new_states.append(ns)
+        return x, new_states
+
+    def feedForward(self, x, train: bool = False):
+        """All layer activations (ref: feedForward returns list)."""
+        x = jnp.asarray(x)
+        acts = [x]
+        key = jax.random.PRNGKey(0)
+        cur = x
+        for i, layer in enumerate(self.layers):
+            if i in self.conf.preprocessors:
+                cur = self.conf.preprocessors[i](cur)
+            key, sub = jax.random.split(key)
+            if isinstance(layer, _MASK_AWARE):
+                cur, _ = layer.apply(self._params[i], self._states[i], cur, train, sub, mask=None)
+            else:
+                cur, _ = layer.apply(self._params[i], self._states[i], cur, train, sub)
+            acts.append(cur)
+        return acts
+
+    def output(self, x, train: bool = False):
+        """Inference forward (ref: MultiLayerNetwork.output)."""
+        out, _ = self._jit_forward()(self._params, self._states, jnp.asarray(x),
+                                     jax.random.PRNGKey(0))
+        return out
+
+    def _jit_forward(self):
+        if self._fwd_cache is None:
+            def fwd(params, states, x, key):
+                return self._forward(params, states, x, False, key)
+            self._fwd_cache = jax.jit(fwd)
+        return self._fwd_cache
+
+    # ------------------------------------------------------------------ loss
+    def _loss_and_reg(self, params, states, x, y, train, key, fmask, lmask):
+        out, new_states = self._forward(params, states, x, train, key, fmask)
+        out_layer = self.layers[-1]
+        if not isinstance(out_layer, L.BaseOutputLayer):
+            raise ValueError("last layer must be an output/loss layer for fit()")
+        loss = out_layer.compute_loss(y, out, mask=lmask)
+        reg = 0.0
+        for layer, p in zip(self.layers, params):
+            l1 = layer.l1 or 0.0
+            l2 = layer.l2 or 0.0
+            if not p or (l1 == 0.0 and l2 == 0.0):
+                continue
+            for name, w in p.items():
+                if not name.startswith(("W", "RW")):
+                    continue  # reference: regularization applies to weights only
+                if l2:
+                    reg = reg + 0.5 * l2 * jnp.sum(jnp.square(w))
+                if l1:
+                    reg = reg + l1 * jnp.sum(jnp.abs(w))
+        return loss + reg, new_states
+
+    # ------------------------------------------------------------------- fit
+    def _make_train_step(self, with_fmask: bool, with_lmask: bool):
+        base = self.conf.base
+        updater = base.updater
+
+        def step(params, states, opt_state, t, x, y, fmask, lmask, key):
+            def loss_fn(p):
+                return self._loss_and_reg(p, states, x, y, True, key,
+                                          fmask if with_fmask else None,
+                                          lmask if with_lmask else None)
+            (loss, new_states), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            if base.grad_norm == "clip_value":
+                grads = upd.clip_by_value(grads, base.grad_norm_threshold)
+            elif base.grad_norm == "clip_l2":
+                grads = upd.clip_by_norm(grads, base.grad_norm_threshold)
+            elif base.grad_norm == "clip_global":
+                grads = upd.clip_by_global_norm(grads, base.grad_norm_threshold)
+            elif base.grad_norm == "renorm":
+                grads = upd.renormalize_l2(grads)
+            lr = updater.lr_at(t)
+            p_leaves, treedef = jax.tree_util.tree_flatten(params)
+            g_leaves = treedef.flatten_up_to(grads)
+            s_leaves = treedef.flatten_up_to(opt_state)
+            new_p, new_s = [], []
+            for pv, gv, sv in zip(p_leaves, g_leaves, s_leaves):
+                u, s2 = updater.apply(gv, sv, lr, t)
+                if isinstance(updater, upd.AdamW) and updater.weight_decay:
+                    u = u + updater.weight_decay_update(pv, lr)
+                new_p.append(pv - u)
+                new_s.append(s2)
+            return (jax.tree_util.tree_unflatten(treedef, new_p), new_states,
+                    jax.tree_util.tree_unflatten(treedef, new_s), loss)
+        return jax.jit(step)
+
+    def _ensure_opt_state(self):
+        if self._opt_state is None:
+            updater = self.conf.base.updater
+            self._opt_state = jax.tree_util.tree_map(
+                lambda p: updater.init_state(p), self._params,
+                is_leaf=lambda x: isinstance(x, jax.Array))
+
+    def fit(self, data, labels=None, epochs: int = 1):
+        """ref: MultiLayerNetwork.fit(DataSetIterator) — accepts an
+        iterator, a DataSet, or (features, labels) arrays."""
+        if not self._initialized:
+            self.init()
+        self._ensure_opt_state()
+
+        def batches():
+            if isinstance(data, DataSetIterator):
+                data.reset()
+                while data.hasNext():
+                    yield data.next()
+            elif isinstance(data, DataSet):
+                yield data
+            elif isinstance(data, (list, tuple)) and data and isinstance(data[0], DataSet):
+                yield from data
+            else:
+                yield DataSet(np.asarray(data), np.asarray(labels))
+
+        for _ in range(epochs):
+            for ds in batches():
+                self._fit_one(ds)
+            self._epoch += 1
+            for lst in self._listeners:
+                if hasattr(lst, "onEpochEnd"):
+                    lst.onEpochEnd(self)
+        return self
+
+    def _fit_one(self, ds: DataSet):
+        x = jnp.asarray(ds.features)
+        y = jnp.asarray(ds.labels)
+        fmask = jnp.asarray(ds.features_mask) if ds.features_mask is not None else None
+        lmask = jnp.asarray(ds.labels_mask) if ds.labels_mask is not None else None
+        sig = (fmask is not None, lmask is not None)
+        if sig not in self._train_step_cache:
+            self._train_step_cache[sig] = self._make_train_step(*sig)
+        step = self._train_step_cache[sig]
+        key = jax.random.PRNGKey(self.conf.base.seed + self._iteration + 1)
+        dummy = jnp.zeros((1,))
+        self._params, self._states, self._opt_state, loss = step(
+            self._params, self._states, self._opt_state,
+            jnp.asarray(self._iteration, jnp.float32), x, y,
+            fmask if fmask is not None else dummy,
+            lmask if lmask is not None else dummy, key)
+        self._score = float(loss)
+        self._iteration += 1
+        for lst in self._listeners:
+            if hasattr(lst, "iterationDone"):
+                lst.iterationDone(self, self._iteration, self._epoch)
+
+    # ----------------------------------------------------------------- score
+    def score(self, ds: DataSet = None) -> float:
+        """Last minibatch score, or score of a given DataSet (ref: score())."""
+        if ds is None:
+            return self._score
+        loss, _ = self._loss_and_reg(
+            self._params, self._states, jnp.asarray(ds.features),
+            jnp.asarray(ds.labels), False, jax.random.PRNGKey(0),
+            jnp.asarray(ds.features_mask) if ds.features_mask is not None else None,
+            jnp.asarray(ds.labels_mask) if ds.labels_mask is not None else None)
+        return float(loss)
+
+    # ------------------------------------------------------------- evaluation
+    def evaluate(self, iterator: DataSetIterator, evaluation=None) -> Evaluation:
+        """ref: MultiLayerNetwork.evaluate(DataSetIterator)."""
+        ev = evaluation or Evaluation()
+        iterator.reset()
+        while iterator.hasNext():
+            ds = iterator.next()
+            preds = self.output(ds.features)
+            ev.eval(ds.labels, np.asarray(preds), mask=ds.labels_mask)
+        return ev
+
+    def evaluateRegression(self, iterator: DataSetIterator) -> RegressionEvaluation:
+        ev = RegressionEvaluation()
+        iterator.reset()
+        while iterator.hasNext():
+            ds = iterator.next()
+            preds = self.output(ds.features)
+            ev.eval(ds.labels, np.asarray(preds), mask=ds.labels_mask)
+        return ev
+
+    # ------------------------------------------------------------ param views
+    def params(self) -> jnp.ndarray:
+        """The reference's single flat contiguous param vector
+        (ref: MultiLayerNetwork.params())."""
+        leaves = jax.tree_util.tree_leaves(self._params)
+        if not leaves:
+            return jnp.zeros((0,))
+        return jnp.concatenate([jnp.ravel(p) for p in leaves])
+
+    def setParams(self, flat):
+        flat = jnp.asarray(flat)
+        leaves, treedef = jax.tree_util.tree_flatten(self._params)
+        out, pos = [], 0
+        for p in leaves:
+            n = int(np.prod(p.shape))
+            out.append(jnp.reshape(flat[pos:pos + n], p.shape).astype(p.dtype))
+            pos += n
+        self._params = jax.tree_util.tree_unflatten(treedef, out)
+
+    def numParams(self) -> int:
+        return sum(int(np.prod(p.shape))
+                   for p in jax.tree_util.tree_leaves(self._params))
+
+    def getLayer(self, i: int):
+        return self.layers[i]
+
+    def getParam(self, i: int, name: str):
+        return self._params[i][name]
+
+    def setListeners(self, *listeners):
+        self._listeners = list(listeners)
+
+    def addListeners(self, *listeners):
+        self._listeners.extend(listeners)
+
+    def getIterationCount(self):
+        return self._iteration
+
+    def getEpochCount(self):
+        return self._epoch
+
+    def summary(self) -> str:
+        lines = ["=" * 70,
+                 f"{'LayerName (Type)':<36}{'nIn,nOut':<16}{'Params':<10}",
+                 "=" * 70]
+        total = 0
+        for i, layer in enumerate(self.layers):
+            n = sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(self._params[i])) \
+                if self._initialized else 0
+            total += n
+            lines.append(f"{f'{i}_{layer.name} ({type(layer).__name__})':<36}"
+                         f"{f'{layer.nIn},{layer.nOut}':<16}{n:<10}")
+        lines.append("-" * 70)
+        lines.append(f"Total params: {total}")
+        lines.append("=" * 70)
+        return "\n".join(lines)
+
+    # ------------------------------------------------------------ save / load
+    def save(self, path: str, save_updater: bool = True):
+        """ref: ModelSerializer.writeModel — zip(config JSON, params,
+        updater state)."""
+        from deeplearning4j_tpu.train.serializer import ModelSerializer
+        ModelSerializer.writeModel(self, path, save_updater)
+
+    @staticmethod
+    def load(path: str, load_updater: bool = True) -> "MultiLayerNetwork":
+        from deeplearning4j_tpu.train.serializer import ModelSerializer
+        return ModelSerializer.restoreMultiLayerNetwork(path, load_updater)
+
+    def clone(self) -> "MultiLayerNetwork":
+        net = MultiLayerNetwork(self.conf)
+        net.init()
+        net._params = jax.tree_util.tree_map(lambda x: x, self._params)
+        net._states = jax.tree_util.tree_map(lambda x: x, self._states)
+        return net

@@ -1,0 +1,106 @@
+"""Input preprocessors — layout adapters inserted between layers.
+
+Reference parity: ``org.deeplearning4j.nn.conf.preprocessor.{
+FeedForwardToCnnPreProcessor, CnnToFeedForwardPreProcessor,
+RnnToFeedForwardPreProcessor, FeedForwardToRnnPreProcessor,
+RnnToCnnPreProcessor, CnnToRnnPreProcessor}`` and the automatic insertion
+logic in ``MultiLayerConfiguration.Builder.setInputType`` (SURVEY.md §2.2).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.config import InputType
+
+
+class Preprocessor:
+    def __call__(self, x):
+        raise NotImplementedError
+
+    def output_type(self, it: InputType) -> InputType:
+        raise NotImplementedError
+
+
+class FeedForwardToCnn(Preprocessor):
+    """[N, h*w*c] -> [N, c, h, w] (ref: FeedForwardToCnnPreProcessor).
+    The reference's flattened order is [c, h, w] row-major."""
+
+    def __init__(self, height, width, channels):
+        self.height, self.width, self.channels = height, width, channels
+
+    def __call__(self, x):
+        return jnp.reshape(x, (x.shape[0], self.channels, self.height, self.width))
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.convolutional(self.height, self.width, self.channels)
+
+
+class CnnToFeedForward(Preprocessor):
+    """[N, c, h, w] -> [N, c*h*w] (ref: CnnToFeedForwardPreProcessor)."""
+
+    def __call__(self, x):
+        return jnp.reshape(x, (x.shape[0], -1))
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.feedForward(it.channels * it.height * it.width)
+
+
+class RnnToFeedForward(Preprocessor):
+    """[N, size, T] -> [N*T, size] (ref: RnnToFeedForwardPreProcessor)."""
+
+    def __call__(self, x):
+        return jnp.reshape(jnp.transpose(x, (0, 2, 1)), (-1, x.shape[1]))
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.feedForward(it.size)
+
+
+class FeedForwardToRnn(Preprocessor):
+    """[N*T, size] -> [N, size, T] (ref: FeedForwardToRnnPreProcessor).
+    Needs the original timestep count, carried via config."""
+
+    def __init__(self, timesteps):
+        self.timesteps = timesteps
+
+    def __call__(self, x):
+        n = x.shape[0] // self.timesteps
+        return jnp.transpose(jnp.reshape(x, (n, self.timesteps, x.shape[1])), (0, 2, 1))
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.recurrent(it.size, self.timesteps)
+
+
+class CnnToRnn(Preprocessor):
+    """[N, c, h, w] -> [N, c*h, w-as-time] — rarely used; kept for parity
+    (ref: CnnToRnnPreProcessor)."""
+
+    def __call__(self, x):
+        n, c, h, w = x.shape
+        return jnp.reshape(x, (n, c * h, w))
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.recurrent(it.channels * it.height, it.width)
+
+
+def preprocessor_for(input_type: InputType, layer) -> Preprocessor | None:
+    """Automatic preprocessor choice (ref: each layer conf's
+    getPreProcessorForInputType)."""
+    need = getattr(layer, "input_kind", None)
+    if need is None or input_type.kind == need:
+        return None
+    if input_type.kind == "cnn_flat" and need == "cnn":
+        return FeedForwardToCnn(input_type.height, input_type.width,
+                                input_type.channels)
+    if input_type.kind == "cnn_flat" and need == "ff":
+        return None  # already flat rows
+    if input_type.kind == "cnn" and need == "ff":
+        return CnnToFeedForward()
+    if input_type.kind == "ff" and need == "cnn":
+        raise ValueError("feedForward input into a conv layer needs explicit "
+                         "InputType.convolutionalFlat(...)")
+    if input_type.kind == "rnn" and need == "ff":
+        return RnnToFeedForward()
+    if input_type.kind == "cnn" and need == "rnn":
+        return CnnToRnn()
+    return None

@@ -24,6 +24,7 @@ from typing import Sequence, Tuple, Union
 import jax
 import jax.numpy as jnp
 from jax import lax
+import numpy as np
 
 IntOrPair = Union[int, Sequence[int]]
 
@@ -200,21 +201,23 @@ def _pool(x, kind: str, kernel, stride, pad, mode, data_format, ndim, pnorm=2):
         padding = ("SAME" if mode.lower() == "same"
                    else [(0, 0)] + [(p, p) for p in pad] + [(0, 0)])
 
+    # NOTE: init values MUST be Python scalars — an array-valued init
+    # breaks reduce_window's reverse-mode autodiff rule under jit.
     if kind == "max":
-        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
-        return lax.reduce_window(x, jnp.asarray(init, x.dtype), lax.max, window, strides, padding)
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else int(jnp.iinfo(x.dtype).min)
+        return lax.reduce_window(x, init, lax.max, window, strides, padding)
     if kind == "avg":
-        summed = lax.reduce_window(x, jnp.asarray(0, x.dtype), lax.add, window, strides, padding)
+        summed = lax.reduce_window(x, 0.0, lax.add, window, strides, padding)
         if mode.lower() == "same" or any(pad):
             # divide by the actual window size (exclude padding) — matches the
             # reference's avgpool with padding excluded from the count
             ones = jnp.ones(x.shape, x.dtype)
-            counts = lax.reduce_window(ones, jnp.asarray(0, x.dtype), lax.add, window, strides, padding)
+            counts = lax.reduce_window(ones, 0.0, lax.add, window, strides, padding)
             return summed / counts
-        return summed / float(jnp.prod(jnp.asarray(kernel)))
+        return summed / float(np.prod(kernel))
     if kind == "pnorm":
         p = float(pnorm)
-        summed = lax.reduce_window(jnp.abs(x) ** p, jnp.asarray(0, x.dtype), lax.add,
+        summed = lax.reduce_window(jnp.abs(x) ** p, 0.0, lax.add,
                                    window, strides, padding)
         return summed ** (1.0 / p)
     raise ValueError(kind)

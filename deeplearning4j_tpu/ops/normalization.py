@@ -84,7 +84,7 @@ def lrn(x, *, depth: int = 5, alpha: float = 1e-4, beta: float = 0.75,
     padded = jnp.pad(sq, pad_cfg)
     window = [1] * x.ndim
     window[c_axis] = depth
-    summed = jax.lax.reduce_window(padded, jnp.asarray(0, x.dtype), jax.lax.add,
+    summed = jax.lax.reduce_window(padded, 0.0, jax.lax.add,
                                    tuple(window), (1,) * x.ndim,
                                    [(0, 0)] * x.ndim)
     return x / (bias + alpha * summed) ** beta

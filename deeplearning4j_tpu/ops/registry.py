@@ -352,11 +352,17 @@ register("space_to_depth", _conv.space_to_depth)
 register("depth_to_space", _conv.depth_to_space)
 register("im2col", _conv.im2col)
 register("batchnorm", _norm.batch_norm)
+# SD-node variant with the SameDiff arg order (x, mean, var, gamma, beta) —
+# lets the graph engine record/serialize batchNorm without a closure wrapper
+register("batchnorm_sd", lambda x, m, v, g, b, eps=1e-5, axis=1:
+         _norm.batch_norm(x, g, b, m, v, eps=eps, axis=axis))
 register("layer_norm", _norm.layer_norm)
 register("rms_norm", _norm.rms_norm)
 register("lrn", _norm.lrn)
 register("dropout", _norm.dropout)
 register("lstmLayer", _rnn.lstm)
+register("lstmLayer_out", lambda x, wi, wh, b: _rnn.lstm(x, wi, wh, b)[0])
+register("gru_out", lambda x, wi, wh, bi, bh: _rnn.gru(x, wi, wh, bi, bh)[0])
 register("lstmCell", _rnn.lstm_cell)
 register("gruCell", _rnn.gru_cell)
 register("gru", _rnn.gru)

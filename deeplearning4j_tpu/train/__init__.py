@@ -1,0 +1,13 @@
+"""Training infra: updaters, schedules, losses, listeners, checkpoints
+(ref: org.nd4j.linalg.learning + org.deeplearning4j.optimize — SURVEY.md §2.2)."""
+
+from deeplearning4j_tpu.train import schedules, updaters  # noqa: F401
+from deeplearning4j_tpu.train.listeners import (  # noqa: F401
+    CheckpointListener,
+    EvaluativeListener,
+    PerformanceListener,
+    ScoreIterationListener,
+    TimeIterationListener,
+    TrainingListener,
+)
+from deeplearning4j_tpu.train.serializer import ModelSerializer  # noqa: F401

@@ -1,0 +1,132 @@
+"""Training listeners — the observability seam of the training loop.
+
+Reference parity: ``org.deeplearning4j.optimize.api.TrainingListener`` and
+``listeners.{ScoreIterationListener, PerformanceListener,
+CheckpointListener, EvaluativeListener, TimeIterationListener}``
+(SURVEY.md §2.2 "Optimize/solvers", §5 "Metrics / logging": the listener
+bus is the single observability seam — score, eval, checkpoints, UI stats
+all hang off it).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Callable, List, Optional
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+
+class TrainingListener:
+    """Listener protocol (ref: TrainingListener)."""
+
+    def iterationDone(self, model, iteration: int, epoch: int):
+        pass
+
+    def onEpochEnd(self, model):
+        pass
+
+
+class ScoreIterationListener(TrainingListener):
+    """Log score every N iterations (ref: ScoreIterationListener)."""
+
+    def __init__(self, print_iterations: int = 10, out: Callable = None):
+        self.n = print_iterations
+        self.out = out or (lambda msg: logger.info(msg))
+        self.history: List[float] = []
+
+    def iterationDone(self, model, iteration, epoch):
+        score = model.score()
+        self.history.append(score)
+        if iteration % self.n == 0:
+            self.out(f"Score at iteration {iteration} is {score}")
+
+
+class PerformanceListener(TrainingListener):
+    """Throughput/timing (ref: PerformanceListener: samples/sec, batches/sec)."""
+
+    def __init__(self, frequency: int = 10, report_batch: bool = True,
+                 out: Callable = None):
+        self.frequency = frequency
+        self.out = out or (lambda msg: logger.info(msg))
+        self._last_time = None
+        self._last_iter = 0
+        self.samples_per_sec: Optional[float] = None
+
+    def iterationDone(self, model, iteration, epoch):
+        now = time.time()
+        if self._last_time is not None and iteration % self.frequency == 0:
+            dt = now - self._last_time
+            iters = iteration - self._last_iter
+            if dt > 0:
+                self.out(f"iter {iteration}: {iters / dt:.1f} iterations/sec")
+            self._last_time = now
+            self._last_iter = iteration
+        elif self._last_time is None:
+            self._last_time = now
+            self._last_iter = iteration
+
+
+class TimeIterationListener(TrainingListener):
+    """ETA logging (ref: TimeIterationListener)."""
+
+    def __init__(self, total_iterations: int, out: Callable = None):
+        self.total = total_iterations
+        self.start = time.time()
+        self.out = out or (lambda msg: logger.info(msg))
+
+    def iterationDone(self, model, iteration, epoch):
+        elapsed = time.time() - self.start
+        if iteration > 0:
+            remaining = elapsed / iteration * (self.total - iteration)
+            self.out(f"iter {iteration}/{self.total}, ETA {remaining:.0f}s")
+
+
+class CheckpointListener(TrainingListener):
+    """Periodic checkpoints, keep-last-K rotation (ref: CheckpointListener)."""
+
+    def __init__(self, directory: str, save_every_n_iterations: int = None,
+                 save_every_n_epochs: int = None, keep_last: int = 3):
+        self.dir = directory
+        self.every_iter = save_every_n_iterations
+        self.every_epoch = save_every_n_epochs
+        self.keep_last = keep_last
+        self.saved: List[str] = []
+        os.makedirs(directory, exist_ok=True)
+
+    def _save(self, model, tag: str):
+        path = os.path.join(self.dir, f"checkpoint_{tag}.zip")
+        model.save(path, save_updater=True)
+        self.saved.append(path)
+        while len(self.saved) > self.keep_last:
+            old = self.saved.pop(0)
+            if os.path.exists(old):
+                os.remove(old)
+
+    def iterationDone(self, model, iteration, epoch):
+        if self.every_iter and iteration % self.every_iter == 0:
+            self._save(model, f"iter_{iteration}")
+
+    def onEpochEnd(self, model):
+        if self.every_epoch and model.getEpochCount() % self.every_epoch == 0:
+            self._save(model, f"epoch_{model.getEpochCount()}")
+
+
+class EvaluativeListener(TrainingListener):
+    """Periodic evaluation on a held-out iterator (ref: EvaluativeListener)."""
+
+    def __init__(self, iterator, frequency: int, evaluation_factory=None,
+                 out: Callable = None):
+        from deeplearning4j_tpu.evaluation.evaluation import Evaluation
+        self.iterator = iterator
+        self.frequency = frequency
+        self.factory = evaluation_factory or Evaluation
+        self.out = out or (lambda msg: logger.info(msg))
+        self.last_evaluation = None
+
+    def iterationDone(self, model, iteration, epoch):
+        if iteration % self.frequency == 0:
+            ev = model.evaluate(self.iterator, self.factory())
+            self.last_evaluation = ev
+            self.out(f"iter {iteration}: accuracy={ev.accuracy():.4f}")

@@ -36,6 +36,9 @@ class ISchedule:
         cls_name = d.pop("@class")
         if cls_name == "RampSchedule":
             return RampSchedule(ISchedule.from_config(d["base"]), d["num_iter"])
+        if cls_name == "MapSchedule":
+            # through __init__ so JSON string keys are coerced back to int
+            return MapSchedule(d["schedule_type"], d["values"])
         cls = _SCHEDULES[cls_name]
         obj = cls.__new__(cls)
         obj.__dict__.update(d)
@@ -184,10 +187,6 @@ class RampSchedule(ISchedule):
     def to_config(self):
         return {"@class": "RampSchedule", "base": self.base.to_config(),
                 "num_iter": self.num_iter}
-
-    @staticmethod
-    def _from_config(d):
-        return RampSchedule(ISchedule.from_config(d["base"]), d["num_iter"])
 
 
 _SCHEDULES = {c.__name__: c for c in

@@ -1,0 +1,95 @@
+"""Model checkpointing — ModelSerializer equivalent.
+
+Reference parity: ``org.deeplearning4j.util.ModelSerializer`` — a zip
+container with config JSON + params + **updater state** so optimizer-exact
+resume works (SURVEY.md §5 "Checkpoint / resume"), plus normalizer
+serialization (``NormalizerSerializer``).
+
+Format: zip{conf.json, arrays.npz} where arrays.npz holds per-layer params
+(``p{i}::name``), layer states (``s{i}::name``), flattened updater-state
+leaves (``u::{j}``), and counters. Arrays are saved as numpy — portable,
+no pickle.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import zipfile
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class ModelSerializer:
+    @staticmethod
+    def writeModel(model, path: str, save_updater: bool = True):
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        conf_json = model.conf.to_json()
+        meta = {"type": type(model).__name__, "iteration": model._iteration,
+                "epoch": model._epoch, "save_updater": bool(save_updater and
+                                                           model._opt_state is not None)}
+        arrays: Dict[str, np.ndarray] = {}
+        for i, p in enumerate(model._params):
+            for name, arr in p.items():
+                arrays[f"p{i}::{name}"] = np.asarray(arr)
+        for i, s in enumerate(model._states):
+            for name, arr in s.items():
+                arrays[f"s{i}::{name}"] = np.asarray(arr)
+        if meta["save_updater"]:
+            leaves, treedef = jax.tree_util.tree_flatten(model._opt_state)
+            for j, leaf in enumerate(leaves):
+                arrays[f"u::{j}"] = np.asarray(leaf)
+        with zipfile.ZipFile(path, "w") as z:
+            z.writestr("conf.json", conf_json)
+            z.writestr("meta.json", json.dumps(meta))
+            buf = io.BytesIO()
+            np.savez(buf, **arrays) if arrays else np.savez(buf, __empty__=np.zeros(1))
+            z.writestr("arrays.npz", buf.getvalue())
+
+    @staticmethod
+    def restoreMultiLayerNetwork(path: str, load_updater: bool = True):
+        from deeplearning4j_tpu.nn.config import MultiLayerConfiguration
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        with zipfile.ZipFile(path) as z:
+            conf = MultiLayerConfiguration.from_json(z.read("conf.json").decode())
+            meta = json.loads(z.read("meta.json"))
+            arrays = np.load(io.BytesIO(z.read("arrays.npz")))
+        net = MultiLayerNetwork(conf)
+        net.init()
+        for k in arrays.files:
+            if k == "__empty__":
+                continue
+            kind, _, name = k.partition("::")
+            if kind.startswith("p"):
+                net._params[int(kind[1:])][name] = jnp.asarray(arrays[k])
+            elif kind.startswith("s") and kind != "s":
+                net._states[int(kind[1:])][name] = jnp.asarray(arrays[k])
+        net._iteration = meta["iteration"]
+        net._epoch = meta["epoch"]
+        if load_updater and meta.get("save_updater"):
+            net._ensure_opt_state()
+            leaves, treedef = jax.tree_util.tree_flatten(net._opt_state)
+            new_leaves = [jnp.asarray(arrays[f"u::{j}"]) for j in range(len(leaves))]
+            net._opt_state = jax.tree_util.tree_unflatten(treedef, new_leaves)
+        return net
+
+    # normalizer (ref: NormalizerSerializer)
+    @staticmethod
+    def writeNormalizer(norm, path: str):
+        state = norm.state() if hasattr(norm, "state") else norm.__dict__
+        np.savez(path, __class__=np.asarray(type(norm).__name__),
+                 **{k: np.asarray(v) for k, v in state.items() if v is not None})
+
+    @staticmethod
+    def restoreNormalizer(path: str):
+        from deeplearning4j_tpu.data import dataset as D
+        data = np.load(path, allow_pickle=False)
+        cls = getattr(D, str(data["__class__"]))
+        norm = cls()
+        for k in data.files:
+            if k != "__class__":
+                setattr(norm, k, data[k])
+        return norm

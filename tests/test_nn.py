@@ -1,0 +1,310 @@
+"""nn-stack tests: configs, layers, MultiLayerNetwork training.
+
+Reference test-strategy parity (SURVEY.md §4): whole-network gradient
+checks in fp64, end-to-end small trainings asserting loss decrease /
+accuracy, save-load exact-parity round trips.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data import (DataSet, IrisDataSetIterator,
+                                     ListDataSetIterator, MnistDataSetIterator,
+                                     NormalizerStandardize, AsyncDataSetIterator)
+from deeplearning4j_tpu.evaluation import Evaluation
+from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                   NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.layers import (ActivationLayer, BatchNormalization,
+                                          Bidirectional, ConvolutionLayer,
+                                          DenseLayer, DropoutLayer,
+                                          EmbeddingSequenceLayer,
+                                          GlobalPoolingLayer, LastTimeStep,
+                                          LSTM, OutputLayer, RnnOutputLayer,
+                                          SimpleRnn, SubsamplingLayer)
+from deeplearning4j_tpu.train import ScoreIterationListener, updaters
+
+
+def iris_split():
+    it = IrisDataSetIterator(150)
+    ds = it.next()
+    ds.shuffle(seed=0)
+    norm = NormalizerStandardize()
+    norm.fit(ds)
+    norm.transform(ds)
+    return ds.splitTestAndTrain(0.8)
+
+
+def mlp_conf(lr=0.05, **base_kw):
+    b = NeuralNetConfiguration.Builder().seed(42).updater(updaters.Adam(lr))
+    for k, v in base_kw.items():
+        getattr(b, k)(v)
+    return (b.list()
+            .layer(DenseLayer(nOut=16, activation="relu"))
+            .layer(DenseLayer(nOut=16, activation="relu"))
+            .layer(OutputLayer(nOut=3, lossFunction="mcxent", activation="softmax"))
+            .setInputType(InputType.feedForward(4))
+            .build())
+
+
+class TestMLP:
+    def test_iris_trains_to_90pct(self):
+        split = iris_split()
+        net = MultiLayerNetwork(mlp_conf()).init()
+        train_it = ListDataSetIterator(split.getTrain(), 16, shuffle=True)
+        net.fit(train_it, epochs=40)
+        ev = net.evaluate(ListDataSetIterator(split.getTest(), 30))
+        assert ev.accuracy() >= 0.9, ev.stats()
+
+    def test_listener_sees_scores(self):
+        split = iris_split()
+        net = MultiLayerNetwork(mlp_conf()).init()
+        lst = ScoreIterationListener(1, out=lambda m: None)
+        net.setListeners(lst)
+        net.fit(ListDataSetIterator(split.getTrain(), 32), epochs=2)
+        assert len(lst.history) > 0
+        assert lst.history[-1] < lst.history[0] * 2  # sane values
+
+    def test_flat_params_roundtrip(self):
+        net = MultiLayerNetwork(mlp_conf()).init()
+        flat = net.params()
+        assert flat.shape[0] == net.numParams() == 4 * 16 + 16 + 16 * 16 + 16 + 16 * 3 + 3
+        net2 = MultiLayerNetwork(mlp_conf()).init(seed=999)
+        net2.setParams(flat)
+        np.testing.assert_allclose(net2.params(), flat)
+        x = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+        np.testing.assert_allclose(net.output(x), net2.output(x), rtol=1e-6)
+
+    def test_summary(self):
+        net = MultiLayerNetwork(mlp_conf()).init()
+        s = net.summary()
+        assert "Total params" in s and "DenseLayer" in s
+
+    def test_gradient_check_whole_net(self):
+        """fp64 finite differences through the whole network
+        (ref: org.deeplearning4j.gradientcheck.GradientCheckTests)."""
+        with jax.enable_x64(True):
+            conf = (NeuralNetConfiguration.Builder().seed(7)
+                    .updater(updaters.Sgd(0.1)).dataType("float64")
+                    .list()
+                    .layer(DenseLayer(nOut=5, activation="tanh"))
+                    .layer(OutputLayer(nOut=2, lossFunction="mcxent",
+                                       activation="softmax"))
+                    .setInputType(InputType.feedForward(3))
+                    .build())
+            net = MultiLayerNetwork(conf).init()
+            net._params = jax.tree_util.tree_map(
+                lambda p: p.astype(jnp.float64), net._params)
+            rng = np.random.RandomState(3)
+            x = jnp.asarray(rng.randn(4, 3))
+            y = jnp.asarray(np.eye(2)[rng.randint(0, 2, 4)])
+
+            def loss_of(params):
+                l, _ = net._loss_and_reg(params, net._states, x, y, False,
+                                         jax.random.PRNGKey(0), None, None)
+                return l
+
+            grads = jax.grad(loss_of)(net._params)
+            eps = 1e-6
+            for li in (0, 1):
+                for name in net._params[li]:
+                    arr = np.asarray(net._params[li][name], np.float64)
+                    g = np.asarray(grads[li][name]).ravel()
+                    for idx in range(0, arr.size, max(1, arr.size // 4)):
+                        pert = arr.copy().ravel()
+                        pert[idx] += eps
+                        pp = [dict(p) for p in net._params]
+                        pp[li][name] = jnp.asarray(pert.reshape(arr.shape))
+                        fp = float(loss_of(pp))
+                        pert[idx] -= 2 * eps
+                        pp[li][name] = jnp.asarray(pert.reshape(arr.shape))
+                        fm = float(loss_of(pp))
+                        fd = (fp - fm) / (2 * eps)
+                        np.testing.assert_allclose(g[idx], fd, rtol=1e-4, atol=1e-8)
+
+
+class TestLeNet:
+    def lenet_conf(self):
+        return (NeuralNetConfiguration.Builder()
+                .seed(123)
+                .updater(updaters.Adam(1e-3))
+                .weightInit("xavier")
+                .list()
+                .layer(ConvolutionLayer(kernelSize=(5, 5), stride=(1, 1),
+                                        nOut=8, activation="identity"))
+                .layer(SubsamplingLayer(poolingType="max", kernelSize=(2, 2),
+                                        stride=(2, 2)))
+                .layer(ConvolutionLayer(kernelSize=(5, 5), stride=(1, 1),
+                                        nOut=16, activation="identity"))
+                .layer(SubsamplingLayer(poolingType="max", kernelSize=(2, 2),
+                                        stride=(2, 2)))
+                .layer(DenseLayer(nOut=32, activation="relu"))
+                .layer(OutputLayer(nOut=10, lossFunction="mcxent",
+                                   activation="softmax"))
+                .setInputType(InputType.convolutionalFlat(28, 28, 1))
+                .build())
+
+    def test_shape_inference(self):
+        conf = self.lenet_conf()
+        # conv(5x5) 28->24, pool 24->12, conv 12->8, pool 8->4 → dense in 16*4*4
+        assert conf.layers[4].nIn == 16 * 4 * 4
+        net = MultiLayerNetwork(conf).init()
+        out = net.output(np.zeros((2, 784), np.float32))
+        assert out.shape == (2, 10)
+
+    def test_lenet_learns_synthetic_mnist(self):
+        train_it = MnistDataSetIterator(64, True, num_examples=512)
+        test_it = MnistDataSetIterator(128, False, num_examples=256)
+        net = MultiLayerNetwork(self.lenet_conf()).init()
+        net.fit(train_it, epochs=6)
+        ev = net.evaluate(test_it)
+        assert ev.accuracy() > 0.85, ev.stats()
+
+
+class TestRecurrentNet:
+    def test_lstm_sequence_classification(self):
+        """Sequences whose mean sign determines the class; LastTimeStep +
+        dense head."""
+        rng = np.random.RandomState(0)
+        N, C, T = 128, 3, 10
+        y = rng.randint(0, 2, N)
+        x = rng.randn(N, C, T).astype(np.float32) * 0.5
+        x += (y * 2 - 1)[:, None, None] * 0.6
+        labels = np.eye(2, dtype=np.float32)[y]
+        conf = (NeuralNetConfiguration.Builder().seed(1)
+                .updater(updaters.Adam(0.01))
+                .list()
+                .layer(LastTimeStep(LSTM(nOut=8)))
+                .layer(OutputLayer(nOut=2, lossFunction="mcxent", activation="softmax"))
+                .setInputType(InputType.recurrent(3, T))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        it = ListDataSetIterator(DataSet(x, labels), 32, shuffle=True)
+        net.fit(it, epochs=8)
+        ev = net.evaluate(ListDataSetIterator(DataSet(x, labels), 64))
+        assert ev.accuracy() >= 0.9, ev.stats()
+
+    def test_rnn_output_layer_with_masks(self):
+        """Per-timestep outputs + label masks (ref: masking is first-class)."""
+        rng = np.random.RandomState(1)
+        N, C, T = 64, 2, 8
+        x = rng.randn(N, C, T).astype(np.float32)
+        y = (x.sum(axis=1, keepdims=True) > 0).astype(np.float32)
+        labels = np.concatenate([y, 1 - y], axis=1)  # [N, 2, T]
+        lengths = rng.randint(3, T + 1, N)
+        mask = (np.arange(T)[None, :] < lengths[:, None]).astype(np.float32)
+        conf = (NeuralNetConfiguration.Builder().seed(2)
+                .updater(updaters.Adam(0.02))
+                .list()
+                .layer(SimpleRnn(nOut=8))
+                .layer(RnnOutputLayer(nOut=2, lossFunction="mcxent",
+                                      activation="softmax"))
+                .setInputType(InputType.recurrent(2, T))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        ds = DataSet(x, labels, features_mask=mask, labels_mask=mask)
+        first = None
+        for _ in range(30):
+            net.fit(ds)
+            first = first if first is not None else net.score()
+        assert net.score() < first
+
+    def test_bidirectional_shapes(self):
+        conf = (NeuralNetConfiguration.Builder().seed(3)
+                .updater(updaters.Sgd(0.1))
+                .list()
+                .layer(Bidirectional(LSTM(nOut=4), mode="concat"))
+                .layer(GlobalPoolingLayer("avg"))
+                .layer(OutputLayer(nOut=2, lossFunction="mcxent", activation="softmax"))
+                .setInputType(InputType.recurrent(3, 5))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        out = net.output(np.zeros((2, 3, 5), np.float32))
+        assert out.shape == (2, 2)
+
+
+class TestBatchNormDropout:
+    def test_batchnorm_updates_running_stats(self):
+        conf = (NeuralNetConfiguration.Builder().seed(4)
+                .updater(updaters.Sgd(0.01))
+                .list()
+                .layer(DenseLayer(nOut=8, activation="identity"))
+                .layer(BatchNormalization())
+                .layer(ActivationLayer("relu"))
+                .layer(OutputLayer(nOut=2, lossFunction="mcxent", activation="softmax"))
+                .setInputType(InputType.feedForward(4))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        before = np.asarray(net._states[1]["mean"]).copy()
+        rng = np.random.RandomState(0)
+        ds = DataSet(rng.randn(32, 4).astype(np.float32) + 3.0,
+                     np.eye(2, dtype=np.float32)[rng.randint(0, 2, 32)])
+        net.fit(ds)
+        after = np.asarray(net._states[1]["mean"])
+        assert not np.allclose(before, after)
+        # inference uses running stats deterministically
+        out1 = net.output(ds.features)
+        out2 = net.output(ds.features)
+        np.testing.assert_allclose(out1, out2)
+
+    def test_dropout_only_in_training(self):
+        conf = (NeuralNetConfiguration.Builder().seed(5)
+                .updater(updaters.Sgd(0.01))
+                .list()
+                .layer(DenseLayer(nOut=32, activation="relu"))
+                .layer(DropoutLayer(dropOut=0.5))
+                .layer(OutputLayer(nOut=2, lossFunction="mcxent", activation="softmax"))
+                .setInputType(InputType.feedForward(4))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        x = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+        np.testing.assert_allclose(net.output(x), net.output(x))
+
+
+class TestSerialization:
+    def test_save_restore_exact(self, tmp_path):
+        split = iris_split()
+        net = MultiLayerNetwork(mlp_conf()).init()
+        it = ListDataSetIterator(split.getTrain(), 32)
+        net.fit(it, epochs=3)
+        path = str(tmp_path / "model.zip")
+        net.save(path)
+        net2 = MultiLayerNetwork.load(path)
+        x = split.getTest().features
+        np.testing.assert_allclose(np.asarray(net.output(x)),
+                                   np.asarray(net2.output(x)), rtol=1e-6)
+        # exact training resume: same next-step score
+        net.fit(split.getTrain())
+        net2.fit(split.getTrain())
+        np.testing.assert_allclose(net.score(), net2.score(), rtol=1e-5)
+
+    def test_config_json_roundtrip(self):
+        from deeplearning4j_tpu.nn.config import MultiLayerConfiguration
+        conf = mlp_conf()
+        conf2 = MultiLayerConfiguration.from_json(conf.to_json())
+        assert len(conf2.layers) == 3
+        assert conf2.layers[0].nIn == 4
+        net = MultiLayerNetwork(conf2).init()
+        assert net.output(np.zeros((1, 4), np.float32)).shape == (1, 3)
+
+
+class TestIterators:
+    def test_async_iterator_matches(self):
+        base = IrisDataSetIterator(32)
+        async_it = AsyncDataSetIterator(IrisDataSetIterator(32))
+        n_base = sum(ds.numExamples() for ds in base)
+        n_async = sum(ds.numExamples() for ds in async_it)
+        assert n_base == n_async == 150
+        # reusable after reset
+        assert sum(ds.numExamples() for ds in async_it) == 150
+
+    def test_normalizer_standardize(self):
+        rng = np.random.RandomState(0)
+        ds = DataSet(rng.randn(100, 5).astype(np.float32) * 7 + 3,
+                     np.zeros((100, 1), np.float32))
+        norm = NormalizerStandardize()
+        norm.fit(ds)
+        norm.transform(ds)
+        assert abs(ds.features.mean()) < 0.1
+        assert abs(ds.features.std() - 1.0) < 0.1

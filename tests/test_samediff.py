@@ -280,3 +280,72 @@ class TestSchedules:
         assert float(m.valueAt(15)) == pytest.approx(0.01)
         r = schedules.RampSchedule(schedules.FixedSchedule(1.0), 10)
         assert float(r.valueAt(4)) == pytest.approx(0.5)
+
+
+class TestReviewRegressions:
+    def test_batchnorm_node_roundtrip(self, tmp_path):
+        sd = SameDiff.create()
+        x = sd.placeHolder("x", shape=(None, 3))
+        mean = sd.var("mean", np.asarray([1.0, 2.0, 3.0], np.float32))
+        var = sd.var("var", np.ones(3, np.float32))
+        gamma = sd.var("gamma", np.full(3, 2.0, np.float32))
+        beta = sd.var("beta", np.zeros(3, np.float32))
+        out = sd.nn.batchNorm(x, mean, var, gamma, beta, axis=1).rename("bn")
+        data = np.asarray([[2.0, 2.0, 2.0]], np.float32)
+        before = np.asarray(sd.output({"x": data}, ["bn"])["bn"])
+        path = str(tmp_path / "bn.sdz")
+        sd.save(path)
+        after = np.asarray(SameDiff.load(path).output({"x": data}, ["bn"])["bn"])
+        np.testing.assert_allclose(before, after, rtol=1e-6)
+
+    def test_lstm_node_roundtrip(self, tmp_path):
+        rng = np.random.RandomState(0)
+        sd = SameDiff.create()
+        x = sd.placeHolder("x", shape=(None, 2, 3))
+        wi = sd.var("wi", rng.randn(3, 16).astype(np.float32) * 0.1)
+        wh = sd.var("wh", rng.randn(4, 16).astype(np.float32) * 0.1)
+        b = sd.var("b", np.zeros(16, np.float32))
+        out = sd.rnn.lstmLayer(x, wi, wh, b).rename("h")
+        data = rng.randn(5, 2, 3).astype(np.float32)
+        before = np.asarray(sd.output({"x": data}, ["h"])["h"])
+        path = str(tmp_path / "lstm.sdz")
+        sd.save(path)
+        after = np.asarray(SameDiff.load(path).output({"x": data}, ["h"])["h"])
+        np.testing.assert_allclose(before, after, rtol=1e-6)
+        assert after.shape == (5, 2, 4)
+
+    def test_map_schedule_json_roundtrip(self):
+        import json as _json
+        from deeplearning4j_tpu.train import schedules
+        m = schedules.MapSchedule("iteration", {0: 0.1, 10: 0.01})
+        m2 = schedules.ISchedule.from_config(_json.loads(_json.dumps(m.to_config())))
+        assert float(m2.valueAt(5)) == pytest.approx(0.1)
+        assert float(m2.valueAt(15)) == pytest.approx(0.01)
+
+    def test_grad_wrt_placeholder(self):
+        sd = SameDiff.create()
+        x = sd.placeHolder("x", shape=(2,))
+        w = sd.var("w", np.asarray([2.0, 3.0], np.float32))
+        loss = (x * w).sum().rename("loss")
+        sd.setLossVariables("loss")
+        g = sd.calculateGradients({"x": np.ones(2, np.float32)}, ["x", "w"])
+        np.testing.assert_allclose(g["x"], [2.0, 3.0])
+        np.testing.assert_allclose(g["w"], [1.0, 1.0])
+
+    def test_unique_never_collides_with_vars(self):
+        sd = SameDiff.create()
+        a = sd.var("a", np.ones(2, np.float32))
+        sd.var("add_1", np.zeros(2, np.float32))
+        o1 = a.add(1.0)
+        o2 = a.add(1.0)
+        o3 = a.add(1.0)
+        names = {o1.name, o2.name, o3.name}
+        assert "add_1" not in names and len(names) == 3
+        assert sd.getVariable("add_1").var_type == "VARIABLE"
+
+    def test_mean_squared_error_saves(self, tmp_path):
+        sd = SameDiff.create()
+        a = sd.var("a", np.ones(3, np.float32))
+        b = sd.var("b", np.zeros(3, np.float32))
+        sd.loss.meanSquaredError(a, b, name="l")
+        sd.save(str(tmp_path / "m.sdz"))
