@@ -1,0 +1,84 @@
+"""Benchmark harness — prints ONE JSON line for the driver.
+
+Headline metric: BERT-style transformer training throughput on one chip
+(the reference's BASELINE config #4 / SameDiff-BERT metric, SURVEY.md §6).
+``value`` = training samples/sec at seq-len 128; ``vs_baseline`` = model
+FLOPs utilization achieved divided by the 0.35 MFU target BASELINE.md
+derives (the reference publishes no in-repo number — see BASELINE.md).
+
+Run: ``python bench.py`` (add ``--quick`` for a smaller config in CI).
+"""
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# public v5e per-chip peak (BASELINE.md): 197 bf16 TFLOP/s
+PEAK_TFLOPS = 197e12
+TARGET_MFU = 0.35
+
+
+def main(quick: bool = False):
+    from deeplearning4j_tpu.models import transformer as tfm
+    from deeplearning4j_tpu.train import updaters
+
+    if quick:
+        cfg = tfm.TransformerConfig(vocab_size=8192, d_model=256, n_heads=4,
+                                    n_layers=4, d_ff=1024, max_len=128,
+                                    causal=False, dtype=jnp.bfloat16)
+        batch, steps = 16, 10
+    else:
+        cfg = tfm.TransformerConfig.bert_base(dtype=jnp.bfloat16)  # 110M params
+        batch, steps = 32, 20
+    seq = 128
+
+    params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+    updater = updaters.Adam(1e-4)
+    opt = tfm.init_opt_state(params, updater)
+    step = tfm.make_train_step(cfg, updater, mesh=None)
+
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)), jnp.int32)
+    targets = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)), jnp.int32)
+    mask = jnp.ones((batch, seq), jnp.float32)
+
+    # param count for the 6*N*T FLOPs estimate (fwd+bwd)
+    n_params = sum(int(np.prod(p.shape))
+                   for p in jax.tree_util.tree_leaves(params))
+
+    # warmup / compile; float() forces a real device->host materialization
+    # (block_until_ready alone under-measures through the async relay on
+    # this environment's experimental TPU backend)
+    params, opt, loss = step(params, opt, jnp.asarray(0.0), tokens, targets, mask)
+    float(loss)
+
+    t0 = time.perf_counter()
+    for i in range(steps):
+        params, opt, loss = step(params, opt, jnp.asarray(float(i + 1)),
+                                 tokens, targets, mask)
+    final_loss = float(loss)  # true sync: the value depends on every step
+    dt = time.perf_counter() - t0
+
+    samples_per_sec = steps * batch / dt
+    tokens_per_sec = samples_per_sec * seq
+    flops_per_token = 6.0 * n_params  # fwd + bwd transformer estimate
+    mfu = tokens_per_sec * flops_per_token / PEAK_TFLOPS
+
+    print(json.dumps({
+        "metric": "bert_base_seq128_train_samples_per_sec_per_chip",
+        "value": round(samples_per_sec, 2),
+        "unit": "samples/sec",
+        "vs_baseline": round(mfu / TARGET_MFU, 4),
+        "detail": {"mfu": round(mfu, 4), "n_params": n_params,
+                   "batch": batch, "seq": seq, "steps": steps,
+                   "final_loss": final_loss,
+                   "backend": jax.default_backend()},
+    }))
+
+
+if __name__ == "__main__":
+    main(quick="--quick" in sys.argv)

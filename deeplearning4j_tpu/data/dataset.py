@@ -215,10 +215,15 @@ class AsyncDataSetIterator(DataSetIterator):
                     except queue.Full:
                         continue
         finally:
-            try:
-                q.put_nowait(self._END)
-            except queue.Full:
-                pass
+            # block-put the END sentinel with the same stop-checked retry as
+            # real items — dropping it deadlocks the consumer on the last batch
+            while True:
+                try:
+                    q.put(self._END, timeout=0.1)
+                    break
+                except queue.Full:
+                    if stop.is_set():
+                        break
 
     def reset(self):
         # stop + drain the previous worker before touching self.base, or two

@@ -24,7 +24,16 @@ class ConfusionMatrix:
         self.num_classes = num_classes
         self.matrix = np.zeros((num_classes, num_classes), np.int64)
 
+    def grow(self, n: int):
+        if n > self.num_classes:
+            m = np.zeros((n, n), np.int64)
+            m[:self.num_classes, :self.num_classes] = self.matrix
+            self.matrix = m
+            self.num_classes = n
+
     def add(self, actual: np.ndarray, predicted: np.ndarray):
+        hi = int(max(actual.max(initial=0), predicted.max(initial=0))) + 1
+        self.grow(hi)
         idx = actual.astype(np.int64) * self.num_classes + predicted.astype(np.int64)
         counts = np.bincount(idx, minlength=self.num_classes ** 2)
         self.matrix += counts.reshape(self.num_classes, self.num_classes)
@@ -71,6 +80,7 @@ class Evaluation:
         n_cls = labels.shape[1] if labels.ndim == 2 else int(max(actual.max(), pred.max())) + 1
         self._ensure(n_cls)
         self.confusion.add(actual, pred)
+        self.num_classes = self.confusion.num_classes  # may have grown (int labels)
         self._examples += len(actual)
 
     # -- metrics --
@@ -118,7 +128,10 @@ class Evaluation:
         if other.confusion is None:
             return
         self._ensure(other.num_classes)
-        self.confusion.merge(other.confusion)
+        self.confusion.grow(other.confusion.num_classes)
+        other_m = other.confusion.matrix
+        self.confusion.matrix[:other_m.shape[0], :other_m.shape[1]] += other_m
+        self.num_classes = self.confusion.num_classes
         self._examples += other._examples
 
     def stats(self) -> str:

@@ -1,0 +1,3 @@
+"""Model zoo (ref: deeplearning4j-zoo — SURVEY.md §2.2)."""
+
+from deeplearning4j_tpu.models import transformer  # noqa: F401

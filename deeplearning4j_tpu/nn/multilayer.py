@@ -220,6 +220,7 @@ class MultiLayerNetwork:
             fmask if fmask is not None else dummy,
             lmask if lmask is not None else dummy, key)
         self._score = float(loss)
+        self._last_batch_size = int(ds.features.shape[0])
         self._iteration += 1
         for lst in self._listeners:
             if hasattr(lst, "iterationDone"):

@@ -1,0 +1,11 @@
+"""Parallelism: mesh/sharding, DP/TP/SP, parallel inference
+(ref: deeplearning4j-scaleout — SURVEY.md §2.3; redesigned as synchronous
+SPMD over a device mesh with XLA collectives)."""
+
+from deeplearning4j_tpu.parallel.mesh import DeviceMesh, ShardingRule  # noqa: F401
+from deeplearning4j_tpu.parallel.sequence import ring_attention  # noqa: F401
+from deeplearning4j_tpu.parallel.wrapper import (  # noqa: F401
+    InferenceObservable,
+    ParallelInference,
+    ParallelWrapper,
+)

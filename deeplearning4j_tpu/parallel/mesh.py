@@ -1,0 +1,113 @@
+"""Device mesh + sharding configuration.
+
+Reference parity: this module replaces the reference's distributed plumbing
+(SURVEY.md §2.3): ``ParallelWrapper`` (single-node DP),
+``ParameterAveragingTrainingMaster``/``SharedTrainingMaster`` (Spark BSP /
+async gradient sharing over Aeron) — all subsumed by synchronous SPMD over
+a ``jax.sharding.Mesh`` with XLA collectives riding ICI (SURVEY.md §5
+"Distributed communication backend": the north-star replacement).
+
+Axes convention (the scaling-book recipe: pick a mesh, annotate shardings,
+let XLA insert collectives):
+- ``data``  — batch dim (DP; grads allreduced by XLA)
+- ``model`` — tensor parallelism (TP; activations allgathered/reduced)
+- ``seq``   — sequence/context parallelism (SP; ring collectives)
+
+Multi-host: the same mesh spans hosts via ``jax.distributed.initialize``
+(DCN between slices) — no code change, which is exactly the design win
+over the reference's Aeron mesh + Spark topology (MeshOrganizer etc.).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class DeviceMesh:
+    """Named-axis device mesh wrapper."""
+
+    def __init__(self, mesh: Mesh):
+        self.mesh = mesh
+
+    @staticmethod
+    def create(data: int = -1, model: int = 1, seq: int = 1,
+               devices: Sequence = None) -> "DeviceMesh":
+        """Build a (data, model, seq) mesh. ``data=-1`` = all remaining."""
+        devices = list(devices if devices is not None else jax.devices())
+        n = len(devices)
+        if data == -1:
+            assert n % (model * seq) == 0, f"{n} devices not divisible by model*seq"
+            data = n // (model * seq)
+        assert data * model * seq == n, \
+            f"mesh {data}x{model}x{seq} != {n} devices"
+        arr = np.asarray(devices).reshape(data, model, seq)
+        return DeviceMesh(Mesh(arr, ("data", "model", "seq")))
+
+    @staticmethod
+    def data_parallel(devices: Sequence = None) -> "DeviceMesh":
+        return DeviceMesh.create(data=-1, model=1, seq=1, devices=devices)
+
+    @property
+    def axis_names(self):
+        return self.mesh.axis_names
+
+    def size(self, axis: str = None) -> int:
+        if axis is None:
+            return int(np.prod([self.mesh.shape[a] for a in self.mesh.axis_names]))
+        return self.mesh.shape[axis]
+
+    def sharding(self, *spec) -> NamedSharding:
+        """NamedSharding from a PartitionSpec-style tuple."""
+        return NamedSharding(self.mesh, P(*spec))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def batch_sharding(self, ndim: int) -> NamedSharding:
+        """Shard dim 0 over data axis, replicate the rest."""
+        return NamedSharding(self.mesh, P("data", *([None] * (ndim - 1))))
+
+    def shard_batch(self, tree):
+        """Place a host batch onto the mesh, dim-0-sharded over data."""
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, self.batch_sharding(np.ndim(x))), tree)
+
+    def replicate(self, tree):
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, self.replicated()), tree)
+
+    def __enter__(self):
+        self._ctx = self.mesh
+        self._ctx.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._ctx.__exit__(*exc)
+
+
+class ShardingRule:
+    """Regex-based parameter sharding rules (the ``pjit`` param-sharding
+    config the reference lacked — SURVEY.md §2.3 'TP for free via GSPMD')."""
+
+    def __init__(self, rules: Dict[str, Tuple]):
+        """rules: {param-name-regex: partition-spec-tuple}"""
+        import re
+        self.rules = [(re.compile(k), v) for k, v in rules.items()]
+
+    def spec_for(self, name: str, ndim: int) -> P:
+        for pat, spec in self.rules:
+            if pat.search(name):
+                return P(*spec)
+        return P()  # replicate by default
+
+    def shard_params(self, mesh: DeviceMesh, named_params: Dict):
+        """Apply rules to a flat {name: array} dict."""
+        out = {}
+        for name, arr in named_params.items():
+            spec = self.spec_for(name, np.ndim(arr))
+            out[name] = jax.device_put(arr, NamedSharding(mesh.mesh, spec))
+        return out

@@ -1,0 +1,178 @@
+"""Data-parallel training + parallel inference over the mesh.
+
+Reference parity:
+- ``ParallelWrapper`` (SURVEY.md §2.2/§2.3): N replicas fed round-robin,
+  periodic averaging / encoded gradient sharing → here: synchronous SPMD —
+  batch sharded over the ``data`` axis, params replicated, XLA emits the
+  gradient allreduce over ICI. Strictly stronger consistency than the
+  reference's async modes at higher throughput (SURVEY.md §2.3 "sync
+  allreduce strictly dominates").
+- ``ParallelInference`` (SURVEY.md §3.5): request queue + dynamic batching
+  across device workers → here: a batcher in front of a data-sharded
+  compiled forward.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet, DataSetIterator
+from deeplearning4j_tpu.parallel.mesh import DeviceMesh
+
+
+class ParallelWrapper:
+    """Sync data-parallel trainer over the mesh (ref: ParallelWrapper).
+
+    Wraps a MultiLayerNetwork; ``fit`` shards each batch over the mesh's
+    ``data`` axis and keeps params replicated — the train step is the
+    network's own compiled step, so gradients are allreduced by XLA inside
+    ONE program (no EncodedGradientsAccumulator, no averaging interval).
+    """
+
+    def __init__(self, model, mesh: DeviceMesh = None,
+                 prefetch_buffer: int = 2, workers: int = None):
+        self.model = model
+        self.mesh = mesh or DeviceMesh.data_parallel()
+        self.prefetch = prefetch_buffer
+
+    def fit(self, iterator: DataSetIterator, epochs: int = 1):
+        model = self.model
+        if not model._initialized:
+            model.init()
+        # replicate params/opt state once; batches are sharded per step
+        with self.mesh:
+            model._ensure_opt_state()
+            model._params = self.mesh.replicate(model._params)
+            model._states = self.mesh.replicate(model._states)
+            model._opt_state = self.mesh.replicate(model._opt_state)
+            for _ in range(epochs):
+                iterator.reset()
+                while iterator.hasNext():
+                    ds = iterator.next()
+                    ds = self._shard(ds)
+                    model._fit_one(ds)
+                model._epoch += 1
+        return model
+
+    def _shard(self, ds: DataSet) -> DataSet:
+        n = self.mesh.size("data")
+        b = ds.features.shape[0]
+        if b % n != 0:
+            # pad the tail batch up to the DP width with ZERO-WEIGHT examples
+            # (labels mask 0) so gradients exactly match the unpadded batch
+            pad = n - b % n
+            rep = lambda a: np.concatenate([a, np.repeat(a[-1:], pad, 0)]) \
+                if a is not None else None
+            lmask = ds.labels_mask
+            if lmask is None:
+                # shape must match what the output layer's loss expects:
+                # per-example [b] for ff labels, per-timestep [b, T] for
+                # time-series labels [N, C, T]
+                if ds.labels is not None and ds.labels.ndim == 3:
+                    lmask = np.ones((b, ds.labels.shape[2]), np.float32)
+                else:
+                    lmask = np.ones((b,), np.float32)
+            lmask = np.concatenate([lmask, np.zeros((pad,) + lmask.shape[1:],
+                                                    lmask.dtype)])
+            ds = DataSet(rep(ds.features), rep(ds.labels),
+                         rep(ds.features_mask), lmask)
+        out = DataSet.__new__(DataSet)
+        put = lambda a: jax.device_put(
+            a, self.mesh.batch_sharding(np.ndim(a))) if a is not None else None
+        out.features = put(ds.features)
+        out.labels = put(ds.labels)
+        out.features_mask = put(ds.features_mask)
+        out.labels_mask = put(ds.labels_mask)
+        return out
+
+    def averagingFrequency(self, n):  # API parity no-ops: sync SPMD has no interval
+        return self
+
+    def workers(self, n):
+        return self
+
+
+class InferenceObservable:
+    """Future-like handle for one inference request (ref: ObservablesProvider)."""
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._result = None
+
+    def _complete(self, result):
+        self._result = result
+        self._event.set()
+
+    def _fail(self, exc: Exception):
+        self._error = exc
+        self._event.set()
+
+    def get(self, timeout: float = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("inference result not ready")
+        if getattr(self, "_error", None) is not None:
+            raise self._error
+        return self._result
+
+
+class ParallelInference:
+    """Batched inference server object (ref: ParallelInference,
+    InferenceMode.BATCHED): queue requests, coalesce up to batchLimit,
+    run ONE sharded forward over the mesh, fan results back out."""
+
+    def __init__(self, model, mesh: DeviceMesh = None, batch_limit: int = 32,
+                 queue_timeout_ms: float = 5.0):
+        self.model = model
+        self.mesh = mesh or DeviceMesh.data_parallel()
+        self.batch_limit = batch_limit
+        self.timeout = queue_timeout_ms / 1000.0
+        self._queue: "queue.Queue" = queue.Queue()
+        self._shutdown = False
+        self._worker = threading.Thread(target=self._serve, daemon=True)
+        self._worker.start()
+
+    def output(self, x, timeout: float = 30.0):
+        """Synchronous single-request API (ref: ParallelInference.output)."""
+        return self.submit(x).get(timeout)
+
+    def submit(self, x) -> InferenceObservable:
+        obs = InferenceObservable()
+        self._queue.put((np.asarray(x), obs))
+        return obs
+
+    def _serve(self):
+        while not self._shutdown:
+            try:
+                first = self._queue.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            batch = [first]
+            sizes = [first[0].shape[0]]
+            while sum(sizes) < self.batch_limit:
+                try:
+                    item = self._queue.get(timeout=self.timeout)
+                    batch.append(item)
+                    sizes.append(item[0].shape[0])
+                except queue.Empty:
+                    break
+            try:
+                feats = np.concatenate([b[0] for b in batch], axis=0)
+                with self.mesh:
+                    out = np.asarray(self.model.output(feats))
+                pos = 0
+                for (x, obs), n in zip(batch, sizes):
+                    obs._complete(out[pos:pos + n])
+                    pos += n
+            except Exception as e:  # fail the requests, keep the server alive
+                for _, obs in batch:
+                    obs._fail(e)
+
+    def shutdown(self):
+        self._shutdown = True
+        self._worker.join(timeout=1.0)

@@ -49,20 +49,28 @@ class PerformanceListener(TrainingListener):
     def __init__(self, frequency: int = 10, report_batch: bool = True,
                  out: Callable = None):
         self.frequency = frequency
+        self.report_batch = report_batch
         self.out = out or (lambda msg: logger.info(msg))
         self._last_time = None
         self._last_iter = 0
+        self._samples = 0
         self.samples_per_sec: Optional[float] = None
 
     def iterationDone(self, model, iteration, epoch):
         now = time.time()
+        self._samples += getattr(model, "_last_batch_size", 0)
         if self._last_time is not None and iteration % self.frequency == 0:
             dt = now - self._last_time
             iters = iteration - self._last_iter
             if dt > 0:
-                self.out(f"iter {iteration}: {iters / dt:.1f} iterations/sec")
+                self.samples_per_sec = self._samples / dt
+                msg = f"iter {iteration}: {iters / dt:.1f} iterations/sec"
+                if self.report_batch and self._samples:
+                    msg += f", {self.samples_per_sec:.1f} samples/sec"
+                self.out(msg)
             self._last_time = now
             self._last_iter = iteration
+            self._samples = 0
         elif self._last_time is None:
             self._last_time = now
             self._last_iter = iteration
