@@ -1,0 +1,500 @@
+"""DataVec equivalent: record readers, schema, transform process.
+
+Reference parity: ``datavec/datavec-api`` —
+``org.datavec.api.records.reader.RecordReader`` impls (CSV, line,
+collection, sequence), the ``Writable`` type system,
+``org.datavec.api.transform.{TransformProcess, schema.Schema}`` with its
+transform ops (remove/rename columns, categorical→integer/one-hot,
+normalize, filter, conditional replace, ...) — SURVEY.md §2.2 "DataVec
+core" (~100 transform ops; the most-used surface is implemented here and
+the DSL is extensible via ``custom``).
+
+TPU-native: transforms run columnar on the host (numpy object arrays /
+python lists) and terminate in ``RecordReaderDataSetIterator`` which emits
+device-ready numpy batches.
+"""
+
+from __future__ import annotations
+
+import csv
+import io
+import os
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Union
+
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet, DataSetIterator
+
+
+# ------------------------------------------------------------------ writables
+class Writable:
+    """Base value wrapper (ref: org.datavec.api.writable.Writable)."""
+
+    def __init__(self, value):
+        self.value = value
+
+    def toDouble(self) -> float:
+        return float(self.value)
+
+    def toInt(self) -> int:
+        return int(float(self.value))
+
+    def toString(self) -> str:
+        return str(self.value)
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.value!r})"
+
+    def __eq__(self, other):
+        return isinstance(other, Writable) and self.value == other.value
+
+
+class DoubleWritable(Writable):
+    pass
+
+
+class IntWritable(Writable):
+    pass
+
+
+class Text(Writable):
+    pass
+
+
+class FloatWritable(Writable):
+    pass
+
+
+# -------------------------------------------------------------------- schema
+class ColumnType:
+    DOUBLE = "Double"
+    INTEGER = "Integer"
+    CATEGORICAL = "Categorical"
+    STRING = "String"
+    TIME = "Time"
+
+
+class Schema:
+    """Column schema (ref: org.datavec.api.transform.schema.Schema)."""
+
+    def __init__(self, columns: List[Dict] = None):
+        self.columns = columns or []
+
+    class Builder:
+        def __init__(self):
+            self._cols = []
+
+        def addColumnDouble(self, name):
+            self._cols.append({"name": name, "type": ColumnType.DOUBLE})
+            return self
+
+        def addColumnsDouble(self, *names):
+            for n in names:
+                self.addColumnDouble(n)
+            return self
+
+        def addColumnInteger(self, name):
+            self._cols.append({"name": name, "type": ColumnType.INTEGER})
+            return self
+
+        def addColumnsInteger(self, *names):
+            for n in names:
+                self.addColumnInteger(n)
+            return self
+
+        def addColumnCategorical(self, name, *state_names):
+            self._cols.append({"name": name, "type": ColumnType.CATEGORICAL,
+                               "states": list(state_names)})
+            return self
+
+        def addColumnString(self, name):
+            self._cols.append({"name": name, "type": ColumnType.STRING})
+            return self
+
+        def build(self):
+            return Schema(self._cols)
+
+    def numColumns(self) -> int:
+        return len(self.columns)
+
+    def getColumnNames(self) -> List[str]:
+        return [c["name"] for c in self.columns]
+
+    def getIndexOfColumn(self, name: str) -> int:
+        return self.getColumnNames().index(name)
+
+    def getColumnTypes(self):
+        return [c["type"] for c in self.columns]
+
+    def __repr__(self):
+        return "Schema(" + ", ".join(f"{c['name']}:{c['type']}"
+                                     for c in self.columns) + ")"
+
+
+# ----------------------------------------------------------- record readers
+class RecordReader:
+    """ref: org.datavec.api.records.reader.RecordReader — iterator over
+    records (lists of Writables)."""
+
+    def hasNext(self) -> bool:
+        raise NotImplementedError
+
+    def next(self) -> List[Writable]:
+        raise NotImplementedError
+
+    def reset(self):
+        raise NotImplementedError
+
+    def __iter__(self):
+        self.reset()
+        while self.hasNext():
+            yield self.next()
+
+
+class CSVRecordReader(RecordReader):
+    """ref: org.datavec.api.records.reader.impl.csv.CSVRecordReader."""
+
+    def __init__(self, skip_lines: int = 0, delimiter: str = ","):
+        self.skip_lines = skip_lines
+        self.delimiter = delimiter
+        self._rows = []
+        self._pos = 0
+
+    def initialize(self, source: Union[str, io.TextIOBase, List[str]]):
+        if isinstance(source, str):
+            with open(source) as f:
+                lines = f.read().splitlines()
+        elif isinstance(source, list):
+            lines = source
+        else:
+            lines = source.read().splitlines()
+        reader = csv.reader(lines[self.skip_lines:], delimiter=self.delimiter)
+        self._rows = [[_auto_writable(v) for v in row] for row in reader if row]
+        self._pos = 0
+        return self
+
+    def hasNext(self):
+        return self._pos < len(self._rows)
+
+    def next(self):
+        row = self._rows[self._pos]
+        self._pos += 1
+        return row
+
+    def reset(self):
+        self._pos = 0
+
+
+class LineRecordReader(RecordReader):
+    """ref: impl.LineRecordReader — one Text writable per line."""
+
+    def __init__(self):
+        self._lines = []
+        self._pos = 0
+
+    def initialize(self, source: Union[str, List[str]]):
+        if isinstance(source, str) and os.path.exists(source):
+            with open(source) as f:
+                self._lines = f.read().splitlines()
+        elif isinstance(source, list):
+            self._lines = source
+        else:
+            self._lines = str(source).splitlines()
+        self._pos = 0
+        return self
+
+    def hasNext(self):
+        return self._pos < len(self._lines)
+
+    def next(self):
+        line = self._lines[self._pos]
+        self._pos += 1
+        return [Text(line)]
+
+    def reset(self):
+        self._pos = 0
+
+
+class CollectionRecordReader(RecordReader):
+    """ref: impl.collection.CollectionRecordReader."""
+
+    def __init__(self, records: List[List]):
+        self._records = [[v if isinstance(v, Writable) else _auto_writable(v)
+                          for v in r] for r in records]
+        self._pos = 0
+
+    def hasNext(self):
+        return self._pos < len(self._records)
+
+    def next(self):
+        r = self._records[self._pos]
+        self._pos += 1
+        return r
+
+    def reset(self):
+        self._pos = 0
+
+
+class CSVSequenceRecordReader(RecordReader):
+    """ref: impl.csv.CSVSequenceRecordReader — one CSV file per sequence."""
+
+    def __init__(self, skip_lines: int = 0, delimiter: str = ","):
+        self.skip_lines = skip_lines
+        self.delimiter = delimiter
+        self._sequences = []
+        self._pos = 0
+
+    def initialize(self, sources: Sequence[Union[str, List[str]]]):
+        self._sequences = []
+        for src in sources:
+            rr = CSVRecordReader(self.skip_lines, self.delimiter).initialize(src)
+            self._sequences.append(list(rr))
+        self._pos = 0
+        return self
+
+    def hasNext(self):
+        return self._pos < len(self._sequences)
+
+    def next(self):
+        s = self._sequences[self._pos]
+        self._pos += 1
+        return s
+
+    def reset(self):
+        self._pos = 0
+
+
+def _auto_writable(v) -> Writable:
+    try:
+        f = float(v)
+        if f.is_integer() and "." not in str(v):
+            return IntWritable(int(f))
+        return DoubleWritable(f)
+    except (TypeError, ValueError):
+        return Text(v)
+
+
+# ------------------------------------------------------------ transform DSL
+class TransformProcess:
+    """Columnar transform pipeline (ref:
+    org.datavec.api.transform.TransformProcess). Build with the Builder,
+    execute with ``execute(records)`` (the LocalTransformExecutor path)."""
+
+    def __init__(self, initial_schema: Schema, steps: List):
+        self.initial_schema = initial_schema
+        self.steps = steps
+
+    class Builder:
+        def __init__(self, schema: Schema):
+            self.schema = schema
+            self.steps = []
+
+        def removeColumns(self, *names):
+            self.steps.append(("remove", names))
+            return self
+
+        def removeAllColumnsExceptFor(self, *names):
+            self.steps.append(("keep", names))
+            return self
+
+        def renameColumn(self, old, new):
+            self.steps.append(("rename", (old, new)))
+            return self
+
+        def categoricalToInteger(self, *names):
+            self.steps.append(("cat2int", names))
+            return self
+
+        def categoricalToOneHot(self, *names):
+            self.steps.append(("cat2onehot", names))
+            return self
+
+        def integerToCategorical(self, name, states):
+            self.steps.append(("int2cat", (name, states)))
+            return self
+
+        def stringToCategorical(self, name, states):
+            self.steps.append(("str2cat", (name, states)))
+            return self
+
+        def doubleMathOp(self, name, op, value):
+            self.steps.append(("math", (name, op, value)))
+            return self
+
+        def normalize(self, name, kind: str = "MinMax"):
+            self.steps.append(("normalize", (name, kind)))
+            return self
+
+        def filter(self, predicate: Callable[[Dict], bool]):
+            """Remove rows where predicate(row_dict) is True (ref:
+            ConditionFilter)."""
+            self.steps.append(("filter", predicate))
+            return self
+
+        def conditionalReplaceValueTransform(self, name, new_value,
+                                             predicate: Callable[[Any], bool]):
+            self.steps.append(("cond_replace", (name, new_value, predicate)))
+            return self
+
+        def custom(self, fn: Callable):
+            """Escape hatch: fn(rows, schema) -> (rows, schema)."""
+            self.steps.append(("custom", fn))
+            return self
+
+        def build(self):
+            return TransformProcess(self.schema, self.steps)
+
+    # -- execution (ref: LocalTransformExecutor.execute) --
+    def execute(self, records: Iterable[List]) -> List[List]:
+        rows = [[w.value if isinstance(w, Writable) else w for w in r]
+                for r in records]
+        schema = Schema([dict(c) for c in self.initial_schema.columns])
+        for kind, arg in self.steps:
+            rows, schema = self._apply(kind, arg, rows, schema)
+        self.final_schema = schema
+        return rows
+
+    def getFinalSchema(self) -> Schema:
+        if not hasattr(self, "final_schema"):
+            # dry-run on empty data to compute the schema
+            self.execute([])
+        return self.final_schema
+
+    def _apply(self, kind, arg, rows, schema: Schema):
+        names = schema.getColumnNames()
+        if kind == "remove":
+            idxs = [names.index(n) for n in arg]
+            keep = [i for i in range(len(names)) if i not in idxs]
+            return ([[r[i] for i in keep] for r in rows],
+                    Schema([schema.columns[i] for i in keep]))
+        if kind == "keep":
+            idxs = [names.index(n) for n in arg]
+            return ([[r[i] for i in idxs] for r in rows],
+                    Schema([schema.columns[i] for i in idxs]))
+        if kind == "rename":
+            old, new = arg
+            cols = [dict(c) for c in schema.columns]
+            cols[names.index(old)]["name"] = new
+            return rows, Schema(cols)
+        if kind == "cat2int":
+            for n in arg:
+                i = names.index(n)
+                states = schema.columns[i].get("states")
+                if states is None:
+                    states = sorted({r[i] for r in rows})
+                lut = {s: j for j, s in enumerate(states)}
+                for r in rows:
+                    r[i] = lut[r[i]]
+                schema.columns[i] = {"name": n, "type": ColumnType.INTEGER}
+            return rows, schema
+        if kind == "cat2onehot":
+            for n in arg:
+                i = schema.getColumnNames().index(n)
+                states = schema.columns[i].get("states")
+                if states is None:
+                    states = sorted({r[i] for r in rows})
+                new_cols = [{"name": f"{n}[{s}]", "type": ColumnType.INTEGER}
+                            for s in states]
+                for r in rows:
+                    onehot = [1 if r[i] == s else 0 for s in states]
+                    r[i:i + 1] = onehot
+                schema.columns[i:i + 1] = new_cols
+            return rows, schema
+        if kind == "int2cat" or kind == "str2cat":
+            name, states = arg
+            i = names.index(name)
+            if kind == "int2cat":
+                for r in rows:
+                    r[i] = states[int(r[i])]
+            schema.columns[i] = {"name": name, "type": ColumnType.CATEGORICAL,
+                                 "states": list(states)}
+            return rows, schema
+        if kind == "math":
+            name, op, value = arg
+            i = names.index(name)
+            fn = {"Add": lambda x: x + value, "Subtract": lambda x: x - value,
+                  "Multiply": lambda x: x * value, "Divide": lambda x: x / value,
+                  "Power": lambda x: x ** value}[op]
+            for r in rows:
+                r[i] = fn(float(r[i]))
+            return rows, schema
+        if kind == "normalize":
+            name, how = arg
+            i = names.index(name)
+            vals = np.asarray([float(r[i]) for r in rows]) if rows else np.zeros(0)
+            if how == "MinMax":
+                lo, hi = (vals.min(), vals.max()) if len(vals) else (0, 1)
+                rng = max(hi - lo, 1e-12)
+                for r in rows:
+                    r[i] = (float(r[i]) - lo) / rng
+            elif how == "Standardize":
+                m, s = (vals.mean(), max(vals.std(), 1e-12)) if len(vals) else (0, 1)
+                for r in rows:
+                    r[i] = (float(r[i]) - m) / s
+            return rows, schema
+        if kind == "filter":
+            pred = arg
+            names_now = schema.getColumnNames()
+            rows = [r for r in rows
+                    if not pred(dict(zip(names_now, r)))]
+            return rows, schema
+        if kind == "cond_replace":
+            name, new_value, pred = arg
+            i = names.index(name)
+            for r in rows:
+                if pred(r[i]):
+                    r[i] = new_value
+            return rows, schema
+        if kind == "custom":
+            return arg(rows, schema)
+        raise ValueError(kind)
+
+
+class RecordReaderDataSetIterator(DataSetIterator):
+    """Bridge RecordReader → DataSet batches
+    (ref: org.deeplearning4j.datasets.datavec.RecordReaderDataSetIterator)."""
+
+    def __init__(self, reader: RecordReader, batch_size: int,
+                 label_index: int = -1, num_classes: int = None,
+                 regression: bool = False):
+        self.reader = reader
+        self.batch_size = batch_size
+        self.label_index = label_index
+        self.num_classes = num_classes
+        self.regression = regression
+        self.reset()
+
+    def reset(self):
+        self.reader.reset()
+
+    def hasNext(self):
+        return self.reader.hasNext()
+
+    def next(self) -> DataSet:
+        feats, labels = [], []
+        n = 0
+        while self.reader.hasNext() and n < self.batch_size:
+            rec = [w.value if isinstance(w, Writable) else w
+                   for w in self.reader.next()]
+            if self.label_index is None:
+                feats.append([float(v) for v in rec])
+            else:
+                li = self.label_index if self.label_index >= 0 \
+                    else len(rec) + self.label_index
+                lab = rec[li]
+                row = [float(v) for j, v in enumerate(rec) if j != li]
+                feats.append(row)
+                labels.append(lab)
+            n += 1
+        features = np.asarray(feats, np.float32)
+        if self.label_index is None:
+            return self._apply_pre(DataSet(features, None))
+        if self.regression:
+            y = np.asarray(labels, np.float32).reshape(-1, 1)
+        else:
+            y = np.eye(self.num_classes, dtype=np.float32)[
+                np.asarray(labels, np.int64)]
+        return self._apply_pre(DataSet(features, y))
+
+    def batch(self):
+        return self.batch_size

@@ -1,0 +1,681 @@
+"""Model zoo — architecture builders.
+
+Reference parity: ``org.deeplearning4j.zoo.model.{LeNet, SimpleCNN,
+AlexNet, VGG16, VGG19, ResNet50, Darknet19, TinyYOLO, YOLO2, SqueezeNet,
+UNet, Xception, FaceNetNN4Small2, TextGenerationLSTM}`` + ``ZooModel``
+base (SURVEY.md §2.2 "Model zoo", L6). Each builder returns a
+MultiLayerNetwork or ComputationGraph configured like the reference's
+(layer counts/kernels/strides per the canonical papers the reference
+follows). ``initPretrained`` requires downloaded weights — this
+environment has no egress, so it loads from DL4J_TPU_DATA_DIR instead.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+from deeplearning4j_tpu.nn.config import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.graph import (ComputationGraph, ElementWiseVertex,
+                                         GraphBuilder, MergeVertex)
+from deeplearning4j_tpu.nn.layers import (ActivationLayer, BatchNormalization,
+                                          ConvolutionLayer, DenseLayer,
+                                          DropoutLayer, GlobalPoolingLayer,
+                                          LocalResponseNormalization, LSTM,
+                                          OutputLayer, RnnOutputLayer,
+                                          SeparableConvolution2D,
+                                          SubsamplingLayer, Upsampling2D,
+                                          ZeroPaddingLayer)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.train import updaters
+
+
+class ZooModel:
+    """Base (ref: org.deeplearning4j.zoo.ZooModel)."""
+
+    def __init__(self, num_classes: int = 1000, seed: int = 123,
+                 input_shape: Tuple[int, int, int] = None, updater=None):
+        self.num_classes = num_classes
+        self.seed = seed
+        self.input_shape = input_shape or self.default_input_shape()
+        self.updater = updater or updaters.Adam(1e-3)
+
+    def default_input_shape(self):
+        return (3, 224, 224)  # (channels, H, W)
+
+    def init(self):
+        net = self.conf_builder()
+        net.init()
+        return net
+
+    def conf_builder(self):
+        raise NotImplementedError
+
+    def initPretrained(self, pretrained_type: str = "IMAGENET"):
+        """ref: ZooModel.initPretrained — checksummed download; here: load
+        from local cache only (zero-egress environment)."""
+        path = os.path.join(
+            os.environ.get("DL4J_TPU_DATA_DIR",
+                           os.path.expanduser("~/.deeplearning4j_tpu")),
+            "pretrained", f"{type(self).__name__.lower()}_{pretrained_type.lower()}.zip")
+        if not os.path.exists(path):
+            raise FileNotFoundError(
+                f"pretrained weights not found at {path} (no network egress; "
+                f"place the checkpoint there manually)")
+        try:
+            return MultiLayerNetwork.load(path)
+        except Exception:
+            return ComputationGraph.load(path)
+
+
+class LeNet(ZooModel):
+    """ref: zoo.model.LeNet — the canonical MNIST config (BASELINE #0)."""
+
+    def default_input_shape(self):
+        return (1, 28, 28)
+
+    def conf_builder(self) -> MultiLayerNetwork:
+        c, h, w = self.input_shape
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(self.seed).updater(self.updater).weightInit("xavier")
+                .list()
+                .layer(ConvolutionLayer(kernelSize=(5, 5), stride=(1, 1),
+                                        nOut=20, activation="identity"))
+                .layer(SubsamplingLayer(poolingType="max", kernelSize=(2, 2),
+                                        stride=(2, 2)))
+                .layer(ConvolutionLayer(kernelSize=(5, 5), stride=(1, 1),
+                                        nOut=50, activation="identity"))
+                .layer(SubsamplingLayer(poolingType="max", kernelSize=(2, 2),
+                                        stride=(2, 2)))
+                .layer(DenseLayer(nOut=500, activation="relu"))
+                .layer(OutputLayer(nOut=self.num_classes, lossFunction="mcxent",
+                                   activation="softmax"))
+                .setInputType(InputType.convolutionalFlat(h, w, c))
+                .build())
+        return MultiLayerNetwork(conf)
+
+
+class SimpleCNN(ZooModel):
+    """ref: zoo.model.SimpleCNN."""
+
+    def default_input_shape(self):
+        return (3, 48, 48)
+
+    def conf_builder(self) -> MultiLayerNetwork:
+        c, h, w = self.input_shape
+        b = (NeuralNetConfiguration.Builder()
+             .seed(self.seed).updater(self.updater).weightInit("relu")
+             .list())
+        for n_out in (16, 16, 32, 32, 64, 64):
+            b = b.layer(ConvolutionLayer(kernelSize=(3, 3), nOut=n_out,
+                                         padding=(1, 1), activation="identity"))
+            b = b.layer(BatchNormalization())
+            b = b.layer(ActivationLayer("relu"))
+            if n_out in (16, 32):
+                b = b.layer(SubsamplingLayer(poolingType="max",
+                                             kernelSize=(2, 2), stride=(2, 2)))
+        b = (b.layer(GlobalPoolingLayer("avg"))
+             .layer(DropoutLayer(dropOut=0.5))
+             .layer(OutputLayer(nOut=self.num_classes, lossFunction="mcxent",
+                                activation="softmax"))
+             .setInputType(InputType.convolutional(h, w, c)))
+        return MultiLayerNetwork(b.build())
+
+
+class AlexNet(ZooModel):
+    """ref: zoo.model.AlexNet (one-tower variant with LRN)."""
+
+    def conf_builder(self) -> MultiLayerNetwork:
+        c, h, w = self.input_shape
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(self.seed).updater(self.updater).weightInit("relu")
+                .list()
+                .layer(ConvolutionLayer(kernelSize=(11, 11), stride=(4, 4),
+                                        padding=(3, 3), nOut=96, activation="relu"))
+                .layer(LocalResponseNormalization())
+                .layer(SubsamplingLayer(poolingType="max", kernelSize=(3, 3),
+                                        stride=(2, 2)))
+                .layer(ConvolutionLayer(kernelSize=(5, 5), padding=(2, 2),
+                                        nOut=256, activation="relu"))
+                .layer(LocalResponseNormalization())
+                .layer(SubsamplingLayer(poolingType="max", kernelSize=(3, 3),
+                                        stride=(2, 2)))
+                .layer(ConvolutionLayer(kernelSize=(3, 3), padding=(1, 1),
+                                        nOut=384, activation="relu"))
+                .layer(ConvolutionLayer(kernelSize=(3, 3), padding=(1, 1),
+                                        nOut=384, activation="relu"))
+                .layer(ConvolutionLayer(kernelSize=(3, 3), padding=(1, 1),
+                                        nOut=256, activation="relu"))
+                .layer(SubsamplingLayer(poolingType="max", kernelSize=(3, 3),
+                                        stride=(2, 2)))
+                .layer(DenseLayer(nOut=4096, activation="relu", dropOut=0.5))
+                .layer(DenseLayer(nOut=4096, activation="relu", dropOut=0.5))
+                .layer(OutputLayer(nOut=self.num_classes, lossFunction="mcxent",
+                                   activation="softmax"))
+                .setInputType(InputType.convolutional(h, w, c))
+                .build())
+        return MultiLayerNetwork(conf)
+
+
+def _vgg_blocks(b, plan):
+    for n_convs, n_out in plan:
+        for _ in range(n_convs):
+            b = b.layer(ConvolutionLayer(kernelSize=(3, 3), padding=(1, 1),
+                                         nOut=n_out, activation="relu"))
+        b = b.layer(SubsamplingLayer(poolingType="max", kernelSize=(2, 2),
+                                     stride=(2, 2)))
+    return b
+
+
+class VGG16(ZooModel):
+    """ref: zoo.model.VGG16 (BASELINE config #1)."""
+
+    PLAN = [(2, 64), (2, 128), (3, 256), (3, 512), (3, 512)]
+
+    def conf_builder(self) -> MultiLayerNetwork:
+        c, h, w = self.input_shape
+        b = (NeuralNetConfiguration.Builder()
+             .seed(self.seed).updater(self.updater).weightInit("relu")
+             .list())
+        b = _vgg_blocks(b, self.PLAN)
+        b = (b.layer(DenseLayer(nOut=4096, activation="relu", dropOut=0.5))
+             .layer(DenseLayer(nOut=4096, activation="relu", dropOut=0.5))
+             .layer(OutputLayer(nOut=self.num_classes, lossFunction="mcxent",
+                                activation="softmax"))
+             .setInputType(InputType.convolutional(h, w, c)))
+        return MultiLayerNetwork(b.build())
+
+
+class VGG19(VGG16):
+    """ref: zoo.model.VGG19."""
+
+    PLAN = [(2, 64), (2, 128), (4, 256), (4, 512), (4, 512)]
+
+
+class ResNet50(ZooModel):
+    """ref: zoo.model.ResNet50 (BASELINE north-star model) — bottleneck
+    residual blocks as a ComputationGraph with ElementWiseVertex adds."""
+
+    def conf_builder(self) -> ComputationGraph:
+        c, h, w = self.input_shape
+        g = (NeuralNetConfiguration.Builder()
+             .seed(self.seed).updater(self.updater).weightInit("relu")
+             .graphBuilder()
+             .addInputs("input")
+             .setInputTypes(InputType.convolutional(h, w, c)))
+
+        # stem
+        g.addLayer("stem_conv", ConvolutionLayer(kernelSize=(7, 7), stride=(2, 2),
+                                                 padding=(3, 3), nOut=64,
+                                                 activation="identity"), "input")
+        g.addLayer("stem_bn", BatchNormalization(), "stem_conv")
+        g.addLayer("stem_relu", ActivationLayer("relu"), "stem_bn")
+        g.addLayer("stem_pool", SubsamplingLayer(poolingType="max",
+                                                 kernelSize=(3, 3), stride=(2, 2),
+                                                 padding=(1, 1)), "stem_relu")
+        last = "stem_pool"
+        stages = [(3, 64, 256, 1), (4, 128, 512, 2), (6, 256, 1024, 2),
+                  (3, 512, 2048, 2)]
+        for si, (blocks, mid, out, first_stride) in enumerate(stages):
+            for bi in range(blocks):
+                stride = first_stride if bi == 0 else 1
+                pref = f"s{si}b{bi}"
+                # main path: 1x1 -> 3x3 -> 1x1 with BN
+                g.addLayer(f"{pref}_c1", ConvolutionLayer(kernelSize=(1, 1),
+                                                          stride=(stride, stride),
+                                                          nOut=mid,
+                                                          activation="identity"), last)
+                g.addLayer(f"{pref}_bn1", BatchNormalization(), f"{pref}_c1")
+                g.addLayer(f"{pref}_r1", ActivationLayer("relu"), f"{pref}_bn1")
+                g.addLayer(f"{pref}_c2", ConvolutionLayer(kernelSize=(3, 3),
+                                                          padding=(1, 1), nOut=mid,
+                                                          activation="identity"),
+                           f"{pref}_r1")
+                g.addLayer(f"{pref}_bn2", BatchNormalization(), f"{pref}_c2")
+                g.addLayer(f"{pref}_r2", ActivationLayer("relu"), f"{pref}_bn2")
+                g.addLayer(f"{pref}_c3", ConvolutionLayer(kernelSize=(1, 1),
+                                                          nOut=out,
+                                                          activation="identity"),
+                           f"{pref}_r2")
+                g.addLayer(f"{pref}_bn3", BatchNormalization(), f"{pref}_c3")
+                # shortcut
+                if bi == 0:
+                    g.addLayer(f"{pref}_sc", ConvolutionLayer(kernelSize=(1, 1),
+                                                              stride=(stride, stride),
+                                                              nOut=out,
+                                                              activation="identity"),
+                               last)
+                    g.addLayer(f"{pref}_scbn", BatchNormalization(), f"{pref}_sc")
+                    shortcut = f"{pref}_scbn"
+                else:
+                    shortcut = last
+                g.addVertex(f"{pref}_add", ElementWiseVertex("Add"),
+                            f"{pref}_bn3", shortcut)
+                g.addLayer(f"{pref}_out", ActivationLayer("relu"), f"{pref}_add")
+                last = f"{pref}_out"
+        g.addLayer("avgpool", GlobalPoolingLayer("avg"), last)
+        g.addLayer("fc", OutputLayer(nOut=self.num_classes, lossFunction="mcxent",
+                                     activation="softmax"), "avgpool")
+        g.setOutputs("fc")
+        return ComputationGraph(g.build())
+
+
+class Darknet19(ZooModel):
+    """ref: zoo.model.Darknet19 (YOLO backbone)."""
+
+    def default_input_shape(self):
+        return (3, 224, 224)
+
+    def conf_builder(self) -> MultiLayerNetwork:
+        c, h, w = self.input_shape
+        b = (NeuralNetConfiguration.Builder()
+             .seed(self.seed).updater(self.updater).weightInit("relu")
+             .list())
+
+        def conv_bn(b, n_out, k):
+            pad = (k // 2, k // 2)
+            b = b.layer(ConvolutionLayer(kernelSize=(k, k), padding=pad,
+                                         nOut=n_out, activation="identity"))
+            b = b.layer(BatchNormalization())
+            return b.layer(ActivationLayer("leakyrelu"))
+
+        def maxpool(b):
+            return b.layer(SubsamplingLayer(poolingType="max",
+                                            kernelSize=(2, 2), stride=(2, 2)))
+
+        b = conv_bn(b, 32, 3)
+        b = maxpool(b)
+        b = conv_bn(b, 64, 3)
+        b = maxpool(b)
+        for trio in [(128, 64), (256, 128)]:
+            big, small = trio
+            b = conv_bn(b, big, 3)
+            b = conv_bn(b, small, 1)
+            b = conv_bn(b, big, 3)
+            b = maxpool(b)
+        for penta in [(512, 256), (1024, 512)]:
+            big, small = penta
+            b = conv_bn(b, big, 3)
+            b = conv_bn(b, small, 1)
+            b = conv_bn(b, big, 3)
+            b = conv_bn(b, small, 1)
+            b = conv_bn(b, big, 3)
+            if big == 512:
+                b = maxpool(b)
+        b = b.layer(ConvolutionLayer(kernelSize=(1, 1), nOut=self.num_classes,
+                                     activation="identity"))
+        b = (b.layer(GlobalPoolingLayer("avg"))
+             .layer(OutputLayer(nOut=self.num_classes, lossFunction="mcxent",
+                                activation="softmax"))
+             .setInputType(InputType.convolutional(h, w, c)))
+        return MultiLayerNetwork(b.build())
+
+
+class SqueezeNet(ZooModel):
+    """ref: zoo.model.SqueezeNet — fire modules via MergeVertex."""
+
+    def conf_builder(self) -> ComputationGraph:
+        c, h, w = self.input_shape
+        g = (NeuralNetConfiguration.Builder()
+             .seed(self.seed).updater(self.updater).weightInit("relu")
+             .graphBuilder()
+             .addInputs("input")
+             .setInputTypes(InputType.convolutional(h, w, c)))
+        g.addLayer("stem", ConvolutionLayer(kernelSize=(3, 3), stride=(2, 2),
+                                            nOut=64, activation="relu"), "input")
+        g.addLayer("pool0", SubsamplingLayer(poolingType="max", kernelSize=(3, 3),
+                                             stride=(2, 2)), "stem")
+        last = "pool0"
+
+        def fire(g, name, inp, squeeze, expand):
+            g.addLayer(f"{name}_sq", ConvolutionLayer(kernelSize=(1, 1),
+                                                      nOut=squeeze,
+                                                      activation="relu"), inp)
+            g.addLayer(f"{name}_e1", ConvolutionLayer(kernelSize=(1, 1),
+                                                      nOut=expand,
+                                                      activation="relu"),
+                       f"{name}_sq")
+            g.addLayer(f"{name}_e3", ConvolutionLayer(kernelSize=(3, 3),
+                                                      padding=(1, 1), nOut=expand,
+                                                      activation="relu"),
+                       f"{name}_sq")
+            g.addVertex(f"{name}_cat", MergeVertex(), f"{name}_e1", f"{name}_e3")
+            return f"{name}_cat"
+
+        last = fire(g, "fire2", last, 16, 64)
+        last = fire(g, "fire3", last, 16, 64)
+        g.addLayer("pool3", SubsamplingLayer(poolingType="max", kernelSize=(3, 3),
+                                             stride=(2, 2)), last)
+        last = fire(g, "fire4", "pool3", 32, 128)
+        last = fire(g, "fire5", last, 32, 128)
+        g.addLayer("pool5", SubsamplingLayer(poolingType="max", kernelSize=(3, 3),
+                                             stride=(2, 2)), last)
+        last = fire(g, "fire6", "pool5", 48, 192)
+        last = fire(g, "fire7", last, 48, 192)
+        last = fire(g, "fire8", last, 64, 256)
+        last = fire(g, "fire9", last, 64, 256)
+        g.addLayer("drop", DropoutLayer(dropOut=0.5), last)
+        g.addLayer("conv10", ConvolutionLayer(kernelSize=(1, 1),
+                                              nOut=self.num_classes,
+                                              activation="relu"), "drop")
+        g.addLayer("gap", GlobalPoolingLayer("avg"), "conv10")
+        g.addLayer("out", OutputLayer(nOut=self.num_classes, lossFunction="mcxent",
+                                      activation="softmax"), "gap")
+        g.setOutputs("out")
+        return ComputationGraph(g.build())
+
+
+class UNet(ZooModel):
+    """ref: zoo.model.UNet — encoder/decoder with skip merges; output is a
+    per-pixel sigmoid map."""
+
+    def default_input_shape(self):
+        return (3, 128, 128)
+
+    def conf_builder(self) -> ComputationGraph:
+        from deeplearning4j_tpu.nn.layers import LossLayer
+        c, h, w = self.input_shape
+        g = (NeuralNetConfiguration.Builder()
+             .seed(self.seed).updater(self.updater).weightInit("relu")
+             .graphBuilder()
+             .addInputs("input")
+             .setInputTypes(InputType.convolutional(h, w, c)))
+
+        def double_conv(g, name, inp, n):
+            g.addLayer(f"{name}_c1", ConvolutionLayer(kernelSize=(3, 3),
+                                                      padding=(1, 1), nOut=n,
+                                                      activation="relu"), inp)
+            g.addLayer(f"{name}_c2", ConvolutionLayer(kernelSize=(3, 3),
+                                                      padding=(1, 1), nOut=n,
+                                                      activation="relu"),
+                       f"{name}_c1")
+            return f"{name}_c2"
+
+        enc_outs = []
+        last = "input"
+        for i, n in enumerate([32, 64, 128]):
+            last = double_conv(g, f"enc{i}", last, n)
+            enc_outs.append(last)
+            g.addLayer(f"pool{i}", SubsamplingLayer(poolingType="max",
+                                                    kernelSize=(2, 2),
+                                                    stride=(2, 2)), last)
+            last = f"pool{i}"
+        last = double_conv(g, "bottom", last, 256)
+        for i, n in zip(reversed(range(3)), [128, 64, 32]):
+            g.addLayer(f"up{i}", Upsampling2D(size=2), last)
+            g.addVertex(f"cat{i}", MergeVertex(), f"up{i}", enc_outs[i])
+            last = double_conv(g, f"dec{i}", f"cat{i}", n)
+        g.addLayer("head", ConvolutionLayer(kernelSize=(1, 1), nOut=1,
+                                            activation="sigmoid"), last)
+        g.addLayer("out", LossLayer(lossFunction="xent", activation="identity"),
+                   "head")
+        g.setOutputs("out")
+        return ComputationGraph(g.build())
+
+
+class Xception(ZooModel):
+    """ref: zoo.model.Xception — separable-conv stacks (middle flow
+    shortened to 4 blocks for practicality; same structure)."""
+
+    def conf_builder(self) -> ComputationGraph:
+        c, h, w = self.input_shape
+        g = (NeuralNetConfiguration.Builder()
+             .seed(self.seed).updater(self.updater).weightInit("relu")
+             .graphBuilder()
+             .addInputs("input")
+             .setInputTypes(InputType.convolutional(h, w, c)))
+        g.addLayer("stem1", ConvolutionLayer(kernelSize=(3, 3), stride=(2, 2),
+                                             nOut=32, activation="relu"), "input")
+        g.addLayer("stem2", ConvolutionLayer(kernelSize=(3, 3), nOut=64,
+                                             activation="relu"), "stem1")
+        last = "stem2"
+        for i, n in enumerate([128, 256, 728]):
+            pref = f"entry{i}"
+            g.addLayer(f"{pref}_s1", SeparableConvolution2D(kernelSize=(3, 3),
+                                                            padding=(1, 1), nOut=n,
+                                                            activation="relu"), last)
+            g.addLayer(f"{pref}_s2", SeparableConvolution2D(kernelSize=(3, 3),
+                                                            padding=(1, 1), nOut=n,
+                                                            activation="identity"),
+                       f"{pref}_s1")
+            g.addLayer(f"{pref}_pool", SubsamplingLayer(poolingType="max",
+                                                        kernelSize=(3, 3),
+                                                        stride=(2, 2),
+                                                        padding=(1, 1)),
+                       f"{pref}_s2")
+            g.addLayer(f"{pref}_sc", ConvolutionLayer(kernelSize=(1, 1),
+                                                      stride=(2, 2), nOut=n,
+                                                      activation="identity"), last)
+            g.addVertex(f"{pref}_add", ElementWiseVertex("Add"),
+                        f"{pref}_pool", f"{pref}_sc")
+            last = f"{pref}_add"
+        for i in range(4):  # middle flow
+            pref = f"mid{i}"
+            inp = last
+            cur = inp
+            for j in range(3):
+                g.addLayer(f"{pref}_s{j}", SeparableConvolution2D(
+                    kernelSize=(3, 3), padding=(1, 1), nOut=728,
+                    activation="relu"), cur)
+                cur = f"{pref}_s{j}"
+            g.addVertex(f"{pref}_add", ElementWiseVertex("Add"), cur, inp)
+            last = f"{pref}_add"
+        g.addLayer("exit_s1", SeparableConvolution2D(kernelSize=(3, 3),
+                                                     padding=(1, 1), nOut=1024,
+                                                     activation="relu"), last)
+        g.addLayer("exit_s2", SeparableConvolution2D(kernelSize=(3, 3),
+                                                     padding=(1, 1), nOut=1536,
+                                                     activation="relu"), "exit_s1")
+        g.addLayer("gap", GlobalPoolingLayer("avg"), "exit_s2")
+        g.addLayer("out", OutputLayer(nOut=self.num_classes, lossFunction="mcxent",
+                                      activation="softmax"), "gap")
+        g.setOutputs("out")
+        return ComputationGraph(g.build())
+
+
+class FaceNetNN4Small2(ZooModel):
+    """ref: zoo.model.FaceNetNN4Small2 — inception-style embedding net with
+    an L2-normalized embedding output (triplet training uses the embedding)."""
+
+    def default_input_shape(self):
+        return (3, 96, 96)
+
+    def conf_builder(self) -> ComputationGraph:
+        from deeplearning4j_tpu.nn.graph import L2NormalizeVertex
+        c, h, w = self.input_shape
+        g = (NeuralNetConfiguration.Builder()
+             .seed(self.seed).updater(self.updater).weightInit("relu")
+             .graphBuilder()
+             .addInputs("input")
+             .setInputTypes(InputType.convolutional(h, w, c)))
+        g.addLayer("c1", ConvolutionLayer(kernelSize=(7, 7), stride=(2, 2),
+                                          padding=(3, 3), nOut=64,
+                                          activation="relu"), "input")
+        g.addLayer("p1", SubsamplingLayer(poolingType="max", kernelSize=(3, 3),
+                                          stride=(2, 2), padding=(1, 1)), "c1")
+        g.addLayer("c2", ConvolutionLayer(kernelSize=(1, 1), nOut=64,
+                                          activation="relu"), "p1")
+        g.addLayer("c3", ConvolutionLayer(kernelSize=(3, 3), padding=(1, 1),
+                                          nOut=192, activation="relu"), "c2")
+        g.addLayer("p2", SubsamplingLayer(poolingType="max", kernelSize=(3, 3),
+                                          stride=(2, 2), padding=(1, 1)), "c3")
+        last = "p2"
+        for i, (n1, n3r, n3) in enumerate([(64, 96, 128), (64, 96, 128),
+                                           (128, 128, 256)]):
+            pref = f"inc{i}"
+            g.addLayer(f"{pref}_1", ConvolutionLayer(kernelSize=(1, 1), nOut=n1,
+                                                     activation="relu"), last)
+            g.addLayer(f"{pref}_3r", ConvolutionLayer(kernelSize=(1, 1), nOut=n3r,
+                                                      activation="relu"), last)
+            g.addLayer(f"{pref}_3", ConvolutionLayer(kernelSize=(3, 3),
+                                                     padding=(1, 1), nOut=n3,
+                                                     activation="relu"),
+                       f"{pref}_3r")
+            g.addVertex(f"{pref}_cat", MergeVertex(), f"{pref}_1", f"{pref}_3")
+            last = f"{pref}_cat"
+        g.addLayer("gap", GlobalPoolingLayer("avg"), last)
+        g.addLayer("embed", DenseLayer(nOut=128, activation="identity"), "gap")
+        g.addVertex("l2", L2NormalizeVertex(), "embed")
+        g.addLayer("out", OutputLayer(nOut=self.num_classes, lossFunction="mcxent",
+                                      activation="softmax"), "l2")
+        g.setOutputs("out")
+        return ComputationGraph(g.build())
+
+
+class TextGenerationLSTM(ZooModel):
+    """ref: zoo.model.TextGenerationLSTM — char-level 2-layer LSTM."""
+
+    def __init__(self, vocab_size: int = 77, **kw):
+        self.vocab_size = vocab_size
+        super().__init__(num_classes=vocab_size, **kw)
+
+    def default_input_shape(self):
+        return (self.vocab_size, 60)
+
+    def conf_builder(self) -> MultiLayerNetwork:
+        n_in, t = self.input_shape
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(self.seed).updater(self.updater).weightInit("xavier")
+                .gradientNormalization("clip_value", 5.0)
+                .list()
+                .layer(LSTM(nOut=256))
+                .layer(LSTM(nOut=256))
+                .layer(RnnOutputLayer(nOut=self.vocab_size, lossFunction="mcxent",
+                                      activation="softmax"))
+                .setInputType(InputType.recurrent(n_in, t))
+                .build())
+        return MultiLayerNetwork(conf)
+
+
+class TinyYOLO(ZooModel):
+    """ref: zoo.model.TinyYOLO (BASELINE config #2) — darknet-tiny backbone
+    + Yolo2OutputLayer with the reference's VOC anchor priors."""
+
+    ANCHORS = [[1.08, 1.19], [3.42, 4.41], [6.63, 11.38], [9.42, 5.11],
+               [16.62, 10.52]]
+
+    def __init__(self, num_classes: int = 20, **kw):
+        super().__init__(num_classes=num_classes, **kw)
+
+    def default_input_shape(self):
+        return (3, 416, 416)
+
+    def conf_builder(self) -> MultiLayerNetwork:
+        from deeplearning4j_tpu.nn.objdetect import Yolo2OutputLayer
+        c, h, w = self.input_shape
+        n_boxes = len(self.ANCHORS)
+        b = (NeuralNetConfiguration.Builder()
+             .seed(self.seed).updater(self.updater).weightInit("relu")
+             .list())
+
+        def conv_bn(b, n_out):
+            b = b.layer(ConvolutionLayer(kernelSize=(3, 3), padding=(1, 1),
+                                         nOut=n_out, activation="identity"))
+            b = b.layer(BatchNormalization())
+            return b.layer(ActivationLayer("leakyrelu"))
+
+        for i, n_out in enumerate([16, 32, 64, 128, 256]):
+            b = conv_bn(b, n_out)
+            b = b.layer(SubsamplingLayer(poolingType="max", kernelSize=(2, 2),
+                                         stride=(2, 2)))
+        b = conv_bn(b, 512)
+        b = b.layer(SubsamplingLayer(poolingType="max", kernelSize=(2, 2),
+                                     stride=(1, 1), padding=(1, 1),
+                                     convolutionMode="same"))
+        b = conv_bn(b, 1024)
+        b = conv_bn(b, 1024)
+        b = b.layer(ConvolutionLayer(kernelSize=(1, 1),
+                                     nOut=n_boxes * (5 + self.num_classes),
+                                     activation="identity"))
+        b = (b.layer(Yolo2OutputLayer(boundingBoxPriors=self.ANCHORS))
+             .setInputType(InputType.convolutional(h, w, c)))
+        return MultiLayerNetwork(b.build())
+
+
+class YOLO2(ZooModel):
+    """ref: zoo.model.YOLO2 (BASELINE config #2) — Darknet19 backbone +
+    passthrough route + Yolo2OutputLayer, COCO anchors."""
+
+    ANCHORS = [[0.57273, 0.677385], [1.87446, 2.06253], [3.33843, 5.47434],
+               [7.88282, 3.52778], [9.77052, 9.16828]]
+
+    def __init__(self, num_classes: int = 80, **kw):
+        super().__init__(num_classes=num_classes, **kw)
+
+    def default_input_shape(self):
+        return (3, 416, 416)
+
+    def conf_builder(self) -> ComputationGraph:
+        from deeplearning4j_tpu.nn.graph import PreprocessorVertex
+        from deeplearning4j_tpu.nn.objdetect import Yolo2OutputLayer
+        c, h, w = self.input_shape
+        n_boxes = len(self.ANCHORS)
+        g = (NeuralNetConfiguration.Builder()
+             .seed(self.seed).updater(self.updater).weightInit("relu")
+             .graphBuilder()
+             .addInputs("input")
+             .setInputTypes(InputType.convolutional(h, w, c)))
+
+        def conv_bn(g, name, inp, n_out, k=3):
+            pad = (k // 2, k // 2)
+            g.addLayer(f"{name}_c", ConvolutionLayer(kernelSize=(k, k),
+                                                     padding=pad, nOut=n_out,
+                                                     activation="identity"), inp)
+            g.addLayer(f"{name}_bn", BatchNormalization(), f"{name}_c")
+            g.addLayer(name, ActivationLayer("leakyrelu"), f"{name}_bn")
+            return name
+
+        last = conv_bn(g, "c1", "input", 32)
+        g.addLayer("p1", SubsamplingLayer(poolingType="max", kernelSize=(2, 2),
+                                          stride=(2, 2)), last)
+        last = conv_bn(g, "c2", "p1", 64)
+        g.addLayer("p2", SubsamplingLayer(poolingType="max", kernelSize=(2, 2),
+                                          stride=(2, 2)), last)
+        spec = [(128, 64, "p3"), (256, 128, "p4")]
+        inp = "p2"
+        for big, small, pool in spec:
+            a = conv_bn(g, f"{pool}a", inp, big)
+            bmid = conv_bn(g, f"{pool}b", a, small, k=1)
+            cend = conv_bn(g, f"{pool}c", bmid, big)
+            g.addLayer(pool, SubsamplingLayer(poolingType="max",
+                                              kernelSize=(2, 2), stride=(2, 2)),
+                       cend)
+            inp = pool
+        # stage 5 (ends at 26x26 with 512 ch — the passthrough source)
+        a = conv_bn(g, "s5a", "p4", 512)
+        bmid = conv_bn(g, "s5b", a, 256, k=1)
+        cend = conv_bn(g, "s5c", bmid, 512)
+        d = conv_bn(g, "s5d", cend, 256, k=1)
+        route = conv_bn(g, "s5e", d, 512)
+        g.addLayer("p5", SubsamplingLayer(poolingType="max", kernelSize=(2, 2),
+                                          stride=(2, 2)), route)
+        # stage 6 at 13x13
+        a = conv_bn(g, "s6a", "p5", 1024)
+        bmid = conv_bn(g, "s6b", a, 512, k=1)
+        cend = conv_bn(g, "s6c", bmid, 1024)
+        d = conv_bn(g, "s6d", cend, 512, k=1)
+        e = conv_bn(g, "s6e", d, 1024)
+        f = conv_bn(g, "det1", e, 1024)
+        f = conv_bn(g, "det2", f, 1024)
+        # passthrough: space_to_depth(route 26x26x512 -> 13x13x2048), concat
+        from deeplearning4j_tpu.nn.preprocessors import Preprocessor
+
+        class _SpaceToDepth(Preprocessor):
+            def __call__(self, x):
+                from deeplearning4j_tpu.ops.convolution import space_to_depth
+                return space_to_depth(x, 2)
+
+            def output_type(self, it):
+                return InputType.convolutional(it.height // 2, it.width // 2,
+                                               it.channels * 4)
+
+        g.addVertex("passthrough", PreprocessorVertex(_SpaceToDepth()), route)
+        g.addVertex("route_cat", MergeVertex(), "passthrough", f)
+        last = conv_bn(g, "head", "route_cat", 1024)
+        g.addLayer("conv_out", ConvolutionLayer(
+            kernelSize=(1, 1), nOut=n_boxes * (5 + self.num_classes),
+            activation="identity"), last)
+        g.addLayer("yolo", Yolo2OutputLayer(boundingBoxPriors=self.ANCHORS),
+                   "conv_out")
+        g.setOutputs("yolo")
+        return ComputationGraph(g.build())

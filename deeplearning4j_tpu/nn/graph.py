@@ -1,0 +1,667 @@
+"""ComputationGraph — DAG networks with graph vertices.
+
+Reference parity: ``org.deeplearning4j.nn.graph.ComputationGraph`` +
+``ComputationGraphConfiguration.GraphBuilder`` + vertex impls
+``org.deeplearning4j.nn.graph.vertex.impl.{MergeVertex, ElementWiseVertex,
+SubsetVertex, L2NormalizeVertex, ScaleVertex, ShiftVertex, StackVertex,
+UnstackVertex, PreprocessorVertex}`` (SURVEY.md §2.2 "ComputationGraph
+vertices", call stack §3.2). ResNet skip connections and YOLO routes are
+built from these.
+
+TPU-native: same design as MultiLayerNetwork — the whole DAG traces into
+ONE compiled step; topological order is computed once from the config.
+Multiple inputs and multiple outputs (MultiDataSet) are supported.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet, DataSetIterator, MultiDataSet
+from deeplearning4j_tpu.evaluation.evaluation import Evaluation
+from deeplearning4j_tpu.nn import layers as L
+from deeplearning4j_tpu.nn import preprocessors as pp
+from deeplearning4j_tpu.nn.config import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.train import updaters as upd
+
+_MASK_AWARE = (L.LSTM, L.SimpleRnn, L.Bidirectional, L.LastTimeStep,
+               L.GlobalPoolingLayer)
+
+
+class GraphVertex:
+    """Non-layer DAG node (ref: org.deeplearning4j.nn.conf.graph.*Vertex)."""
+
+    def apply(self, *inputs):
+        raise NotImplementedError
+
+    def output_type(self, *input_types: InputType) -> InputType:
+        return input_types[0]
+
+    def to_config(self):
+        d = {"@class": type(self).__name__}
+        d.update({k: (list(v) if isinstance(v, tuple) else v)
+                  for k, v in self.__dict__.items()})
+        return d
+
+    @classmethod
+    def from_config(cls, d):
+        obj = cls.__new__(cls)
+        for k, v in d.items():
+            if k != "@class":
+                setattr(obj, k, v)
+        return obj
+
+
+class MergeVertex(GraphVertex):
+    """Concat along the channel/feature axis (ref: MergeVertex)."""
+
+    def apply(self, *inputs):
+        axis = 1 if inputs[0].ndim >= 3 else -1
+        return jnp.concatenate(inputs, axis=axis)
+
+    def output_type(self, *its: InputType) -> InputType:
+        it = its[0]
+        if it.kind == "cnn":
+            return InputType.convolutional(it.height, it.width,
+                                           sum(i.channels for i in its))
+        if it.kind == "rnn":
+            return InputType.recurrent(sum(i.size for i in its),
+                                       it.dims.get("timesteps", -1))
+        return InputType.feedForward(sum(i.arrayElementsPerExample() for i in its))
+
+
+class ElementWiseVertex(GraphVertex):
+    """Add/Product/Subtract/Average/Max of same-shape inputs
+    (ref: ElementWiseVertex). The ResNet residual-add."""
+
+    def __init__(self, op: str = "Add"):
+        self.op = op.lower()
+
+    def apply(self, *inputs):
+        if self.op == "add":
+            out = inputs[0]
+            for i in inputs[1:]:
+                out = out + i
+            return out
+        if self.op == "product":
+            out = inputs[0]
+            for i in inputs[1:]:
+                out = out * i
+            return out
+        if self.op == "subtract":
+            return inputs[0] - inputs[1]
+        if self.op == "average":
+            return sum(inputs) / len(inputs)
+        if self.op == "max":
+            out = inputs[0]
+            for i in inputs[1:]:
+                out = jnp.maximum(out, i)
+            return out
+        raise ValueError(self.op)
+
+
+class SubsetVertex(GraphVertex):
+    """Channel-range slice (ref: SubsetVertex)."""
+
+    def __init__(self, frm: int, to: int):
+        self.frm, self.to = frm, to
+
+    def apply(self, x):
+        if x.ndim >= 3:
+            return x[:, self.frm:self.to + 1]
+        return x[:, self.frm:self.to + 1]
+
+    def output_type(self, it: InputType) -> InputType:
+        n = self.to - self.frm + 1
+        if it.kind == "cnn":
+            return InputType.convolutional(it.height, it.width, n)
+        if it.kind == "rnn":
+            return InputType.recurrent(n, it.dims.get("timesteps", -1))
+        return InputType.feedForward(n)
+
+
+class L2NormalizeVertex(GraphVertex):
+    """Per-example L2 normalize (ref: L2NormalizeVertex; FaceNet uses it)."""
+
+    def __init__(self, eps: float = 1e-8):
+        self.eps = eps
+
+    def apply(self, x):
+        flat = x.reshape(x.shape[0], -1)
+        n = jnp.sqrt(jnp.sum(flat * flat, axis=1, keepdims=True))
+        out = flat / jnp.maximum(n, self.eps)
+        return out.reshape(x.shape)
+
+
+class ScaleVertex(GraphVertex):
+    """(ref: ScaleVertex)"""
+
+    def __init__(self, scale: float):
+        self.scale = scale
+
+    def apply(self, x):
+        return x * self.scale
+
+
+class ShiftVertex(GraphVertex):
+    """(ref: ShiftVertex)"""
+
+    def __init__(self, shift: float):
+        self.shift = shift
+
+    def apply(self, x):
+        return x + self.shift
+
+
+class StackVertex(GraphVertex):
+    """Stack along batch (ref: StackVertex)."""
+
+    def apply(self, *inputs):
+        return jnp.concatenate(inputs, axis=0)
+
+
+class UnstackVertex(GraphVertex):
+    """Take slice i of a StackVertex output (ref: UnstackVertex)."""
+
+    def __init__(self, frm: int, stack_size: int):
+        self.frm, self.stack_size = frm, stack_size
+
+    def apply(self, x):
+        n = x.shape[0] // self.stack_size
+        return x[self.frm * n:(self.frm + 1) * n]
+
+
+class PreprocessorVertex(GraphVertex):
+    """Wraps an input preprocessor as a vertex (ref: PreprocessorVertex)."""
+
+    def __init__(self, preproc):
+        self.preproc = preproc
+
+    def apply(self, x):
+        return self.preproc(x)
+
+    def output_type(self, it: InputType) -> InputType:
+        return self.preproc.output_type(it)
+
+    def to_config(self):
+        return {"@class": "PreprocessorVertex",
+                "preproc_class": type(self.preproc).__name__,
+                "preproc_args": dict(self.preproc.__dict__)}
+
+    @classmethod
+    def from_config(cls, d):
+        pc = getattr(pp, d["preproc_class"])
+        obj = pc.__new__(pc)
+        obj.__dict__.update(d["preproc_args"])
+        return PreprocessorVertex(obj)
+
+
+_VERTEX_CLASSES = {c.__name__: c for c in
+                   [MergeVertex, ElementWiseVertex, SubsetVertex,
+                    L2NormalizeVertex, ScaleVertex, ShiftVertex, StackVertex,
+                    UnstackVertex, PreprocessorVertex]}
+
+
+class _GraphNode:
+    def __init__(self, name: str, kind: str, obj, inputs: List[str]):
+        self.name = name
+        self.kind = kind      # 'layer' | 'vertex'
+        self.obj = obj
+        self.inputs = inputs
+
+
+class GraphBuilder:
+    """ref: ComputationGraphConfiguration.GraphBuilder."""
+
+    def __init__(self, base: NeuralNetConfiguration):
+        self.base = base
+        self.nodes: List[_GraphNode] = []
+        self.graph_inputs: List[str] = []
+        self.graph_outputs: List[str] = []
+        self.input_types: Dict[str, InputType] = {}
+
+    def addInputs(self, *names):
+        self.graph_inputs.extend(names)
+        return self
+
+    def setInputTypes(self, *types):
+        for name, t in zip(self.graph_inputs, types):
+            self.input_types[name] = t
+        return self
+
+    def addLayer(self, name: str, layer, *inputs):
+        layer.name = name
+        self.nodes.append(_GraphNode(name, "layer", layer, list(inputs)))
+        return self
+
+    def addVertex(self, name: str, vertex: GraphVertex, *inputs):
+        self.nodes.append(_GraphNode(name, "vertex", vertex, list(inputs)))
+        return self
+
+    def setOutputs(self, *names):
+        self.graph_outputs = list(names)
+        return self
+
+    def build(self) -> "ComputationGraphConfiguration":
+        return ComputationGraphConfiguration(self)
+
+
+class ComputationGraphConfiguration:
+    """ref: org.deeplearning4j.nn.conf.ComputationGraphConfiguration."""
+
+    def __init__(self, builder: GraphBuilder):
+        self.base = builder.base
+        self.nodes = builder.nodes
+        self.graph_inputs = builder.graph_inputs
+        self.graph_outputs = builder.graph_outputs
+        self.input_types = builder.input_types
+        self.preprocessors: Dict[str, Any] = {}
+        self.node_by_name = {n.name: n for n in self.nodes}
+        self._toposort()
+        if self.input_types:
+            self._propagate_types()
+
+    def _toposort(self):
+        order, seen = [], set(self.graph_inputs)
+        remaining = list(self.nodes)
+        while remaining:
+            progressed = False
+            for n in list(remaining):
+                if all(i in seen for i in n.inputs):
+                    order.append(n)
+                    seen.add(n.name)
+                    remaining.remove(n)
+                    progressed = True
+            if not progressed:
+                missing = {i for n in remaining for i in n.inputs if i not in seen}
+                raise ValueError(f"graph has unresolved inputs/cycle: {missing}")
+        self.topo = order
+
+    def _propagate_types(self):
+        types: Dict[str, InputType] = dict(self.input_types)
+        for node in self.topo:
+            in_types = [types[i] for i in node.inputs]
+            if node.kind == "layer":
+                layer = node.obj
+                pre = pp.preprocessor_for(in_types[0], layer)
+                if pre is not None:
+                    self.preprocessors[node.name] = pre
+                    in_types[0] = pre.output_type(in_types[0])
+                layer.set_defaults(self.base)
+                layer.infer_nin(in_types[0])
+                types[node.name] = layer.output_type(in_types[0])
+            else:
+                types[node.name] = node.obj.output_type(*in_types)
+        self.types = types
+
+    def to_json(self) -> str:
+        import json
+        return json.dumps({
+            "base": self.base.to_config(),
+            "inputs": self.graph_inputs,
+            "outputs": self.graph_outputs,
+            "input_types": {k: v.to_config() for k, v in self.input_types.items()},
+            "nodes": [{"name": n.name, "kind": n.kind,
+                       "inputs": n.inputs, "conf": n.obj.to_config()}
+                      for n in self.nodes],
+        })
+
+    @staticmethod
+    def from_json(s: str) -> "ComputationGraphConfiguration":
+        import json
+        d = json.loads(s)
+        b = GraphBuilder(NeuralNetConfiguration.from_config(d["base"]))
+        b.addInputs(*d["inputs"])
+        b.input_types = {k: InputType.from_config(v)
+                         for k, v in d["input_types"].items()}
+        for nd in d["nodes"]:
+            if nd["kind"] == "layer":
+                obj = L.layer_from_config(nd["conf"])
+                b.addLayer(nd["name"], obj, *nd["inputs"])
+            else:
+                cls = _VERTEX_CLASSES[nd["conf"]["@class"]]
+                b.addVertex(nd["name"], cls.from_config(nd["conf"]), *nd["inputs"])
+        b.setOutputs(*d["outputs"])
+        return ComputationGraphConfiguration(b)
+
+
+class ComputationGraph:
+    """DAG network (ref: org.deeplearning4j.nn.graph.ComputationGraph)."""
+
+    def __init__(self, conf: ComputationGraphConfiguration):
+        self.conf = conf
+        self._params: Dict[str, Dict] = {}
+        self._states: Dict[str, Dict] = {}
+        self._opt_state = None
+        self._iteration = 0
+        self._epoch = 0
+        self._score = float("nan")
+        self._listeners: List[Any] = []
+        self._train_step_cache = {}
+        self._fwd_cache = None
+        self._initialized = False
+
+    def init(self, seed: int = None):
+        seed = self.conf.base.seed if seed is None else seed
+        key = jax.random.PRNGKey(seed)
+        self._params, self._states = {}, {}
+        for node in self.conf.topo:
+            if node.kind == "layer":
+                key, sub = jax.random.split(key)
+                p, s = node.obj.initialize(sub)
+                self._params[node.name] = p
+                self._states[node.name] = s
+        self._opt_state = None
+        self._train_step_cache = {}
+        self._fwd_cache = None
+        self._initialized = True
+        return self
+
+    # --------------------------------------------------------------- forward
+    def _forward(self, params, states, inputs: Dict[str, Any], train, key,
+                 fmask=None):
+        env = dict(inputs)
+        new_states = {}
+        for node in self.conf.topo:
+            xs = [env[i] for i in node.inputs]
+            if node.kind == "layer":
+                x = xs[0]
+                if node.name in self.conf.preprocessors:
+                    x = self.conf.preprocessors[node.name](x)
+                key, sub = jax.random.split(key)
+                if isinstance(node.obj, _MASK_AWARE):
+                    out, ns = node.obj.apply(params[node.name], states[node.name],
+                                             x, train, sub, mask=fmask)
+                else:
+                    out, ns = node.obj.apply(params[node.name], states[node.name],
+                                             x, train, sub)
+                new_states[node.name] = ns
+            else:
+                out = node.obj.apply(*xs)
+            env[node.name] = out
+        return [env[o] for o in self.conf.graph_outputs], new_states
+
+    def _as_input_dict(self, inputs) -> Dict[str, jnp.ndarray]:
+        if isinstance(inputs, dict):
+            return {k: jnp.asarray(v) for k, v in inputs.items()}
+        if not isinstance(inputs, (list, tuple)):
+            inputs = [inputs]
+        return {name: jnp.asarray(a)
+                for name, a in zip(self.conf.graph_inputs, inputs)}
+
+    def output(self, *inputs, train: bool = False):
+        """ref: ComputationGraph.output — returns list of output arrays
+        (single array if one output)."""
+        ins = self._as_input_dict(inputs[0] if len(inputs) == 1 else list(inputs))
+        if self._fwd_cache is None:
+            def fwd(params, states, ins, key):
+                outs, _ = self._forward(params, states, ins, False, key)
+                return outs
+            self._fwd_cache = jax.jit(fwd)
+        outs = self._fwd_cache(self._params, self._states, ins,
+                               jax.random.PRNGKey(0))
+        return outs[0] if len(outs) == 1 else outs
+
+    def feedForward(self, inputs, train: bool = False):
+        ins = self._as_input_dict(inputs)
+        env = dict(ins)
+        key = jax.random.PRNGKey(0)
+        acts = {}
+        for node in self.conf.topo:
+            xs = [env[i] for i in node.inputs]
+            if node.kind == "layer":
+                x = xs[0]
+                if node.name in self.conf.preprocessors:
+                    x = self.conf.preprocessors[node.name](x)
+                key, sub = jax.random.split(key)
+                if isinstance(node.obj, _MASK_AWARE):
+                    out, _ = node.obj.apply(self._params[node.name],
+                                            self._states[node.name], x, train,
+                                            sub, mask=None)
+                else:
+                    out, _ = node.obj.apply(self._params[node.name],
+                                            self._states[node.name], x, train, sub)
+            else:
+                out = node.obj.apply(*xs)
+            env[node.name] = out
+            acts[node.name] = out
+        return acts
+
+    # ------------------------------------------------------------------ loss
+    def _output_layers(self):
+        outs = []
+        for name in self.conf.graph_outputs:
+            node = self.conf.node_by_name[name]
+            if node.kind != "layer" or not isinstance(node.obj, L.BaseOutputLayer):
+                raise ValueError(f"graph output '{name}' must be an output layer")
+            outs.append(node.obj)
+        return outs
+
+    def _loss_and_reg(self, params, states, ins, labels: List, train, key,
+                      fmask, lmasks: Optional[List]):
+        outs, new_states = self._forward(params, states, ins, train, key, fmask)
+        out_layers = self._output_layers()
+        loss = 0.0
+        for i, (ol, out) in enumerate(zip(out_layers, outs)):
+            lm = lmasks[i] if lmasks is not None else None
+            loss = loss + ol.compute_loss(labels[i], out, mask=lm)
+        reg = 0.0
+        for node in self.conf.topo:
+            if node.kind != "layer":
+                continue
+            layer = node.obj
+            l1 = layer.l1 or 0.0
+            l2 = layer.l2 or 0.0
+            p = params.get(node.name) or {}
+            if l1 == 0.0 and l2 == 0.0:
+                continue
+            for pname, w in p.items():
+                if not pname.startswith(("W", "RW")):
+                    continue
+                if l2:
+                    reg = reg + 0.5 * l2 * jnp.sum(jnp.square(w))
+                if l1:
+                    reg = reg + l1 * jnp.sum(jnp.abs(w))
+        return loss + reg, new_states
+
+    # ------------------------------------------------------------------- fit
+    def _make_train_step(self, with_lmasks: bool):
+        base = self.conf.base
+        updater = base.updater
+
+        def step(params, states, opt_state, t, ins, labels, lmasks, key):
+            def loss_fn(p):
+                return self._loss_and_reg(p, states, ins, labels, True, key,
+                                          None, lmasks if with_lmasks else None)
+            (loss, new_states), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            if base.grad_norm == "clip_value":
+                grads = upd.clip_by_value(grads, base.grad_norm_threshold)
+            elif base.grad_norm == "clip_l2":
+                grads = upd.clip_by_norm(grads, base.grad_norm_threshold)
+            elif base.grad_norm == "clip_global":
+                grads = upd.clip_by_global_norm(grads, base.grad_norm_threshold)
+            lr = updater.lr_at(t)
+            leaves, treedef = jax.tree_util.tree_flatten(params)
+            g_leaves = treedef.flatten_up_to(grads)
+            s_leaves = treedef.flatten_up_to(opt_state)
+            new_p, new_s = [], []
+            for pv, gv, sv in zip(leaves, g_leaves, s_leaves):
+                u, s2 = updater.apply(gv, sv, lr, t)
+                if isinstance(updater, upd.AdamW) and updater.weight_decay:
+                    u = u + updater.weight_decay_update(pv, lr)
+                new_p.append(pv - u)
+                new_s.append(s2)
+            return (jax.tree_util.tree_unflatten(treedef, new_p), new_states,
+                    jax.tree_util.tree_unflatten(treedef, new_s), loss)
+        return jax.jit(step)
+
+    def _ensure_opt_state(self):
+        if self._opt_state is None:
+            updater = self.conf.base.updater
+            self._opt_state = jax.tree_util.tree_map(
+                lambda p: updater.init_state(p), self._params,
+                is_leaf=lambda x: isinstance(x, jax.Array))
+
+    def fit(self, data, labels=None, epochs: int = 1):
+        """Accepts a DataSetIterator, DataSet, MultiDataSet, or arrays."""
+        if not self._initialized:
+            self.init()
+        self._ensure_opt_state()
+
+        def batches():
+            if isinstance(data, DataSetIterator):
+                data.reset()
+                while data.hasNext():
+                    yield data.next()
+            elif isinstance(data, (DataSet, MultiDataSet)):
+                yield data
+            elif isinstance(data, (list, tuple)) and data and \
+                    isinstance(data[0], (DataSet, MultiDataSet)):
+                yield from data
+            else:
+                yield DataSet(np.asarray(data), np.asarray(labels))
+
+        for _ in range(epochs):
+            for ds in batches():
+                self._fit_one(ds)
+            self._epoch += 1
+            for lst in self._listeners:
+                if hasattr(lst, "onEpochEnd"):
+                    lst.onEpochEnd(self)
+        return self
+
+    def _fit_one(self, ds):
+        if isinstance(ds, MultiDataSet):
+            ins = {name: jnp.asarray(a)
+                   for name, a in zip(self.conf.graph_inputs, ds.features)}
+            labels = [jnp.asarray(a) for a in ds.labels]
+            lmasks = [jnp.asarray(m) for m in ds.labels_masks] \
+                if ds.labels_masks else None
+        else:
+            ins = {self.conf.graph_inputs[0]: jnp.asarray(ds.features)}
+            labels = [jnp.asarray(ds.labels)]
+            lmasks = [jnp.asarray(ds.labels_mask)] if ds.labels_mask is not None else None
+        sig = lmasks is not None
+        if sig not in self._train_step_cache:
+            self._train_step_cache[sig] = self._make_train_step(sig)
+        step = self._train_step_cache[sig]
+        key = jax.random.PRNGKey(self.conf.base.seed + self._iteration + 1)
+        dummy = [jnp.zeros((1,))] * len(labels)
+        self._params, self._states, self._opt_state, loss = step(
+            self._params, self._states, self._opt_state,
+            jnp.asarray(self._iteration, jnp.float32), ins, labels,
+            lmasks if lmasks is not None else dummy, key)
+        self._score = float(loss)
+        self._last_batch_size = int(next(iter(ins.values())).shape[0])
+        self._iteration += 1
+        for lst in self._listeners:
+            if hasattr(lst, "iterationDone"):
+                lst.iterationDone(self, self._iteration, self._epoch)
+
+    # ------------------------------------------------------------- utilities
+    def score(self, ds=None) -> float:
+        if ds is None:
+            return self._score
+        if isinstance(ds, MultiDataSet):
+            ins = {n: jnp.asarray(a) for n, a in zip(self.conf.graph_inputs, ds.features)}
+            labels = [jnp.asarray(a) for a in ds.labels]
+        else:
+            ins = {self.conf.graph_inputs[0]: jnp.asarray(ds.features)}
+            labels = [jnp.asarray(ds.labels)]
+        loss, _ = self._loss_and_reg(self._params, self._states, ins, labels,
+                                     False, jax.random.PRNGKey(0), None, None)
+        return float(loss)
+
+    def evaluate(self, iterator: DataSetIterator, evaluation=None) -> Evaluation:
+        ev = evaluation or Evaluation()
+        iterator.reset()
+        while iterator.hasNext():
+            ds = iterator.next()
+            preds = self.output(ds.features)
+            ev.eval(ds.labels, np.asarray(preds), mask=ds.labels_mask)
+        return ev
+
+    def params(self) -> jnp.ndarray:
+        leaves = jax.tree_util.tree_leaves(self._params)
+        if not leaves:
+            return jnp.zeros((0,))
+        return jnp.concatenate([jnp.ravel(p) for p in leaves])
+
+    def numParams(self) -> int:
+        return sum(int(np.prod(p.shape))
+                   for p in jax.tree_util.tree_leaves(self._params))
+
+    def setListeners(self, *listeners):
+        self._listeners = list(listeners)
+
+    def getLayer(self, name: str):
+        return self.conf.node_by_name[name].obj
+
+    def summary(self) -> str:
+        lines = ["=" * 78,
+                 f"{'Name (Type)':<38}{'In':<20}{'Params':<10}", "=" * 78]
+        total = 0
+        for node in self.conf.topo:
+            n = sum(int(np.prod(p.shape))
+                    for p in jax.tree_util.tree_leaves(self._params.get(node.name, {})))
+            total += n
+            lines.append(f"{f'{node.name} ({type(node.obj).__name__})':<38}"
+                         f"{','.join(node.inputs):<20}{n:<10}")
+        lines.append(f"Total params: {total}")
+        return "\n".join(lines)
+
+    # ------------------------------------------------------------ save / load
+    def save(self, path: str, save_updater: bool = True):
+        import io
+        import json
+        import zipfile
+        meta = {"type": "ComputationGraph", "iteration": self._iteration,
+                "epoch": self._epoch,
+                "save_updater": bool(save_updater and self._opt_state is not None)}
+        arrays = {}
+        for name, p in self._params.items():
+            for k, arr in p.items():
+                arrays[f"p::{name}::{k}"] = np.asarray(arr)
+        for name, s in self._states.items():
+            for k, arr in s.items():
+                arrays[f"s::{name}::{k}"] = np.asarray(arr)
+        if meta["save_updater"]:
+            leaves, _ = jax.tree_util.tree_flatten(self._opt_state)
+            for j, leaf in enumerate(leaves):
+                arrays[f"u::{j}"] = np.asarray(leaf)
+        with zipfile.ZipFile(path, "w") as z:
+            z.writestr("conf.json", self.conf.to_json())
+            z.writestr("meta.json", json.dumps(meta))
+            buf = io.BytesIO()
+            np.savez(buf, **arrays) if arrays else np.savez(buf, __empty__=np.zeros(1))
+            z.writestr("arrays.npz", buf.getvalue())
+
+    @staticmethod
+    def load(path: str, load_updater: bool = True) -> "ComputationGraph":
+        import io
+        import json
+        import zipfile
+        with zipfile.ZipFile(path) as z:
+            conf = ComputationGraphConfiguration.from_json(z.read("conf.json").decode())
+            meta = json.loads(z.read("meta.json"))
+            arrays = np.load(io.BytesIO(z.read("arrays.npz")))
+        net = ComputationGraph(conf)
+        net.init()
+        for k in arrays.files:
+            parts = k.split("::")
+            if parts[0] == "p":
+                net._params[parts[1]][parts[2]] = jnp.asarray(arrays[k])
+            elif parts[0] == "s":
+                net._states[parts[1]][parts[2]] = jnp.asarray(arrays[k])
+        net._iteration = meta["iteration"]
+        net._epoch = meta["epoch"]
+        if load_updater and meta.get("save_updater"):
+            net._ensure_opt_state()
+            leaves, treedef = jax.tree_util.tree_flatten(net._opt_state)
+            new_leaves = [jnp.asarray(arrays[f"u::{j}"]) for j in range(len(leaves))]
+            net._opt_state = jax.tree_util.tree_unflatten(treedef, new_leaves)
+        return net

@@ -594,6 +594,18 @@ class LSTM(Layer):
                                mask_tn=mask_tn)
         return jnp.transpose(outs, (1, 2, 0)), state  # [T,N,H] -> [N,H,T]
 
+    def apply_with_state(self, params, x, rnn_state, mask=None):
+        """Streaming forward carrying (h, c) across calls
+        (ref: MultiLayerNetwork.rnnTimeStep state keeping)."""
+        x_tnc = jnp.transpose(x, (2, 0, 1))
+        mask_tn = jnp.transpose(mask, (1, 0)) if mask is not None else None
+        h0 = c0 = None
+        if rnn_state is not None:
+            h0, c0 = rnn_state
+        outs, (hT, cT) = rnn_ops.lstm(x_tnc, params["W"], params["RW"],
+                                      params["b"], h0=h0, c0=c0, mask_tn=mask_tn)
+        return jnp.transpose(outs, (1, 2, 0)), (hT, cT)
+
     def output_type(self, it: InputType) -> InputType:
         return InputType.recurrent(self.nOut, it.dims.get("timesteps", -1))
 
@@ -635,6 +647,15 @@ class SimpleRnn(Layer):
                                      mask_tn=mask_tn,
                                      activation=act.get(self.activation))
         return jnp.transpose(outs, (1, 2, 0)), state
+
+    def apply_with_state(self, params, x, rnn_state, mask=None):
+        x_tnc = jnp.transpose(x, (2, 0, 1))
+        mask_tn = jnp.transpose(mask, (1, 0)) if mask is not None else None
+        h0 = rnn_state
+        outs, hT = rnn_ops.simple_rnn(x_tnc, params["W"], params["RW"],
+                                      params["b"], h0=h0, mask_tn=mask_tn,
+                                      activation=act.get(self.activation))
+        return jnp.transpose(outs, (1, 2, 0)), hT
 
     def output_type(self, it: InputType) -> InputType:
         return InputType.recurrent(self.nOut, it.dims.get("timesteps", -1))

@@ -204,6 +204,9 @@ class MultiLayerNetwork:
         return self
 
     def _fit_one(self, ds: DataSet):
+        if not self._initialized:
+            self.init()
+        self._ensure_opt_state()
         x = jnp.asarray(ds.features)
         y = jnp.asarray(ds.labels)
         fmask = jnp.asarray(ds.features_mask) if ds.features_mask is not None else None
@@ -326,6 +329,111 @@ class MultiLayerNetwork:
     def load(path: str, load_updater: bool = True) -> "MultiLayerNetwork":
         from deeplearning4j_tpu.train.serializer import ModelSerializer
         return ModelSerializer.restoreMultiLayerNetwork(path, load_updater)
+
+    # --------------------------------------------------- streaming RNN state
+    def rnnTimeStep(self, x):
+        """Streaming inference carrying RNN state across calls
+        (ref: MultiLayerNetwork.rnnTimeStep; SURVEY.md §5 tBPTT section).
+        x: [N, C, T_chunk] (or [N, C] for a single step)."""
+        x = jnp.asarray(x)
+        single = x.ndim == 2
+        if single:
+            x = x[:, :, None]
+        if not hasattr(self, "_rnn_states") or self._rnn_states is None:
+            self._rnn_states = [None] * len(self.layers)
+        cur = x
+        key = jax.random.PRNGKey(0)
+        for i, layer in enumerate(self.layers):
+            if i in self.conf.preprocessors:
+                cur = self.conf.preprocessors[i](cur)
+            key, sub = jax.random.split(key)
+            if hasattr(layer, "apply_with_state"):
+                cur, self._rnn_states[i] = layer.apply_with_state(
+                    self._params[i], cur, self._rnn_states[i])
+            elif isinstance(layer, _MASK_AWARE):
+                cur, _ = layer.apply(self._params[i], self._states[i], cur,
+                                     False, sub, mask=None)
+            else:
+                cur, _ = layer.apply(self._params[i], self._states[i], cur,
+                                     False, sub)
+        if single and cur.ndim == 3:
+            cur = cur[:, :, -1]
+        return cur
+
+    def rnnClearPreviousState(self):
+        """ref: MultiLayerNetwork.rnnClearPreviousState."""
+        self._rnn_states = None
+
+    def rnnGetPreviousState(self, layer_idx: int):
+        states = getattr(self, "_rnn_states", None)
+        return states[layer_idx] if states else None
+
+    def fitTBPTT(self, ds: DataSet, tbptt_length: int):
+        """Truncated BPTT (ref: BackpropType.TruncatedBPTT + tBPTTLength):
+        the sequence is split into segments; RNN state carries across
+        segments (detached), gradients stop at segment boundaries."""
+        T = ds.features.shape[2]
+        seg_states = [None] * len(self.layers)
+        for start in range(0, T, tbptt_length):
+            sl = slice(start, start + tbptt_length)
+            feats = ds.features[:, :, sl]
+            labels = ds.labels[:, :, sl] if ds.labels.ndim == 3 else ds.labels
+            fmask = ds.features_mask[:, sl] if ds.features_mask is not None else None
+            lmask = ds.labels_mask[:, sl] if ds.labels_mask is not None else None
+            seg_states = self._fit_one_tbptt(
+                DataSet(feats, labels, fmask, lmask), seg_states)
+        return self
+
+    def _fit_one_tbptt(self, ds: DataSet, seg_states):
+        """One TBPTT segment: like _fit_one but threading initial RNN state
+        in and detached final state out."""
+        x = jnp.asarray(ds.features)
+        y = jnp.asarray(ds.labels)
+        base = self.conf.base
+        updater = base.updater
+        self._ensure_opt_state()
+
+        def loss_fn(params):
+            cur = x
+            key = jax.random.PRNGKey(0)
+            new_seg = []
+            for i, layer in enumerate(self.layers):
+                if i in self.conf.preprocessors:
+                    cur = self.conf.preprocessors[i](cur)
+                key, sub = jax.random.split(key)
+                if hasattr(layer, "apply_with_state"):
+                    cur, s_new = layer.apply_with_state(params[i], cur,
+                                                        seg_states[i])
+                    new_seg.append(jax.tree_util.tree_map(
+                        jax.lax.stop_gradient, s_new))
+                else:
+                    if isinstance(layer, _MASK_AWARE):
+                        cur, _ = layer.apply(params[i], self._states[i], cur,
+                                             True, sub, mask=None)
+                    else:
+                        cur, _ = layer.apply(params[i], self._states[i], cur,
+                                             True, sub)
+                    new_seg.append(None)
+            loss = self.layers[-1].compute_loss(y, cur, mask=(
+                jnp.asarray(ds.labels_mask) if ds.labels_mask is not None else None))
+            return loss, new_seg
+
+        (loss, new_seg), grads = jax.value_and_grad(loss_fn, has_aux=True)(self._params)
+        lr = updater.lr_at(jnp.asarray(self._iteration, jnp.float32))
+        p_leaves, treedef = jax.tree_util.tree_flatten(self._params)
+        g_leaves = treedef.flatten_up_to(grads)
+        s_leaves = treedef.flatten_up_to(self._opt_state)
+        new_p, new_s = [], []
+        t = jnp.asarray(self._iteration, jnp.float32)
+        for pv, gv, sv in zip(p_leaves, g_leaves, s_leaves):
+            u, s2 = updater.apply(gv, sv, lr, t)
+            new_p.append(pv - u)
+            new_s.append(s2)
+        self._params = jax.tree_util.tree_unflatten(treedef, new_p)
+        self._opt_state = jax.tree_util.tree_unflatten(treedef, new_s)
+        self._score = float(loss)
+        self._iteration += 1
+        return new_seg
 
     def clone(self) -> "MultiLayerNetwork":
         net = MultiLayerNetwork(self.conf)

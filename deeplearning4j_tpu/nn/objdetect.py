@@ -1,0 +1,262 @@
+"""Object detection: YOLOv2 output layer + postprocessing.
+
+Reference parity: ``org.deeplearning4j.nn.layers.objdetect.Yolo2OutputLayer``
+(+ conf class), ``org.deeplearning4j.nn.layers.objdetect.YoloUtils``
+(``getPredictedObjects`` NMS postprocessing) and ``DetectedObject``
+(SURVEY.md §2.2 "DL4J layers": objdetect.Yolo2OutputLayer; zoo TinyYOLO/
+YOLO2 use these).
+
+Conventions follow the reference:
+- network output per grid cell: B anchor boxes x (tx, ty, tw, th, conf)
+  then C class scores; activations: sigmoid on xy/conf, exp on wh (scaled
+  by anchor priors), softmax on classes.
+- label format [N, 4 + C, gridH, gridW]: channels 0..3 = (x1, y1, x2, y2)
+  of the ground-truth box IN GRID UNITS for the responsible cell, then a
+  one-hot class; cells without objects are all-zero.
+- loss: lambda_coord * coord SSE + conf loss (IoU target, lambda_noobj on
+  empty cells) + per-cell class cross-entropy — Redmon et al. YOLOv2 as
+  the reference implements it.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.config import InputType
+from deeplearning4j_tpu.nn.layers import BaseOutputLayer
+
+
+class DetectedObject:
+    """ref: org.deeplearning4j.nn.layers.objdetect.DetectedObject."""
+
+    def __init__(self, example: int, center_x: float, center_y: float,
+                 width: float, height: float, predicted_class: int,
+                 confidence: float):
+        self.example = example
+        self.center_x = center_x
+        self.center_y = center_y
+        self.width = width
+        self.height = height
+        self.predicted_class = predicted_class
+        self.confidence = confidence
+
+    def getTopLeftXY(self):
+        return self.center_x - self.width / 2, self.center_y - self.height / 2
+
+    def getBottomRightXY(self):
+        return self.center_x + self.width / 2, self.center_y + self.height / 2
+
+    def getPredictedClass(self):
+        return self.predicted_class
+
+    def __repr__(self):
+        return (f"DetectedObject(ex={self.example} cls={self.predicted_class} "
+                f"conf={self.confidence:.3f} cx={self.center_x:.2f} "
+                f"cy={self.center_y:.2f} w={self.width:.2f} h={self.height:.2f})")
+
+
+class Yolo2OutputLayer(BaseOutputLayer):
+    """ref: conf.layers.objdetect.Yolo2OutputLayer — no params; applies
+    YOLO activations and computes the YOLOv2 loss."""
+
+    input_kind = "cnn"
+    has_params = False
+
+    def __init__(self, boundingBoxPriors=None, lambdaCoord: float = 5.0,
+                 lambdaNoObj: float = 0.5, **kw):
+        kw.setdefault("lossFunction", "mse")
+        super().__init__(**kw)
+        self.anchors = np.asarray(boundingBoxPriors if boundingBoxPriors is not None
+                                  else [[1.0, 1.0]], np.float32)  # [B, 2] (w, h) grid units
+        self.lambda_coord = lambdaCoord
+        self.lambda_noobj = lambdaNoObj
+        self.activation = "identity"
+
+    class Builder:
+        def __init__(self):
+            self._kw = {}
+
+        def boundingBoxPriors(self, priors):
+            self._kw["boundingBoxPriors"] = priors
+            return self
+
+        def lambdaCoord(self, v):
+            self._kw["lambdaCoord"] = v
+            return self
+
+        def lambdaNoObj(self, v):
+            self._kw["lambdaNoObj"] = v
+            return self
+
+        def build(self):
+            return Yolo2OutputLayer(**self._kw)
+
+    def infer_nin(self, it: InputType):
+        self.nIn = self.nOut = it.channels
+        self._grid_h, self._grid_w = it.height, it.width
+        b = self.anchors.shape[0]
+        assert it.channels % b == 0, \
+            f"channels {it.channels} not divisible by {b} anchors"
+        self._n_classes = it.channels // b - 5
+
+    def output_type(self, it: InputType) -> InputType:
+        return it
+
+    def _split(self, x):
+        """x [N, B*(5+C), H, W] -> (xy [N,B,2,H,W], wh, conf [N,B,H,W],
+        class_logits [N,B,C,H,W])."""
+        N, ch, H, W = x.shape
+        B = self.anchors.shape[0]
+        C = ch // B - 5
+        x = x.reshape(N, B, 5 + C, H, W)
+        txy = x[:, :, 0:2]
+        twh = x[:, :, 2:4]
+        tconf = x[:, :, 4]
+        tcls = x[:, :, 5:]
+        return txy, twh, tconf, tcls
+
+    def apply(self, params, state, x, train, key):
+        """Forward = YOLO activations (ref: Yolo2OutputLayer.activate):
+        sigmoid(xy), anchors*exp(wh), sigmoid(conf), softmax(classes);
+        repacked to the same [N, B*(5+C), H, W] layout."""
+        txy, twh, tconf, tcls = self._split(x)
+        anchors = jnp.asarray(self.anchors)  # [B, 2]
+        xy = jax.nn.sigmoid(txy)
+        wh = anchors[None, :, :, None, None] * jnp.exp(twh)
+        conf = jax.nn.sigmoid(tconf)[:, :, None]
+        cls = jax.nn.softmax(tcls, axis=2)
+        out = jnp.concatenate([xy, wh, conf, cls], axis=2)
+        N, B, ch, H, W = out.shape
+        return out.reshape(N, B * ch, H, W), state
+
+    def compute_loss(self, labels, preds, mask=None):
+        """labels [N, 4+C, H, W] (reference format); preds = activated
+        output of :meth:`apply` reshaped back per anchor."""
+        N, ch, H, W = preds.shape
+        B = self.anchors.shape[0]
+        C = ch // B - 5
+        p = preds.reshape(N, B, 5 + C, H, W)
+        pred_xy = p[:, :, 0:2]           # offsets within cell, [0,1]
+        pred_wh = p[:, :, 2:4]           # grid units
+        pred_conf = p[:, :, 4]
+        pred_cls = p[:, :, 5:]
+
+        lab_box = labels[:, 0:4]         # x1, y1, x2, y2 in grid units
+        lab_cls = labels[:, 4:]          # one-hot [N, C, H, W]
+        obj_mask = (jnp.sum(lab_cls, axis=1) > 0).astype(jnp.float32)  # [N, H, W]
+
+        gx1, gy1, gx2, gy2 = (lab_box[:, i] for i in range(4))
+        gt_w = jnp.maximum(gx2 - gx1, 1e-6)
+        gt_h = jnp.maximum(gy2 - gy1, 1e-6)
+        cell_x = jnp.arange(W)[None, None, :]
+        cell_y = jnp.arange(H)[None, :, None]
+        gt_cx = (gx1 + gx2) / 2 - cell_x     # offset within the cell
+        gt_cy = (gy1 + gy2) / 2 - cell_y
+
+        # responsible anchor = best IoU with gt by shape (wh only), per cell
+        anchors = jnp.asarray(self.anchors)            # [B, 2]
+        inter = jnp.minimum(anchors[:, 0][None, :, None, None], gt_w[:, None]) * \
+            jnp.minimum(anchors[:, 1][None, :, None, None], gt_h[:, None])
+        union = anchors[:, 0][None, :, None, None] * anchors[:, 1][None, :, None, None] \
+            + (gt_w * gt_h)[:, None] - inter
+        anchor_iou = inter / jnp.maximum(union, 1e-9)  # [N, B, H, W]
+        best = jnp.argmax(anchor_iou, axis=1)          # [N, H, W]
+        resp = jax.nn.one_hot(best, B, axis=1) * obj_mask[:, None]  # [N,B,H,W]
+
+        # coordinate loss (ref: lambdaCoord * SSE on xy and sqrt-wh)
+        xy_loss = jnp.sum(resp[:, :, None] * jnp.square(
+            pred_xy - jnp.stack([gt_cx, gt_cy], axis=1)[:, None]), axis=2)
+        wh_loss = jnp.sum(resp[:, :, None] * jnp.square(
+            jnp.sqrt(jnp.maximum(pred_wh, 1e-9)) -
+            jnp.sqrt(jnp.stack([gt_w, gt_h], axis=1)[:, None])), axis=2)
+
+        # confidence: target = IoU(pred box, gt box) on responsible anchors
+        pcx = pred_xy[:, :, 0] + cell_x[None]
+        pcy = pred_xy[:, :, 1] + cell_y[None]
+        px1, px2 = pcx - pred_wh[:, :, 0] / 2, pcx + pred_wh[:, :, 0] / 2
+        py1, py2 = pcy - pred_wh[:, :, 1] / 2, pcy + pred_wh[:, :, 1] / 2
+        ix = jnp.maximum(0.0, jnp.minimum(px2, gx2[:, None]) - jnp.maximum(px1, gx1[:, None]))
+        iy = jnp.maximum(0.0, jnp.minimum(py2, gy2[:, None]) - jnp.maximum(py1, gy1[:, None]))
+        inter_a = ix * iy
+        area_p = jnp.maximum(px2 - px1, 0) * jnp.maximum(py2 - py1, 0)
+        area_g = (gt_w * gt_h)[:, None]
+        iou = inter_a / jnp.maximum(area_p + area_g - inter_a, 1e-9)
+        conf_obj = jnp.square(pred_conf - jax.lax.stop_gradient(iou)) * resp
+        conf_noobj = jnp.square(pred_conf) * (1.0 - resp)
+
+        # class loss: cross-entropy on responsible cells
+        cls_loss = -jnp.sum(lab_cls[:, None] * jnp.log(jnp.maximum(pred_cls, 1e-9)),
+                            axis=2) * resp
+
+        total = (self.lambda_coord * jnp.sum(xy_loss + wh_loss)
+                 + jnp.sum(conf_obj) + self.lambda_noobj * jnp.sum(conf_noobj)
+                 + jnp.sum(cls_loss))
+        return total / N
+
+
+class YoloUtils:
+    """ref: org.deeplearning4j.nn.layers.objdetect.YoloUtils."""
+
+    @staticmethod
+    def getPredictedObjects(anchors, net_output, conf_threshold: float = 0.5,
+                            nms_threshold: float = 0.4) -> List[DetectedObject]:
+        """Decode an ACTIVATED yolo output [N, B*(5+C), H, W] into
+        DetectedObjects with per-class greedy NMS."""
+        out = np.asarray(net_output)
+        anchors = np.asarray(anchors, np.float32)
+        N, ch, H, W = out.shape
+        B = anchors.shape[0]
+        C = ch // B - 5
+        out = out.reshape(N, B, 5 + C, H, W)
+        objs: List[DetectedObject] = []
+        for n in range(N):
+            cand = []
+            for b in range(B):
+                conf = out[n, b, 4]
+                ys, xs = np.where(conf >= conf_threshold)
+                for y, x in zip(ys, xs):
+                    cx = out[n, b, 0, y, x] + x
+                    cy = out[n, b, 1, y, x] + y
+                    wdt = out[n, b, 2, y, x]
+                    hgt = out[n, b, 3, y, x]
+                    cls_probs = out[n, b, 5:, y, x]
+                    cls = int(np.argmax(cls_probs))
+                    score = float(conf[y, x] * cls_probs[cls])
+                    if score >= conf_threshold:
+                        cand.append(DetectedObject(n, float(cx), float(cy),
+                                                   float(wdt), float(hgt),
+                                                   cls, score))
+            objs.extend(YoloUtils.nms(cand, nms_threshold))
+        return objs
+
+    @staticmethod
+    def iou(a: DetectedObject, b: DetectedObject) -> float:
+        ax1, ay1 = a.getTopLeftXY()
+        ax2, ay2 = a.getBottomRightXY()
+        bx1, by1 = b.getTopLeftXY()
+        bx2, by2 = b.getBottomRightXY()
+        ix = max(0.0, min(ax2, bx2) - max(ax1, bx1))
+        iy = max(0.0, min(ay2, by2) - max(ay1, by1))
+        inter = ix * iy
+        union = (ax2 - ax1) * (ay2 - ay1) + (bx2 - bx1) * (by2 - by1) - inter
+        return inter / union if union > 0 else 0.0
+
+    @staticmethod
+    def nms(objects: List[DetectedObject], threshold: float = 0.4
+            ) -> List[DetectedObject]:
+        """Greedy per-class NMS (ref: YoloUtils.nms)."""
+        keep: List[DetectedObject] = []
+        by_class = {}
+        for o in objects:
+            by_class.setdefault(o.predicted_class, []).append(o)
+        for cls, objs in by_class.items():
+            objs = sorted(objs, key=lambda o: -o.confidence)
+            while objs:
+                best = objs.pop(0)
+                keep.append(best)
+                objs = [o for o in objs if YoloUtils.iou(best, o) < threshold]
+        return keep

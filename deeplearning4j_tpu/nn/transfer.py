@@ -1,0 +1,208 @@
+"""Transfer learning: freeze/replace layers of a pretrained network.
+
+Reference parity: ``org.deeplearning4j.nn.transferlearning.{
+TransferLearning, TransferLearningHelper, FineTuneConfiguration}``
+(SURVEY.md §2.2 "Transfer learning").
+
+TPU-native: freezing is a static property of the compiled train step —
+frozen layers get a zero update (their grads still flow through for
+upstream layers, exactly like the reference's FrozenLayer). The helper's
+featurize-and-cache mode runs the frozen prefix ONCE per dataset and
+trains only the head.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import List, Optional
+
+import jax
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.nn.config import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+class FineTuneConfiguration:
+    """ref: FineTuneConfiguration — overrides applied to all layers."""
+
+    def __init__(self, updater=None, l1: float = None, l2: float = None,
+                 seed: int = None):
+        self.updater = updater
+        self.l1 = l1
+        self.l2 = l2
+        self.seed = seed
+
+    class Builder:
+        def __init__(self):
+            self._kw = {}
+
+        def updater(self, u):
+            self._kw["updater"] = u
+            return self
+
+        def l1(self, v):
+            self._kw["l1"] = v
+            return self
+
+        def l2(self, v):
+            self._kw["l2"] = v
+            return self
+
+        def seed(self, s):
+            self._kw["seed"] = s
+            return self
+
+        def build(self):
+            return FineTuneConfiguration(**self._kw)
+
+
+class TransferLearning:
+    """ref: TransferLearning.Builder for MultiLayerNetwork."""
+
+    class Builder:
+        def __init__(self, net: MultiLayerNetwork):
+            self.net = net
+            self._ftc: Optional[FineTuneConfiguration] = None
+            self._freeze_until: Optional[int] = None
+            self._n_removed = 0
+            self._added = []
+            self._nout_replaced = {}
+
+        def fineTuneConfiguration(self, ftc: FineTuneConfiguration):
+            self._ftc = ftc
+            return self
+
+        def setFeatureExtractor(self, layer_idx: int):
+            """Freeze layers [0..layer_idx] inclusive (ref semantics)."""
+            self._freeze_until = layer_idx
+            return self
+
+        def removeOutputLayer(self):
+            self._n_removed += 1
+            return self
+
+        def removeLayersFromOutput(self, n: int):
+            self._n_removed += n
+            return self
+
+        def addLayer(self, layer):
+            self._added.append(layer)
+            return self
+
+        def nOutReplace(self, layer_idx: int, n_out: int, weight_init="xavier"):
+            """Replace layer_idx's nOut (and re-init it + the next layer's
+            nIn) — ref: nOutReplace."""
+            self._nout_replaced[layer_idx] = (n_out, weight_init)
+            return self
+
+        def build(self) -> MultiLayerNetwork:
+            src = self.net
+            conf = src.conf
+            keep = len(conf.layers) - self._n_removed
+            new_layers = [copy.deepcopy(l) for l in conf.layers[:keep]]
+            for idx, (n_out, w_init) in self._nout_replaced.items():
+                new_layers[idx].nOut = n_out
+                new_layers[idx].weight_init = w_init
+                if idx + 1 < len(new_layers):
+                    new_layers[idx + 1].nIn = None  # re-infer
+            new_layers.extend(copy.deepcopy(l) for l in self._added)
+
+            base = copy.deepcopy(conf.base)
+            if self._ftc:
+                if self._ftc.updater is not None:
+                    base.updater = self._ftc.updater
+                if self._ftc.l1 is not None:
+                    base.l1 = self._ftc.l1
+                if self._ftc.l2 is not None:
+                    base.l2 = self._ftc.l2
+                if self._ftc.seed is not None:
+                    base.seed = self._ftc.seed
+
+            new_conf = MultiLayerConfiguration(base, new_layers, conf.input_type)
+            net = MultiLayerNetwork(new_conf)
+            net.init()
+            # copy source params for retained, un-replaced layers
+            for i in range(keep):
+                if i in self._nout_replaced:
+                    continue
+                if i + 1 in self._nout_replaced or (i - 1) in self._nout_replaced:
+                    pass  # neighbours of a replaced layer keep shapes unless nIn changed
+                for name, arr in src._params[i].items():
+                    if name in net._params[i] and net._params[i][name].shape == arr.shape:
+                        net._params[i][name] = arr
+                for name, arr in src._states[i].items():
+                    if name in net._states[i] and net._states[i][name].shape == arr.shape:
+                        net._states[i][name] = arr
+            if self._freeze_until is not None:
+                net._frozen_layers = set(range(self._freeze_until + 1))
+            return net
+
+
+def _patch_frozen_training():
+    """Teach MultiLayerNetwork's train step about frozen layers: their
+    params receive a zero update (ref: FrozenLayer wrapping)."""
+    orig = MultiLayerNetwork._make_train_step
+
+    def make(self, with_fmask, with_lmask):
+        step = orig(self, with_fmask, with_lmask)
+        frozen = getattr(self, "_frozen_layers", None)
+        if not frozen:
+            return step
+
+        def wrapped(params, states, opt_state, t, x, y, fmask, lmask, key):
+            new_p, new_s, new_o, loss = step(params, states, opt_state, t, x, y,
+                                             fmask, lmask, key)
+            # restore frozen layers' params/opt-state (zero effective update)
+            new_p = [params[i] if i in frozen else new_p[i]
+                     for i in range(len(params))]
+            new_o = [opt_state[i] if i in frozen else new_o[i]
+                     for i in range(len(opt_state))]
+            return new_p, new_s, new_o, loss
+        return wrapped
+    MultiLayerNetwork._make_train_step = make
+
+
+_patch_frozen_training()
+
+
+class TransferLearningHelper:
+    """ref: TransferLearningHelper — featurize the frozen prefix once,
+    train only the unfrozen head."""
+
+    def __init__(self, net: MultiLayerNetwork, frozen_until: int):
+        self.net = net
+        self.frozen_until = frozen_until
+
+    def featurize(self, ds: DataSet) -> DataSet:
+        """Run inputs through the frozen prefix (ref: featurize)."""
+        acts = self.net.feedForward(ds.features, train=False)
+        # activation index: acts[0] is the input; +1 per layer
+        feat = np.asarray(acts[self.frozen_until + 1])
+        return DataSet(feat, ds.labels, ds.features_mask, ds.labels_mask)
+
+    def unfrozenMLN(self) -> MultiLayerNetwork:
+        """A network of only the unfrozen layers, sharing params."""
+        conf = self.net.conf
+        head_layers = conf.layers[self.frozen_until + 1:]
+        base = conf.base
+        new_conf = MultiLayerConfiguration.__new__(MultiLayerConfiguration)
+        new_conf.base = base
+        new_conf.layers = head_layers
+        new_conf.input_type = None
+        new_conf.preprocessors = {}
+        new_conf.layer_input_types = []
+        net = MultiLayerNetwork(new_conf)
+        net._params = self.net._params[self.frozen_until + 1:]
+        net._states = self.net._states[self.frozen_until + 1:]
+        net._initialized = True
+        return net
+
+    def fitFeaturized(self, featurized: DataSet, epochs: int = 1):
+        head = self.unfrozenMLN()
+        head.fit(featurized, epochs=epochs)
+        # write trained head params back
+        for off, p in enumerate(head._params):
+            self.net._params[self.frozen_until + 1 + off] = p
+        return self.net

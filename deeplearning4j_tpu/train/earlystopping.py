@@ -1,0 +1,244 @@
+"""Early stopping: validation-driven training termination + best-model save.
+
+Reference parity: ``org.deeplearning4j.earlystopping.*`` —
+``EarlyStoppingConfiguration``, ``EarlyStoppingTrainer``, score calculators
+(``DataSetLossCalculator``), termination conditions
+(``MaxEpochsTerminationCondition``, ``ScoreImprovementEpochTerminationCondition``,
+``MaxScoreIterationTerminationCondition``, ``MaxTimeIterationTerminationCondition``),
+``EarlyStoppingResult``, ``LocalFileModelSaver`` / ``InMemoryModelSaver``
+(SURVEY.md §2.2 "Early stopping").
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import time
+from typing import Any, Callable, List, Optional
+
+import jax
+import numpy as np
+
+
+class DataSetLossCalculator:
+    """Average loss over a validation iterator (ref: DataSetLossCalculator)."""
+
+    def __init__(self, iterator, average: bool = True):
+        self.iterator = iterator
+        self.average = average
+
+    def calculateScore(self, model) -> float:
+        total, n = 0.0, 0
+        self.iterator.reset()
+        while self.iterator.hasNext():
+            ds = self.iterator.next()
+            total += model.score(ds) * ds.numExamples()
+            n += ds.numExamples()
+        return total / max(n, 1) if self.average else total
+
+
+class ClassificationScoreCalculator:
+    """Negative accuracy so 'lower is better' holds (ref:
+    ClassificationScoreCalculator uses the Evaluation metric)."""
+
+    def __init__(self, iterator):
+        self.iterator = iterator
+
+    def calculateScore(self, model) -> float:
+        ev = model.evaluate(self.iterator)
+        return -ev.accuracy()
+
+
+class MaxEpochsTerminationCondition:
+    def __init__(self, max_epochs: int):
+        self.max_epochs = max_epochs
+
+    def terminate(self, epoch: int, score: float, best_epoch: int) -> bool:
+        return epoch >= self.max_epochs
+
+
+class ScoreImprovementEpochTerminationCondition:
+    """Stop after N epochs without score improvement (ref class of the
+    same name)."""
+
+    def __init__(self, max_epochs_without_improvement: int,
+                 min_improvement: float = 0.0):
+        self.patience = max_epochs_without_improvement
+        self.min_improvement = min_improvement
+
+    def terminate(self, epoch: int, score: float, best_epoch: int) -> bool:
+        return (epoch - best_epoch) > self.patience
+
+
+class MaxScoreIterationTerminationCondition:
+    """Abort if score explodes (ref class of the same name)."""
+
+    def __init__(self, max_score: float):
+        self.max_score = max_score
+
+    def terminate_iteration(self, score: float) -> bool:
+        return score > self.max_score or not np.isfinite(score)
+
+
+class MaxTimeIterationTerminationCondition:
+    def __init__(self, max_seconds: float):
+        self.max_seconds = max_seconds
+        self._start = None
+
+    def terminate_iteration(self, score: float) -> bool:
+        if self._start is None:
+            self._start = time.time()
+            return False
+        return (time.time() - self._start) > self.max_seconds
+
+
+class InMemoryModelSaver:
+    def __init__(self):
+        self.best = None
+
+    def saveBestModel(self, model, score):
+        self.best = (copy.deepcopy(model._params), copy.deepcopy(model._states))
+        self._model_ref = model
+
+    def getBestModel(self):
+        model = self._model_ref
+        model._params, model._states = self.best
+        return model
+
+
+class LocalFileModelSaver:
+    def __init__(self, directory: str):
+        self.dir = directory
+        os.makedirs(directory, exist_ok=True)
+        self.best_path = os.path.join(directory, "bestModel.zip")
+
+    def saveBestModel(self, model, score):
+        model.save(self.best_path)
+        self._model_cls = type(model)
+
+    def getBestModel(self):
+        return self._model_cls.load(self.best_path)
+
+
+class EarlyStoppingConfiguration:
+    """ref: EarlyStoppingConfiguration.Builder."""
+
+    def __init__(self, score_calculator, epoch_termination_conditions: List,
+                 iteration_termination_conditions: List = None,
+                 model_saver=None, evaluate_every_n_epochs: int = 1):
+        self.score_calculator = score_calculator
+        self.epoch_conditions = epoch_termination_conditions
+        self.iter_conditions = iteration_termination_conditions or []
+        self.saver = model_saver or InMemoryModelSaver()
+        self.eval_every = evaluate_every_n_epochs
+
+    class Builder:
+        def __init__(self):
+            self._score = None
+            self._epoch_conds = []
+            self._iter_conds = []
+            self._saver = None
+            self._every = 1
+
+        def scoreCalculator(self, sc):
+            self._score = sc
+            return self
+
+        def epochTerminationConditions(self, *conds):
+            self._epoch_conds.extend(conds)
+            return self
+
+        def iterationTerminationConditions(self, *conds):
+            self._iter_conds.extend(conds)
+            return self
+
+        def modelSaver(self, saver):
+            self._saver = saver
+            return self
+
+        def evaluateEveryNEpochs(self, n):
+            self._every = n
+            return self
+
+        def build(self):
+            return EarlyStoppingConfiguration(self._score, self._epoch_conds,
+                                              self._iter_conds, self._saver,
+                                              self._every)
+
+
+class EarlyStoppingResult:
+    """ref: EarlyStoppingResult."""
+
+    def __init__(self, termination_reason: str, termination_details: str,
+                 score_vs_epoch: dict, best_epoch: int, best_score: float,
+                 total_epochs: int, best_model):
+        self.termination_reason = termination_reason
+        self.termination_details = termination_details
+        self.score_vs_epoch = score_vs_epoch
+        self.best_epoch = best_epoch
+        self.best_score = best_score
+        self.total_epochs = total_epochs
+        self.best_model = best_model
+
+    def getBestModel(self):
+        return self.best_model
+
+    def getBestModelEpoch(self):
+        return self.best_epoch
+
+    def getBestModelScore(self):
+        return self.best_score
+
+
+class EarlyStoppingTrainer:
+    """ref: EarlyStoppingTrainer (works for MultiLayerNetwork and
+    ComputationGraph — both expose fit/score)."""
+
+    def __init__(self, config: EarlyStoppingConfiguration, model, train_iterator):
+        self.config = config
+        self.model = model
+        self.iterator = train_iterator
+
+    def fit(self) -> EarlyStoppingResult:
+        cfg = self.config
+        best_score = float("inf")
+        best_epoch = -1
+        scores = {}
+        epoch = 0
+        reason, details = "MaxEpochs", ""
+        while True:
+            # one epoch, watching iteration conditions
+            self.iterator.reset()
+            aborted = False
+            while self.iterator.hasNext():
+                self.model._fit_one(self.iterator.next())
+                for ic in cfg.iter_conditions:
+                    if ic.terminate_iteration(self.model.score()):
+                        reason = "IterationTerminationCondition"
+                        details = type(ic).__name__
+                        aborted = True
+                        break
+                if aborted:
+                    break
+            if aborted:
+                break
+            epoch += 1
+            if epoch % cfg.eval_every == 0:
+                score = cfg.score_calculator.calculateScore(self.model)
+                scores[epoch] = score
+                if score < best_score:
+                    best_score = score
+                    best_epoch = epoch
+                    cfg.saver.saveBestModel(self.model, score)
+            stop = False
+            for ec in cfg.epoch_conditions:
+                if ec.terminate(epoch, scores.get(epoch, best_score), best_epoch):
+                    reason = "EpochTerminationCondition"
+                    details = type(ec).__name__
+                    stop = True
+                    break
+            if stop:
+                break
+        best_model = cfg.saver.getBestModel() if best_epoch >= 0 else self.model
+        return EarlyStoppingResult(reason, details, scores, best_epoch,
+                                   best_score, epoch, best_model)

@@ -1,0 +1,257 @@
+"""ComputationGraph + model zoo + object detection tests.
+
+Reference test-strategy parity (SURVEY.md §4): zoo tests instantiate each
+model and run a tiny forward pass; graph tests check vertices/DAG wiring;
+YOLO loss/NMS sanity.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.nn import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.graph import (ComputationGraph, ElementWiseVertex,
+                                         L2NormalizeVertex, MergeVertex,
+                                         SubsetVertex)
+from deeplearning4j_tpu.nn.layers import (ActivationLayer, BatchNormalization,
+                                          ConvolutionLayer, DenseLayer,
+                                          GlobalPoolingLayer, OutputLayer,
+                                          SubsamplingLayer)
+from deeplearning4j_tpu.nn.objdetect import (DetectedObject, Yolo2OutputLayer,
+                                             YoloUtils)
+from deeplearning4j_tpu.models import zoo
+from deeplearning4j_tpu.train import updaters
+
+
+class TestComputationGraph:
+    def _skip_graph(self):
+        """x -> dense1 -> dense2 -> add(dense1) -> out (residual)."""
+        g = (NeuralNetConfiguration.Builder().seed(7)
+             .updater(updaters.Adam(0.05))
+             .graphBuilder()
+             .addInputs("x")
+             .setInputTypes(InputType.feedForward(4)))
+        g.addLayer("d1", DenseLayer(nOut=8, activation="relu"), "x")
+        g.addLayer("d2", DenseLayer(nOut=8, activation="relu"), "d1")
+        g.addVertex("add", ElementWiseVertex("Add"), "d1", "d2")
+        g.addLayer("out", OutputLayer(nOut=3, lossFunction="mcxent",
+                                      activation="softmax"), "add")
+        g.setOutputs("out")
+        return ComputationGraph(g.build())
+
+    def test_forward_and_shapes(self):
+        net = self._skip_graph().init()
+        out = net.output(np.zeros((5, 4), np.float32))
+        assert out.shape == (5, 3)
+
+    def test_training_converges(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(90, 4).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 90)]
+        x += 2.0 * y @ np.asarray([[1, 0, 0, 0], [0, 1, 0, 0], [0, 0, 1, 0]],
+                                  np.float32)
+        net = self._skip_graph().init()
+        it = ListDataSetIterator(DataSet(x, y), 32, shuffle=True)
+        net.fit(it, epochs=20)
+        ev = net.evaluate(ListDataSetIterator(DataSet(x, y), 64))
+        assert ev.accuracy() > 0.9, ev.stats()
+
+    def test_merge_and_subset_vertices(self):
+        g = (NeuralNetConfiguration.Builder().seed(1)
+             .updater(updaters.Sgd(0.1))
+             .graphBuilder()
+             .addInputs("x")
+             .setInputTypes(InputType.feedForward(4)))
+        g.addLayer("a", DenseLayer(nOut=6, activation="relu"), "x")
+        g.addLayer("b", DenseLayer(nOut=4, activation="relu"), "x")
+        g.addVertex("cat", MergeVertex(), "a", "b")       # 10
+        g.addVertex("sub", SubsetVertex(0, 4), "cat")     # 5
+        g.addLayer("out", OutputLayer(nOut=2, lossFunction="mcxent",
+                                      activation="softmax"), "sub")
+        g.setOutputs("out")
+        net = ComputationGraph(g.build()).init()
+        assert net.conf.types["cat"].arrayElementsPerExample() == 10
+        out = net.output(np.zeros((2, 4), np.float32))
+        assert out.shape == (2, 2)
+
+    def test_l2_normalize_vertex(self):
+        v = L2NormalizeVertex()
+        x = jnp.asarray([[3.0, 4.0]])
+        np.testing.assert_allclose(np.asarray(v.apply(x)), [[0.6, 0.8]], rtol=1e-6)
+
+    def test_multiple_outputs(self):
+        g = (NeuralNetConfiguration.Builder().seed(2)
+             .updater(updaters.Adam(0.05))
+             .graphBuilder()
+             .addInputs("x")
+             .setInputTypes(InputType.feedForward(4)))
+        g.addLayer("trunk", DenseLayer(nOut=8, activation="relu"), "x")
+        g.addLayer("out1", OutputLayer(nOut=2, lossFunction="mcxent",
+                                       activation="softmax"), "trunk")
+        g.addLayer("out2", OutputLayer(nOut=1, lossFunction="mse",
+                                       activation="identity"), "trunk")
+        g.setOutputs("out1", "out2")
+        net = ComputationGraph(g.build()).init()
+        o1, o2 = net.output(np.zeros((3, 4), np.float32))
+        assert o1.shape == (3, 2) and o2.shape == (3, 1)
+        from deeplearning4j_tpu.data import MultiDataSet
+        rng = np.random.RandomState(0)
+        mds = MultiDataSet([rng.randn(16, 4).astype(np.float32)],
+                           [np.eye(2, dtype=np.float32)[rng.randint(0, 2, 16)],
+                            rng.randn(16, 1).astype(np.float32)])
+        first = None
+        for _ in range(15):
+            net.fit(mds)
+            first = first if first is not None else net.score()
+        assert net.score() < first
+
+    def test_save_load_roundtrip(self, tmp_path):
+        net = self._skip_graph().init()
+        rng = np.random.RandomState(3)
+        ds = DataSet(rng.randn(16, 4).astype(np.float32),
+                     np.eye(3, dtype=np.float32)[rng.randint(0, 3, 16)])
+        net.fit(ds)
+        path = str(tmp_path / "graph.zip")
+        net.save(path)
+        net2 = ComputationGraph.load(path)
+        x = ds.features[:4]
+        np.testing.assert_allclose(np.asarray(net.output(x)),
+                                   np.asarray(net2.output(x)), rtol=1e-6)
+        net.fit(ds)
+        net2.fit(ds)
+        np.testing.assert_allclose(net.score(), net2.score(), rtol=1e-5)
+
+
+class TestZoo:
+    @pytest.mark.parametrize("model_cls,kwargs,in_shape", [
+        (zoo.LeNet, {"num_classes": 10}, None),
+        (zoo.SimpleCNN, {"num_classes": 5, "input_shape": (3, 32, 32)}, None),
+        (zoo.AlexNet, {"num_classes": 10, "input_shape": (3, 96, 96)}, None),
+        (zoo.VGG16, {"num_classes": 10, "input_shape": (3, 64, 64)}, None),
+        (zoo.VGG19, {"num_classes": 10, "input_shape": (3, 64, 64)}, None),
+        (zoo.Darknet19, {"num_classes": 10, "input_shape": (3, 64, 64)}, None),
+    ])
+    def test_mln_models_forward(self, model_cls, kwargs, in_shape):
+        net = model_cls(seed=42, **kwargs).init()
+        c, h, w = kwargs.get("input_shape", (1, 28, 28))
+        if model_cls is zoo.LeNet:
+            x = np.zeros((2, c * h * w), np.float32)
+        else:
+            x = np.zeros((2, c, h, w), np.float32)
+        out = net.output(x)
+        assert out.shape == (2, kwargs["num_classes"])
+        assert np.allclose(np.asarray(out).sum(1), 1.0, atol=1e-4)
+
+    @pytest.mark.parametrize("model_cls,kwargs", [
+        (zoo.ResNet50, {"num_classes": 7, "input_shape": (3, 64, 64)}),
+        (zoo.SqueezeNet, {"num_classes": 7, "input_shape": (3, 64, 64)}),
+        (zoo.FaceNetNN4Small2, {"num_classes": 7, "input_shape": (3, 64, 64)}),
+    ])
+    def test_graph_models_forward(self, model_cls, kwargs):
+        net = model_cls(seed=42, **kwargs).init()
+        c, h, w = kwargs["input_shape"]
+        out = net.output(np.zeros((2, c, h, w), np.float32))
+        assert out.shape == (2, kwargs["num_classes"])
+
+    def test_unet_output_is_map(self):
+        net = zoo.UNet(input_shape=(3, 32, 32)).init()
+        out = net.output(np.zeros((1, 3, 32, 32), np.float32))
+        assert out.shape == (1, 1, 32, 32)
+        assert (np.asarray(out) >= 0).all() and (np.asarray(out) <= 1).all()
+
+    def test_xception_forward(self):
+        net = zoo.Xception(num_classes=4, input_shape=(3, 71, 71), seed=1).init()
+        out = net.output(np.zeros((1, 3, 71, 71), np.float32))
+        assert out.shape == (1, 4)
+
+    def test_text_generation_lstm(self):
+        m = zoo.TextGenerationLSTM(vocab_size=30)
+        net = m.init()
+        out = net.output(np.zeros((2, 30, 60), np.float32))
+        assert out.shape == (2, 30, 60)
+
+    def test_resnet50_bottleneck_count(self):
+        net = zoo.ResNet50(num_classes=3, input_shape=(3, 64, 64)).init()
+        conv_names = [n.name for n in net.conf.topo if "c3" in n.name]
+        assert len(conv_names) == 3 + 4 + 6 + 3  # bottlenecks per stage
+
+
+class TestYolo:
+    def _tiny_net(self, grid=4, n_classes=2, n_boxes=2):
+        anchors = [[1.0, 1.0], [2.0, 2.0]][:n_boxes]
+        conf = (NeuralNetConfiguration.Builder().seed(5)
+                .updater(updaters.Adam(1e-3)).list()
+                .layer(ConvolutionLayer(kernelSize=(3, 3), padding=(1, 1),
+                                        nOut=16, activation="relu"))
+                .layer(ConvolutionLayer(kernelSize=(1, 1),
+                                        nOut=n_boxes * (5 + n_classes),
+                                        activation="identity"))
+                .layer(Yolo2OutputLayer(boundingBoxPriors=anchors))
+                .setInputType(InputType.convolutional(grid, grid, 3))
+                .build())
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        return MultiLayerNetwork(conf).init()
+
+    def _labels(self, n, grid, n_classes):
+        lab = np.zeros((n, 4 + n_classes, grid, grid), np.float32)
+        # one object per example in cell (1,1): box from (0.8,0.9)->(1.6,1.9)
+        lab[:, 0, 1, 1] = 0.8
+        lab[:, 1, 1, 1] = 0.9
+        lab[:, 2, 1, 1] = 1.6
+        lab[:, 3, 1, 1] = 1.9
+        lab[:, 4, 1, 1] = 1.0  # class 0
+        return lab
+
+    def test_yolo_loss_decreases(self):
+        grid, n_classes = 4, 2
+        net = self._tiny_net(grid, n_classes)
+        rng = np.random.RandomState(0)
+        x = rng.rand(8, 3, grid, grid).astype(np.float32)
+        ds = DataSet(x, self._labels(8, grid, n_classes))
+        first = None
+        for _ in range(25):
+            net.fit(ds)
+            if first is None:
+                first = net.score()
+        assert np.isfinite(net.score())
+        assert net.score() < first
+
+    def test_yolo_forward_activations(self):
+        grid, n_classes, n_boxes = 4, 2, 2
+        net = self._tiny_net(grid, n_classes, n_boxes)
+        out = np.asarray(net.output(np.zeros((1, 3, grid, grid), np.float32)))
+        out = out.reshape(1, n_boxes, 5 + n_classes, grid, grid)
+        assert (out[:, :, 0:2] >= 0).all() and (out[:, :, 0:2] <= 1).all()  # xy
+        assert (out[:, :, 2:4] > 0).all()                                   # wh
+        assert (out[:, :, 4] >= 0).all() and (out[:, :, 4] <= 1).all()      # conf
+        np.testing.assert_allclose(out[:, :, 5:].sum(2), 1.0, atol=1e-5)    # cls
+
+    def test_yolo_utils_nms(self):
+        a = DetectedObject(0, 1.0, 1.0, 1.0, 1.0, 0, 0.9)
+        b = DetectedObject(0, 1.05, 1.0, 1.0, 1.0, 0, 0.8)   # overlaps a
+        c = DetectedObject(0, 3.0, 3.0, 1.0, 1.0, 0, 0.7)    # separate
+        d = DetectedObject(0, 1.0, 1.0, 1.0, 1.0, 1, 0.6)    # other class
+        keep = YoloUtils.nms([a, b, c, d], threshold=0.4)
+        confs = sorted(o.confidence for o in keep)
+        assert confs == [0.6, 0.7, 0.9]
+
+    def test_get_predicted_objects(self):
+        grid, n_classes, n_boxes = 4, 2, 1
+        out = np.zeros((1, n_boxes * (5 + n_classes), grid, grid), np.float32)
+        out = out.reshape(1, n_boxes, 5 + n_classes, grid, grid)
+        out[0, 0, 0, 2, 3] = 0.5   # cx offset
+        out[0, 0, 1, 2, 3] = 0.5
+        out[0, 0, 2, 2, 3] = 1.0   # w
+        out[0, 0, 3, 2, 3] = 1.0
+        out[0, 0, 4, 2, 3] = 0.95  # conf
+        out[0, 0, 5, 2, 3] = 0.9   # class 0
+        out[0, 0, 6, 2, 3] = 0.1
+        objs = YoloUtils.getPredictedObjects([[1.0, 1.0]],
+                                             out.reshape(1, -1, grid, grid),
+                                             conf_threshold=0.5)
+        assert len(objs) == 1
+        o = objs[0]
+        assert o.predicted_class == 0
+        assert abs(o.center_x - 3.5) < 1e-5 and abs(o.center_y - 2.5) < 1e-5

@@ -1,0 +1,160 @@
+"""Transfer learning, early stopping, streaming RNN (rnnTimeStep/tBPTT)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data import DataSet, IrisDataSetIterator, ListDataSetIterator, NormalizerStandardize
+from deeplearning4j_tpu.nn import InputType, MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import (DenseLayer, LSTM, OutputLayer,
+                                          RnnOutputLayer)
+from deeplearning4j_tpu.nn.transfer import (FineTuneConfiguration,
+                                            TransferLearning,
+                                            TransferLearningHelper)
+from deeplearning4j_tpu.train import updaters
+from deeplearning4j_tpu.train.earlystopping import (
+    DataSetLossCalculator, EarlyStoppingConfiguration, EarlyStoppingTrainer,
+    InMemoryModelSaver, MaxEpochsTerminationCondition,
+    MaxScoreIterationTerminationCondition,
+    ScoreImprovementEpochTerminationCondition)
+
+
+def iris_data():
+    it = IrisDataSetIterator(150)
+    ds = it.next()
+    ds.shuffle(seed=0)
+    norm = NormalizerStandardize()
+    norm.fit(ds)
+    norm.transform(ds)
+    return ds.splitTestAndTrain(0.8)
+
+
+def base_net():
+    conf = (NeuralNetConfiguration.Builder().seed(42)
+            .updater(updaters.Adam(0.05)).list()
+            .layer(DenseLayer(nOut=16, activation="relu"))
+            .layer(DenseLayer(nOut=8, activation="relu"))
+            .layer(OutputLayer(nOut=3, lossFunction="mcxent", activation="softmax"))
+            .setInputType(InputType.feedForward(4))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+class TestTransferLearning:
+    def test_frozen_layers_do_not_update(self):
+        split = iris_data()
+        net = base_net()
+        net.fit(ListDataSetIterator(split.getTrain(), 32), epochs=3)
+        new_net = (TransferLearning.Builder(net)
+                   .fineTuneConfiguration(
+                       FineTuneConfiguration.Builder()
+                       .updater(updaters.Adam(0.05)).build())
+                   .setFeatureExtractor(0)
+                   .build())
+        w0_before = np.asarray(new_net._params[0]["W"]).copy()
+        w1_before = np.asarray(new_net._params[1]["W"]).copy()
+        new_net.fit(ListDataSetIterator(split.getTrain(), 32), epochs=3)
+        np.testing.assert_array_equal(np.asarray(new_net._params[0]["W"]), w0_before)
+        assert not np.allclose(np.asarray(new_net._params[1]["W"]), w1_before)
+
+    def test_replace_output_layer(self):
+        net = base_net()
+        new_net = (TransferLearning.Builder(net)
+                   .removeOutputLayer()
+                   .addLayer(OutputLayer(nOut=5, lossFunction="mcxent",
+                                         activation="softmax", nIn=8))
+                   .build())
+        out = new_net.output(np.zeros((2, 4), np.float32))
+        assert out.shape == (2, 5)
+        # retained layers share the source's weights
+        np.testing.assert_array_equal(np.asarray(new_net._params[0]["W"]),
+                                      np.asarray(net._params[0]["W"]))
+
+    def test_helper_featurize_and_fit(self):
+        split = iris_data()
+        net = base_net()
+        helper = TransferLearningHelper(net, frozen_until=0)
+        feat_ds = helper.featurize(split.getTrain())
+        assert feat_ds.features.shape == (120, 16)
+        before = np.asarray(net._params[0]["W"]).copy()
+        helper.fitFeaturized(feat_ds, epochs=3)
+        np.testing.assert_array_equal(np.asarray(net._params[0]["W"]), before)
+        ev_out = net.output(split.getTest().features)
+        assert ev_out.shape[1] == 3
+
+
+class TestEarlyStopping:
+    def test_stops_on_patience_and_returns_best(self):
+        split = iris_data()
+        net = base_net()
+        train_it = ListDataSetIterator(split.getTrain(), 32, shuffle=True)
+        val_it = ListDataSetIterator(split.getTest(), 30)
+        cfg = (EarlyStoppingConfiguration.Builder()
+               .scoreCalculator(DataSetLossCalculator(val_it))
+               .epochTerminationConditions(
+                   MaxEpochsTerminationCondition(40),
+                   ScoreImprovementEpochTerminationCondition(5))
+               .modelSaver(InMemoryModelSaver())
+               .build())
+        result = EarlyStoppingTrainer(cfg, net, train_it).fit()
+        assert result.best_epoch >= 1
+        assert result.total_epochs <= 40
+        assert np.isfinite(result.best_score)
+        best = result.getBestModel()
+        ev = best.evaluate(ListDataSetIterator(split.getTest(), 30))
+        assert ev.accuracy() > 0.7
+
+    def test_iteration_condition_aborts(self):
+        split = iris_data()
+        net = base_net()
+        train_it = ListDataSetIterator(split.getTrain(), 32)
+        cfg = (EarlyStoppingConfiguration.Builder()
+               .scoreCalculator(DataSetLossCalculator(
+                   ListDataSetIterator(split.getTest(), 30)))
+               .epochTerminationConditions(MaxEpochsTerminationCondition(100))
+               .iterationTerminationConditions(
+                   MaxScoreIterationTerminationCondition(1e-9))  # triggers at once
+               .build())
+        result = EarlyStoppingTrainer(cfg, net, train_it).fit()
+        assert result.termination_reason == "IterationTerminationCondition"
+
+
+class TestStreamingRnn:
+    def _rnn_net(self, T):
+        conf = (NeuralNetConfiguration.Builder().seed(3)
+                .updater(updaters.Adam(0.01)).list()
+                .layer(LSTM(nOut=8))
+                .layer(RnnOutputLayer(nOut=2, lossFunction="mcxent",
+                                      activation="softmax"))
+                .setInputType(InputType.recurrent(3, T))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    def test_rnn_time_step_matches_full_forward(self):
+        T = 8
+        net = self._rnn_net(T)
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 3, T).astype(np.float32)
+        full = np.asarray(net.output(x))
+        net.rnnClearPreviousState()
+        parts = [np.asarray(net.rnnTimeStep(x[:, :, i:i + 2])) for i in range(0, T, 2)]
+        streamed = np.concatenate(parts, axis=2)
+        np.testing.assert_allclose(streamed, full, rtol=1e-4, atol=1e-5)
+        # state persists: a cleared run differs from a continuing run
+        cont = np.asarray(net.rnnTimeStep(x[:, :, :2]))
+        net.rnnClearPreviousState()
+        fresh = np.asarray(net.rnnTimeStep(x[:, :, :2]))
+        assert not np.allclose(cont, fresh)
+
+    def test_tbptt_reduces_loss(self):
+        T = 12
+        net = self._rnn_net(T)
+        rng = np.random.RandomState(1)
+        x = rng.randn(16, 3, T).astype(np.float32)
+        y = (x.sum(axis=1, keepdims=True) > 0).astype(np.float32)
+        labels = np.concatenate([y, 1 - y], axis=1)
+        ds = DataSet(x, labels)
+        first = None
+        for _ in range(10):
+            net.fitTBPTT(ds, tbptt_length=4)
+            first = first if first is not None else net.score()
+        assert net.score() < first
