@@ -45,15 +45,20 @@ from deeplearning4j_tpu.train.updaters import IUpdater
 
 
 class _Node:
-    __slots__ = ("op", "fn", "inputs", "outputs", "attrs")
+    __slots__ = ("op", "fn", "inputs", "outputs", "attrs", "rebuild")
 
     def __init__(self, op: str, fn: Callable, inputs: List[str],
-                 outputs: List[str], attrs: Dict[str, Any]):
+                 outputs: List[str], attrs: Dict[str, Any],
+                 rebuild: str = None):
         self.op = op
         self.fn = fn
         self.inputs = inputs
         self.outputs = outputs
         self.attrs = attrs
+        # Key into _FN_REBUILDERS: nodes whose callable is a closure (not a
+        # plain registry op) serialize by recording this key + attrs, and
+        # load() rebuilds the closure — same pattern as _make_rng_fn.
+        self.rebuild = rebuild
 
 
 class SDVariable:
@@ -169,7 +174,16 @@ class SDVariable:
         return self._un("cast", dtype=np.dtype(dtype).name)
 
     def get(self, idx):
-        return self.sd._record_fn("getitem", lambda x: x[idx], [self.name])
+        # serializable when the index is basic (ints/slices/ellipsis/newaxis/
+        # 1-D int lists); advanced indices (nd arrays, bool masks, traced
+        # arrays) keep exact numpy semantics via a closure and are simply not
+        # serializable (save() reports it)
+        try:
+            attrs = {"index": _encode_index(idx)}
+        except TypeError:
+            return self.sd._record_fn("getitem", lambda x: x[idx], [self.name])
+        return self.sd._record_fn("getitem", _make_getitem_fn(attrs),
+                                  [self.name], attrs=attrs, rebuild="getitem")
 
     __getitem__ = get
 
@@ -202,13 +216,13 @@ class SDMath(_Namespace):
 
     def std(self, x, *axes, name=None):
         return self.sd._record_fn(
-            "std", lambda v, axis=None: jnp.std(v, axis=axis, ddof=1),
-            [x.name], name=name, attrs={"axis": tuple(axes) or None})
+            "std", _make_std_fn({}), [x.name], name=name,
+            attrs={"axis": tuple(axes) or None}, rebuild="std")
 
     def variance(self, x, *axes, name=None):
         return self.sd._record_fn(
-            "variance", lambda v, axis=None: jnp.var(v, axis=axis, ddof=1),
-            [x.name], name=name, attrs={"axis": tuple(axes) or None})
+            "variance", _make_variance_fn({}), [x.name], name=name,
+            attrs={"axis": tuple(axes) or None}, rebuild="variance")
 
 
 class SDNN(_Namespace):
@@ -252,15 +266,12 @@ class SDNN(_Namespace):
     def multiHeadDotProductAttention(self, q, kv, wq, wk, wv, wo,
                                      num_heads, mask=None, name=None):
         ins = [q, kv, wq, wk, wv, wo] + ([mask] if mask is not None else [])
-        if mask is not None:
-            fn = lambda a, b, c, d, e, f, m, num_heads: op_registry.get(
-                "multi_head_dot_product_attention")(a, b, c, d, e, f, num_heads=num_heads, mask=m)
-        else:
-            fn = lambda a, b, c, d, e, f, num_heads: op_registry.get(
-                "multi_head_dot_product_attention")(a, b, c, d, e, f, num_heads=num_heads)
-        return self.sd._record_fn("multi_head_dot_product_attention", fn,
+        attrs = {"num_heads": num_heads, "has_mask": mask is not None}
+        return self.sd._record_fn("multi_head_dot_product_attention",
+                                  _make_mha_fn(attrs),
                                   [self.sd._as_var(v).name for v in ins],
-                                  name=name, attrs={"num_heads": num_heads})
+                                  name=name, attrs=attrs,
+                                  rebuild="multi_head_dot_product_attention")
 
 
 class SDCNN(_Namespace):
@@ -538,12 +549,12 @@ class SameDiff:
 
     def _record_fn(self, op: str, fn: Callable, input_names: List[str],
                    name: str = None, n_out: int = 1, attrs: Dict = None,
-                   registry_op: bool = False):
+                   registry_op: bool = False, rebuild: str = None):
         attrs = attrs or {}
         base = name or op
         out_names = [self._unique(base if n_out == 1 else f"{base}:{i}")
                      for i in range(n_out)]
-        node = _Node(op, fn, list(input_names), out_names, attrs)
+        node = _Node(op, fn, list(input_names), out_names, attrs, rebuild=rebuild)
         self._nodes.append(node)
         self._invalidate()
         outs = []
@@ -634,7 +645,7 @@ class SameDiff:
         if rng_key is None:
             rng_key = jax.random.PRNGKey(self._step)
         return self._fn_cache[key](self._variables, self._constants, phs,
-                                   rng_key, train)
+                                   rng_key, train=train)
 
     def output(self, placeholders: Dict[str, Any], outputs: Sequence[str],
                train: bool = False) -> Dict[str, jax.Array]:
@@ -698,7 +709,8 @@ class SameDiff:
                           static_argnames=("train",))
             self._grad_cache[key] = gfn
         var_g, ph_g = self._grad_cache[key](self._variables, self._constants, phs,
-                                            jax.random.PRNGKey(self._step), False)
+                                            jax.random.PRNGKey(self._step),
+                                            train=False)
         merged = {**ph_g, **var_g}
         return {k: merged[k] for k in wrt}
 
@@ -731,7 +743,10 @@ class SameDiff:
             new_vars, new_state = {}, {}
             for k, g in grads.items():
                 u, s = updater.apply(g, opt_state[k], lr, t)
-                if isinstance(updater, upd.AdamW) and updater.weight_decay:
+                if (isinstance(updater, upd.AdamW) and updater.weight_decay
+                        and variables[k].ndim >= 2):
+                    # decoupled decay on weight matrices only — biases and
+                    # norm scales (1-D) are exempt, like the loss-side L1/L2
                     u = u + updater.weight_decay_update(variables[k], lr)
                 new_vars[k] = variables[k] - u
                 new_state[k] = s
@@ -786,11 +801,14 @@ class SameDiff:
                 self._variables, self._updater_state, loss = train_step(
                     self._variables, self._constants, self._updater_state,
                     jnp.asarray(self._step, jnp.float32), phs, rng)
-                hist.loss_curve.append(float(loss))
+                # keep losses on-device during the epoch; convert in bulk at
+                # the end (per-step float() blocks the pipeline on every step)
+                hist.loss_curve.append(loss)
                 self._step += 1
                 for lst in self._listeners:
                     if hasattr(lst, "iterationDone"):
-                        lst.iterationDone(self, self._step, float(loss))
+                        lst.iterationDone(self, self._step, loss)
+        hist.loss_curve = [float(l) for l in jax.device_get(hist.loss_curve)]
         return hist
 
     # ---------------------------------------------------------- control flow
@@ -837,20 +855,35 @@ class SameDiff:
     # ------------------------------------------------------- save / load
     def save(self, path: str, save_updater_state: bool = True):
         """ref: SameDiff.save (FlatBuffers zip). Format: zip with graph.json
-        + arrays.npz (+ updater state). Nodes recorded via _record_fn with
-        non-registry callables are rejected (not serializable)."""
+        + arrays.npz (+ updater state).
+
+        Closure-backed nodes (attention, std/variance, getitem, RNG ops)
+        serialize via a rebuild key + attrs and are reconstructed at load().
+        ``while_loop``/``cond`` are explicitly NOT serializable: their bodies
+        are arbitrary Python callables (the reference serializes interpreted
+        Enter/Exit/Merge frames; the TPU rebuild compiles bodies to
+        lax.while_loop/cond, which have no data representation) — save()
+        raises with this explanation, callers must rebuild such graphs from
+        code."""
         graph = {"nodes": [], "placeholders": {k: [list(v[0]) if v[0] else None,
                                                    str(np.dtype(v[1]) if not isinstance(v[1], str) else v[1])]
                                                for k, v in self._placeholders.items()},
                  "loss_variables": self._loss_variables,
                  "step": self._step}
         for node in self._nodes:
-            if not op_registry.has(node.op):
-                raise ValueError(f"node '{node.op}' is not a registry op; not serializable")
-            attrs = {k: v for k, v in node.attrs.items() if k != "__rng__"}
-            graph["nodes"].append({"op": node.op, "inputs": node.inputs,
-                                   "outputs": node.outputs, "attrs": attrs,
-                                   "rng": bool(node.attrs.get("__rng__"))})
+            spec = {"op": node.op, "inputs": node.inputs,
+                    "outputs": node.outputs,
+                    "attrs": {k: v for k, v in node.attrs.items() if k != "__rng__"},
+                    "rng": bool(node.attrs.get("__rng__"))}
+            if node.rebuild is not None:
+                spec["rebuild"] = node.rebuild
+            elif not op_registry.has(node.op):
+                raise ValueError(
+                    f"node '{node.op}' is not serializable: its body is an "
+                    f"arbitrary Python closure (while_loop/cond bodies are "
+                    f"compiled to lax primitives and have no data form — "
+                    f"rebuild such graphs from code after load)")
+            graph["nodes"].append(spec)
         if self.training_config is not None:
             graph["training_config"] = self.training_config.to_config()
         arrays = {f"var::{k}": np.asarray(v) for k, v in self._variables.items()}
@@ -888,13 +921,19 @@ class SameDiff:
             elif kind == "upd":
                 upd_leaves[int(name)] = jnp.asarray(arrays[k])
         for nd_spec in graph["nodes"]:
-            fn = op_registry.get(nd_spec["op"])
             attrs = dict(nd_spec["attrs"])
-            attrs = {k: (tuple(v) if isinstance(v, list) else v) for k, v in attrs.items()}
-            if nd_spec.get("rng"):
+            attrs = {k: (tuple(v) if isinstance(v, list) and k != "index" else v)
+                     for k, v in attrs.items()}
+            rebuild = nd_spec.get("rebuild")
+            if rebuild is not None:
+                fn = _FN_REBUILDERS[rebuild](attrs)
+            elif nd_spec.get("rng"):
                 fn = _make_rng_fn(nd_spec["op"], attrs)
                 attrs["__rng__"] = True
-            node = _Node(nd_spec["op"], fn, nd_spec["inputs"], nd_spec["outputs"], attrs)
+            else:
+                fn = op_registry.get(nd_spec["op"])
+            node = _Node(nd_spec["op"], fn, nd_spec["inputs"], nd_spec["outputs"],
+                         attrs, rebuild=rebuild)
             sd._nodes.append(node)
             for on in node.outputs:
                 sd._vars[on] = SDVariable(sd, on, "ARRAY")
@@ -920,6 +959,73 @@ def _make_rng_fn(op: str, params: Dict) -> Callable:
     shape = tuple(params.pop("shape"))
     kw = dict(params)
     return lambda key, train: inner(key, shape, **kw)
+
+
+def _encode_index(idx):
+    """JSON-able encoding of a numpy-style index (for serializable getitem)."""
+    if isinstance(idx, tuple):
+        return {"tuple": [_encode_index(i) for i in idx]}
+    if isinstance(idx, slice):
+        return {"slice": [idx.start, idx.stop, idx.step]}
+    if idx is Ellipsis:
+        return {"ellipsis": True}
+    if idx is None:
+        return {"newaxis": True}
+    if isinstance(idx, (int, np.integer)) and not isinstance(idx, (bool, np.bool_)):
+        return int(idx)
+    if isinstance(idx, list) or (isinstance(idx, np.ndarray) and idx.ndim == 1
+                                 and np.issubdtype(idx.dtype, np.integer)):
+        return {"list": [int(i) for i in idx]}
+    raise TypeError(f"unsupported index for serializable getitem: {idx!r}")
+
+
+def _decode_index(spec):
+    if isinstance(spec, int):
+        return spec
+    if "tuple" in spec:
+        return tuple(_decode_index(s) for s in spec["tuple"])
+    if "slice" in spec:
+        return slice(*spec["slice"])
+    if "ellipsis" in spec:
+        return Ellipsis
+    if "newaxis" in spec:
+        return None
+    return list(spec["list"])
+
+
+def _make_getitem_fn(attrs):
+    idx = _decode_index(attrs["index"])
+    return lambda x, index=None: x[idx]
+
+
+def _make_std_fn(attrs):
+    return lambda v, axis=None: jnp.std(v, axis=axis, ddof=1)
+
+
+def _make_variance_fn(attrs):
+    return lambda v, axis=None: jnp.var(v, axis=axis, ddof=1)
+
+
+def _make_mha_fn(attrs):
+    """Rebuild the multiHeadDotProductAttention closure; the mask (when
+    recorded) is a graph input, passed positionally after the six weights."""
+    inner = op_registry.get("multi_head_dot_product_attention")
+    if attrs.get("has_mask"):
+        def fn(q, kv, wq, wk, wv, wo, m, num_heads=None, has_mask=True):
+            return inner(q, kv, wq, wk, wv, wo, num_heads=num_heads, mask=m)
+    else:
+        def fn(q, kv, wq, wk, wv, wo, num_heads=None, has_mask=False):
+            return inner(q, kv, wq, wk, wv, wo, num_heads=num_heads)
+    return fn
+
+
+# rebuild-key -> closure builder; save() records the key, load() calls it
+_FN_REBUILDERS = {
+    "getitem": _make_getitem_fn,
+    "std": _make_std_fn,
+    "variance": _make_variance_fn,
+    "multi_head_dot_product_attention": _make_mha_fn,
+}
 
 
 def _treedef_to_json(tree):

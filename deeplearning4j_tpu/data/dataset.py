@@ -6,9 +6,11 @@ Reference parity: ``org.nd4j.linalg.dataset.{DataSet, MultiDataSet}``,
 "DataSet API"), and ``AsyncDataSetIterator`` (background prefetch,
 §2.2 "Iterators").
 
-TPU-native: arrays stay as numpy on host until the train step moves a
-batch to device; AsyncDataSetIterator double-buffers host→device transfer
-behind compute.
+TPU-native: host arrays stay as numpy until the train step moves a batch
+to device; arrays that are ALREADY device-resident (jax.Array) are kept
+as-is — coercing them to numpy would round-trip every batch through the
+host link on each step. AsyncDataSetIterator double-buffers host→device
+transfer behind compute.
 """
 
 from __future__ import annotations
@@ -17,7 +19,15 @@ import queue
 import threading
 from typing import Iterator, List, Optional, Sequence
 
+import jax
 import numpy as np
+
+
+def _as_batch_array(a):
+    """numpy for host data, untouched for device-resident arrays."""
+    if a is None or isinstance(a, jax.Array):
+        return a
+    return np.asarray(a)
 
 
 class DataSet:
@@ -25,10 +35,10 @@ class DataSet:
 
     def __init__(self, features=None, labels=None,
                  features_mask=None, labels_mask=None):
-        self.features = np.asarray(features) if features is not None else None
-        self.labels = np.asarray(labels) if labels is not None else None
-        self.features_mask = np.asarray(features_mask) if features_mask is not None else None
-        self.labels_mask = np.asarray(labels_mask) if labels_mask is not None else None
+        self.features = _as_batch_array(features)
+        self.labels = _as_batch_array(labels)
+        self.features_mask = _as_batch_array(features_mask)
+        self.labels_mask = _as_batch_array(labels_mask)
 
     def getFeatures(self):
         return self.features
@@ -99,7 +109,7 @@ class MultiDataSet:
 
     def __init__(self, features: Sequence, labels: Sequence,
                  features_masks: Sequence = None, labels_masks: Sequence = None):
-        as_list = lambda x: [np.asarray(a) for a in x] if x is not None else None
+        as_list = lambda x: [_as_batch_array(a) for a in x] if x is not None else None
         self.features = as_list(features if isinstance(features, (list, tuple)) else [features])
         self.labels = as_list(labels if isinstance(labels, (list, tuple)) else [labels])
         self.features_masks = as_list(features_masks)

@@ -109,8 +109,14 @@ class Evaluation:
         return float(np.mean(vals)) if vals else 0.0
 
     def f1(self, cls: int = None) -> float:
-        p, r = self.precision(cls), self.recall(cls)
-        return float(2 * p * r / max(p + r, 1e-12))
+        if cls is not None:
+            p, r = self.precision(cls), self.recall(cls)
+            return float(2 * p * r / max(p + r, 1e-12))
+        # reference macro-F1 = mean of per-class F1 (NOT F1 of macro P/R)
+        vals = [self.f1(c) for c in range(self.num_classes)
+                if (self.confusion.matrix[:, c].sum()
+                    + self.confusion.matrix[c, :].sum()) > 0]
+        return float(np.mean(vals)) if vals else 0.0
 
     def falsePositiveRate(self, cls: int) -> float:
         fp = self._fp(cls)

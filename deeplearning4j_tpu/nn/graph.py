@@ -485,13 +485,16 @@ class ComputationGraph:
             elif base.grad_norm == "clip_global":
                 grads = upd.clip_by_global_norm(grads, base.grad_norm_threshold)
             lr = updater.lr_at(t)
-            leaves, treedef = jax.tree_util.tree_flatten(params)
+            path_leaves, treedef = jax.tree_util.tree_flatten_with_path(params)
             g_leaves = treedef.flatten_up_to(grads)
             s_leaves = treedef.flatten_up_to(opt_state)
             new_p, new_s = [], []
-            for pv, gv, sv in zip(leaves, g_leaves, s_leaves):
+            for (path, pv), gv, sv in zip(path_leaves, g_leaves, s_leaves):
                 u, s2 = updater.apply(gv, sv, lr, t)
-                if isinstance(updater, upd.AdamW) and updater.weight_decay:
+                leaf_name = str(getattr(path[-1], "key", path[-1]))
+                if (isinstance(updater, upd.AdamW) and updater.weight_decay
+                        and leaf_name.startswith(("W", "RW"))):
+                    # decoupled decay on weight matrices only (see multilayer)
                     u = u + updater.weight_decay_update(pv, lr)
                 new_p.append(pv - u)
                 new_s.append(s2)
@@ -555,7 +558,9 @@ class ComputationGraph:
             self._params, self._states, self._opt_state,
             jnp.asarray(self._iteration, jnp.float32), ins, labels,
             lmasks if lmasks is not None else dummy, key)
-        self._score = float(loss)
+        # on-device; score() converts lazily (per-step host sync is ~20x the
+        # step cost through a high-latency device link)
+        self._score = loss
         self._last_batch_size = int(next(iter(ins.values())).shape[0])
         self._iteration += 1
         for lst in self._listeners:
@@ -565,6 +570,8 @@ class ComputationGraph:
     # ------------------------------------------------------------- utilities
     def score(self, ds=None) -> float:
         if ds is None:
+            if self._score is not None and not isinstance(self._score, float):
+                self._score = float(self._score)
             return self._score
         if isinstance(ds, MultiDataSet):
             ins = {n: jnp.asarray(a) for n, a in zip(self.conf.graph_inputs, ds.features)}

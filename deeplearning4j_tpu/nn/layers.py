@@ -869,12 +869,18 @@ class RnnOutputLayer(BaseOutputLayer):
         return a, state
 
     def compute_loss(self, labels, preds, mask=None):
-        """labels/preds [N, C, T]; mask [N, T]. Flatten time into batch
-        (reference scores per-timestep)."""
+        """labels/preds [N, C, T]; mask [N, T]. The reference sums each
+        example's per-timestep losses and divides by the minibatch size N
+        (NOT by N*T) — preserved here so LR settings transfer from reference
+        configs. Flattens time into batch for the loss kernel, then rescales
+        the per-row mean back to sum-over-time / N."""
+        n = labels.shape[0]
         lab = jnp.reshape(jnp.transpose(labels, (0, 2, 1)), (-1, labels.shape[1]))
         pre = jnp.reshape(jnp.transpose(preds, (0, 2, 1)), (-1, preds.shape[1]))
         m = jnp.reshape(mask, (-1,)) if mask is not None else None
-        return loss_ops.get(self.loss_fn)(lab, pre, mask=m)
+        per_row_mean = loss_ops.get(self.loss_fn)(lab, pre, mask=m)
+        n_rows = jnp.maximum(jnp.sum(m), 1.0) if m is not None else lab.shape[0]
+        return per_row_mean * n_rows / n
 
     def output_type(self, it: InputType) -> InputType:
         return InputType.recurrent(self.nOut, it.dims.get("timesteps", -1))

@@ -47,6 +47,7 @@ class MultiLayerNetwork:
         self._epoch = 0
         self._listeners: List[Any] = []
         self._train_step_cache = {}
+        self._tbptt_step_cache = {}
         self._fwd_cache = None
         self._score = float("nan")
         self._initialized = False
@@ -64,6 +65,7 @@ class MultiLayerNetwork:
             self._states.append(s)
         self._opt_state = None
         self._train_step_cache = {}
+        self._tbptt_step_cache = {}
         self._fwd_cache = None
         self._initialized = True
         return self
@@ -154,13 +156,18 @@ class MultiLayerNetwork:
             elif base.grad_norm == "renorm":
                 grads = upd.renormalize_l2(grads)
             lr = updater.lr_at(t)
-            p_leaves, treedef = jax.tree_util.tree_flatten(params)
+            path_leaves, treedef = jax.tree_util.tree_flatten_with_path(params)
+            p_leaves = [leaf for _, leaf in path_leaves]
             g_leaves = treedef.flatten_up_to(grads)
             s_leaves = treedef.flatten_up_to(opt_state)
             new_p, new_s = [], []
-            for pv, gv, sv in zip(p_leaves, g_leaves, s_leaves):
+            for (path, pv), gv, sv in zip(path_leaves, g_leaves, s_leaves):
                 u, s2 = updater.apply(gv, sv, lr, t)
-                if isinstance(updater, upd.AdamW) and updater.weight_decay:
+                leaf_name = str(getattr(path[-1], "key", path[-1]))
+                if (isinstance(updater, upd.AdamW) and updater.weight_decay
+                        and leaf_name.startswith(("W", "RW"))):
+                    # decoupled decay on weight matrices only, matching the
+                    # loss-side L1/L2 gating in _loss_and_reg
                     u = u + updater.weight_decay_update(pv, lr)
                 new_p.append(pv - u)
                 new_s.append(s2)
@@ -222,7 +229,10 @@ class MultiLayerNetwork:
             jnp.asarray(self._iteration, jnp.float32), x, y,
             fmask if fmask is not None else dummy,
             lmask if lmask is not None else dummy, key)
-        self._score = float(loss)
+        # keep the loss on-device: a float() here would block on the whole
+        # step through the (high-latency) host<->device link every iteration;
+        # score() converts lazily when someone actually asks
+        self._score = loss
         self._last_batch_size = int(ds.features.shape[0])
         self._iteration += 1
         for lst in self._listeners:
@@ -233,6 +243,8 @@ class MultiLayerNetwork:
     def score(self, ds: DataSet = None) -> float:
         """Last minibatch score, or score of a given DataSet (ref: score())."""
         if ds is None:
+            if self._score is not None and not isinstance(self._score, float):
+                self._score = float(self._score)
             return self._score
         loss, _ = self._loss_and_reg(
             self._params, self._states, jnp.asarray(ds.features),
@@ -384,54 +396,69 @@ class MultiLayerNetwork:
                 DataSet(feats, labels, fmask, lmask), seg_states)
         return self
 
+    def _make_tbptt_step(self, with_lmask: bool):
+        """Compiled TBPTT segment step (one XLA program, cached — the jit
+        retraces only when the carried-state pytree structure changes, i.e.
+        once after the first segment materializes RNN states)."""
+        base = self.conf.base
+        updater = base.updater
+
+        def step(params, states, opt_state, t, x, y, lmask, seg_states):
+            def loss_fn(p):
+                cur = x
+                key = jax.random.PRNGKey(0)
+                new_seg = []
+                for i, layer in enumerate(self.layers):
+                    if i in self.conf.preprocessors:
+                        cur = self.conf.preprocessors[i](cur)
+                    key, sub = jax.random.split(key)
+                    if hasattr(layer, "apply_with_state"):
+                        cur, s_new = layer.apply_with_state(p[i], cur,
+                                                            seg_states[i])
+                        new_seg.append(jax.tree_util.tree_map(
+                            jax.lax.stop_gradient, s_new))
+                    else:
+                        if isinstance(layer, _MASK_AWARE):
+                            cur, _ = layer.apply(p[i], states[i], cur,
+                                                 True, sub, mask=None)
+                        else:
+                            cur, _ = layer.apply(p[i], states[i], cur,
+                                                 True, sub)
+                        new_seg.append(None)
+                loss = self.layers[-1].compute_loss(
+                    y, cur, mask=lmask if with_lmask else None)
+                return loss, new_seg
+
+            (loss, new_seg), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            lr = updater.lr_at(t)
+            p_leaves, treedef = jax.tree_util.tree_flatten(params)
+            g_leaves = treedef.flatten_up_to(grads)
+            s_leaves = treedef.flatten_up_to(opt_state)
+            new_p, new_s = [], []
+            for pv, gv, sv in zip(p_leaves, g_leaves, s_leaves):
+                u, s2 = updater.apply(gv, sv, lr, t)
+                new_p.append(pv - u)
+                new_s.append(s2)
+            return (jax.tree_util.tree_unflatten(treedef, new_p),
+                    jax.tree_util.tree_unflatten(treedef, new_s), loss, new_seg)
+        return jax.jit(step)
+
     def _fit_one_tbptt(self, ds: DataSet, seg_states):
         """One TBPTT segment: like _fit_one but threading initial RNN state
         in and detached final state out."""
         x = jnp.asarray(ds.features)
         y = jnp.asarray(ds.labels)
-        base = self.conf.base
-        updater = base.updater
         self._ensure_opt_state()
-
-        def loss_fn(params):
-            cur = x
-            key = jax.random.PRNGKey(0)
-            new_seg = []
-            for i, layer in enumerate(self.layers):
-                if i in self.conf.preprocessors:
-                    cur = self.conf.preprocessors[i](cur)
-                key, sub = jax.random.split(key)
-                if hasattr(layer, "apply_with_state"):
-                    cur, s_new = layer.apply_with_state(params[i], cur,
-                                                        seg_states[i])
-                    new_seg.append(jax.tree_util.tree_map(
-                        jax.lax.stop_gradient, s_new))
-                else:
-                    if isinstance(layer, _MASK_AWARE):
-                        cur, _ = layer.apply(params[i], self._states[i], cur,
-                                             True, sub, mask=None)
-                    else:
-                        cur, _ = layer.apply(params[i], self._states[i], cur,
-                                             True, sub)
-                    new_seg.append(None)
-            loss = self.layers[-1].compute_loss(y, cur, mask=(
-                jnp.asarray(ds.labels_mask) if ds.labels_mask is not None else None))
-            return loss, new_seg
-
-        (loss, new_seg), grads = jax.value_and_grad(loss_fn, has_aux=True)(self._params)
-        lr = updater.lr_at(jnp.asarray(self._iteration, jnp.float32))
-        p_leaves, treedef = jax.tree_util.tree_flatten(self._params)
-        g_leaves = treedef.flatten_up_to(grads)
-        s_leaves = treedef.flatten_up_to(self._opt_state)
-        new_p, new_s = [], []
-        t = jnp.asarray(self._iteration, jnp.float32)
-        for pv, gv, sv in zip(p_leaves, g_leaves, s_leaves):
-            u, s2 = updater.apply(gv, sv, lr, t)
-            new_p.append(pv - u)
-            new_s.append(s2)
-        self._params = jax.tree_util.tree_unflatten(treedef, new_p)
-        self._opt_state = jax.tree_util.tree_unflatten(treedef, new_s)
-        self._score = float(loss)
+        lmask = jnp.asarray(ds.labels_mask) if ds.labels_mask is not None else None
+        sig = lmask is not None
+        if sig not in self._tbptt_step_cache:
+            self._tbptt_step_cache[sig] = self._make_tbptt_step(sig)
+        step = self._tbptt_step_cache[sig]
+        self._params, self._opt_state, loss, new_seg = step(
+            self._params, self._states, self._opt_state,
+            jnp.asarray(self._iteration, jnp.float32), x, y,
+            lmask if lmask is not None else jnp.zeros((1,)), seg_states)
+        self._score = loss  # on-device; score() converts lazily
         self._iteration += 1
         return new_seg
 

@@ -349,3 +349,92 @@ class TestReviewRegressions:
         b = sd.var("b", np.zeros(3, np.float32))
         sd.loss.meanSquaredError(a, b, name="l")
         sd.save(str(tmp_path / "m.sdz"))
+
+
+class TestClosureNodeSerialization:
+    """Round-trips for closure-backed nodes rebuilt via _FN_REBUILDERS
+    (VERDICT r1 weak #5 / ADVICE r1 medium)."""
+
+    def _roundtrip(self, sd, tmp_path, phs, out):
+        before = np.asarray(sd.output(phs, [out])[out])
+        path = str(tmp_path / "g.sdz")
+        sd.save(path)
+        after = np.asarray(SameDiff.load(path).output(phs, [out])[out])
+        np.testing.assert_allclose(before, after, rtol=1e-6)
+        return after
+
+    def test_mha_masked_roundtrip(self, tmp_path):
+        rng = np.random.RandomState(0)
+        d, h = 8, 2
+        sd = SameDiff.create()
+        q = sd.placeHolder("q", shape=(None, 5, d))
+        kv = sd.placeHolder("kv", shape=(None, 5, d))
+        wq = sd.var("wq", rng.randn(d, d).astype(np.float32) * 0.1)
+        wk = sd.var("wk", rng.randn(d, d).astype(np.float32) * 0.1)
+        wv = sd.var("wv", rng.randn(d, d).astype(np.float32) * 0.1)
+        wo = sd.var("wo", rng.randn(d, d).astype(np.float32) * 0.1)
+        # mask broadcastable to [B, H, Tq, Tk]: block the last two keys
+        mask = sd.constant(
+            np.asarray([1, 1, 1, 0, 0], np.float32).reshape(1, 1, 1, 5), name="m")
+        sd.nn.multiHeadDotProductAttention(q, kv, wq, wk, wv, wo, num_heads=h,
+                                           mask=mask, name="att")
+        phs = {"q": rng.randn(1, 5, d).astype(np.float32),
+               "kv": rng.randn(1, 5, d).astype(np.float32)}
+        self._roundtrip(sd, tmp_path, phs, "att")
+
+    def test_mha_unmasked_roundtrip(self, tmp_path):
+        rng = np.random.RandomState(1)
+        d, h = 8, 2
+        sd = SameDiff.create()
+        q = sd.placeHolder("q", shape=(None, 4, d))
+        wq = sd.var("wq", rng.randn(d, d).astype(np.float32) * 0.1)
+        wk = sd.var("wk", rng.randn(d, d).astype(np.float32) * 0.1)
+        wv = sd.var("wv", rng.randn(d, d).astype(np.float32) * 0.1)
+        wo = sd.var("wo", rng.randn(d, d).astype(np.float32) * 0.1)
+        sd.nn.multiHeadDotProductAttention(q, q, wq, wk, wv, wo, num_heads=h,
+                                           name="att")
+        phs = {"q": rng.randn(2, 4, d).astype(np.float32)}
+        self._roundtrip(sd, tmp_path, phs, "att")
+
+    def test_std_variance_roundtrip(self, tmp_path):
+        rng = np.random.RandomState(2)
+        sd = SameDiff.create()
+        x = sd.placeHolder("x", shape=(None, 4))
+        sd.math.std(x, 1, name="s")
+        sd.math.variance(x, 0, name="v")
+        data = rng.randn(3, 4).astype(np.float32)
+        before_s = np.asarray(sd.output({"x": data}, ["s"])["s"])
+        before_v = np.asarray(sd.output({"x": data}, ["v"])["v"])
+        path = str(tmp_path / "sv.sdz")
+        sd.save(path)
+        sd2 = SameDiff.load(path)
+        np.testing.assert_allclose(
+            np.asarray(sd2.output({"x": data}, ["s"])["s"]), before_s, rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(sd2.output({"x": data}, ["v"])["v"]), before_v, rtol=1e-6)
+        np.testing.assert_allclose(before_s, np.std(data, axis=1, ddof=1), rtol=1e-5)
+
+    def test_getitem_roundtrip(self, tmp_path):
+        rng = np.random.RandomState(3)
+        sd = SameDiff.create()
+        x = sd.placeHolder("x", shape=(None, 6))
+        x[1:3, ::2].rename("g")
+        data = rng.randn(5, 6).astype(np.float32)
+        after = self._roundtrip(sd, tmp_path, {"x": data}, "g")
+        np.testing.assert_allclose(after, data[1:3, ::2], rtol=1e-6)
+
+    def test_getitem_int_and_newaxis_roundtrip(self, tmp_path):
+        rng = np.random.RandomState(4)
+        sd = SameDiff.create()
+        x = sd.placeHolder("x", shape=(None, 6))
+        x[(0, None, Ellipsis)].rename("g")
+        data = rng.randn(5, 6).astype(np.float32)
+        after = self._roundtrip(sd, tmp_path, {"x": data}, "g")
+        np.testing.assert_allclose(after, data[0, None, ...], rtol=1e-6)
+
+    def test_while_loop_save_refused_with_reason(self, tmp_path):
+        sd = SameDiff.create()
+        i = sd.var("i", np.asarray(0.0, np.float32))
+        sd.while_loop(lambda v: v < 5.0, lambda v: v + 1.0, [i])
+        with pytest.raises(ValueError, match="not serializable"):
+            sd.save(str(tmp_path / "wl.sdz"))
