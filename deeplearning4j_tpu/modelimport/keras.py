@@ -1,0 +1,524 @@
+"""Keras h5 model import.
+
+Reference parity: ``org.deeplearning4j.nn.modelimport.keras`` —
+``KerasModelImport.importKerasSequentialModelAndWeights`` /
+``importKerasModelAndWeights``, ``Hdf5Archive``, and the per-layer
+``KerasLayer`` mapping classes (~60 in the reference; SURVEY.md §2.2 "Keras
+import"). The reference parses the ``model_config`` JSON attribute + the
+``model_weights`` HDF5 group and rebuilds the net in DL4J conventions;
+this module does the same onto ``nn/layers.py``.
+
+Convention translation (same choices as the reference):
+- Keras is channels-last (NHWC / [N, T, C]); the rebuilt net uses the
+  DL4J conventions NCHW / [N, C, T]. Feed inputs accordingly.
+- Conv kernels [kH, kW, cIn, cOut] -> our [cOut, cIn, kH, kW].
+- Dense following a Flatten of a conv feature map: kernel rows are
+  reordered from Keras's (h, w, c) flattening to our (c, h, w)
+  flattening, so outputs match exactly.
+- LSTM gate order is [i, f, g(c), o] in both Keras and this framework —
+  kernels map through unchanged (the reference had to reorder DL4J's
+  [c, f, o, i]... we chose Keras order at design time).
+
+Only h5py is required (no TensorFlow/Keras at import time).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn import layers as L
+from deeplearning4j_tpu.nn import preprocessors as pp
+from deeplearning4j_tpu.nn.config import (InputType, NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.graph import (ComputationGraph, ElementWiseVertex,
+                                         MergeVertex)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+class KerasImportError(ValueError):
+    """ref: InvalidKerasConfigurationException / UnsupportedKerasConfigurationException."""
+
+
+class Hdf5Archive:
+    """Read-only view of a Keras h5 file (ref: modelimport.keras.Hdf5Archive)."""
+
+    def __init__(self, path: str):
+        import h5py
+        self._f = h5py.File(path, "r")
+
+    def close(self):
+        self._f.close()
+
+    def _attr(self, name: str, group=None):
+        g = self._f if group is None else self._f[group]
+        if name not in g.attrs:
+            return None
+        v = g.attrs[name]
+        if isinstance(v, bytes):
+            v = v.decode("utf-8")
+        return v
+
+    def model_config(self) -> Dict:
+        raw = self._attr("model_config")
+        if raw is None:
+            raise KerasImportError("h5 file has no 'model_config' attribute "
+                                   "(weights-only file? full-model save required)")
+        return json.loads(raw)
+
+    def keras_version(self) -> str:
+        v = self._attr("keras_version") or self._attr("keras_version", "model_weights")
+        return v or "unknown"
+
+    def layer_weights(self, layer_name: str) -> Dict[str, np.ndarray]:
+        """Weights of one layer keyed by basename (kernel, bias, gamma, ...)."""
+        mw = self._f["model_weights"]
+        if layer_name not in mw:
+            return {}
+        g = mw[layer_name]
+        names = g.attrs.get("weight_names", [])
+        out = {}
+        for n in names:
+            key = n.decode("utf-8") if isinstance(n, bytes) else str(n)
+            base = key.rsplit("/", 1)[-1].split(":")[0]
+            out[base] = np.asarray(g[key])
+        return out
+
+
+# --------------------------------------------------------------------------
+# per-layer mapping (ref: the ~60 KerasLayer subclasses; one function each)
+# --------------------------------------------------------------------------
+
+_ACTIVATION_MAP = {
+    "linear": "identity", "relu": "relu", "relu6": "relu6",
+    "sigmoid": "sigmoid", "tanh": "tanh", "softmax": "softmax",
+    "elu": "elu", "selu": "selu", "softplus": "softplus",
+    "softsign": "softsign", "swish": "swish", "silu": "swish",
+    "gelu": "gelu", "hard_sigmoid": "hardsigmoid", "mish": "mish",
+    "leaky_relu": "leakyrelu", "exponential": None,
+}
+
+
+def _act(name) -> str:
+    if name is None:
+        return "identity"
+    if isinstance(name, dict):  # serialized Activation object
+        name = name.get("config", {}).get("name", "linear")
+    mapped = _ACTIVATION_MAP.get(str(name).lower())
+    if mapped is None:
+        raise KerasImportError(f"unsupported Keras activation '{name}'")
+    return mapped
+
+
+def _pair(v):
+    return tuple(v) if isinstance(v, (list, tuple)) else (int(v), int(v))
+
+
+def _conv_mode(padding: str) -> Tuple[str, Tuple[int, int]]:
+    p = str(padding).lower()
+    if p == "same":
+        return "same", (0, 0)
+    if p == "valid":
+        return "truncate", (0, 0)
+    raise KerasImportError(f"unsupported Keras padding '{padding}'")
+
+
+def _flatten_perm(c: int, h: int, w: int) -> np.ndarray:
+    """Row permutation taking Keras's (h, w, c)-flattened feature index to
+    our (c, h, w) flattening: perm[our_index] = keras_index."""
+    return np.arange(h * w * c).reshape(h, w, c).transpose(2, 0, 1).reshape(-1)
+
+
+class _Imported:
+    """One mapped layer: our layer object + how to fill its params/state."""
+
+    def __init__(self, layer, kname: str, fill=None):
+        self.layer = layer
+        self.kname = kname          # keras layer name (weights group)
+        self.fill = fill            # fn(kweights, pre_it) -> (params, state)
+
+
+def _map_dense(cfg) -> _Imported:
+    lay = L.DenseLayer(nOut=int(cfg["units"]), hasBias=bool(cfg.get("use_bias", True)),
+                       activation=_act(cfg.get("activation")))
+
+    def fill(kw, pre_it):
+        W = kw["kernel"]
+        if pre_it is not None and pre_it.kind == "cnn":
+            perm = _flatten_perm(pre_it.channels, pre_it.height, pre_it.width)
+            W = W[perm]
+        params = {"W": jnp.asarray(W)}
+        if "bias" in kw:
+            params["b"] = jnp.asarray(kw["bias"])
+        return params, None
+    return _Imported(lay, cfg["name"], fill)
+
+
+def _map_conv2d(cfg) -> _Imported:
+    mode, pad = _conv_mode(cfg.get("padding", "valid"))
+    if str(cfg.get("data_format", "channels_last")) == "channels_first":
+        raise KerasImportError("channels_first Keras convs are not supported; "
+                               "save the model channels_last")
+    lay = L.ConvolutionLayer(
+        kernelSize=_pair(cfg["kernel_size"]), stride=_pair(cfg.get("strides", 1)),
+        padding=pad, dilation=_pair(cfg.get("dilation_rate", 1)),
+        nOut=int(cfg["filters"]), convolutionMode=mode,
+        hasBias=bool(cfg.get("use_bias", True)),
+        activation=_act(cfg.get("activation")))
+
+    def fill(kw, pre_it):
+        params = {"W": jnp.asarray(kw["kernel"].transpose(3, 2, 0, 1))}
+        if "bias" in kw:
+            params["b"] = jnp.asarray(kw["bias"])
+        return params, None
+    return _Imported(lay, cfg["name"], fill)
+
+
+def _map_depthwise_conv2d(cfg) -> _Imported:
+    mode, pad = _conv_mode(cfg.get("padding", "valid"))
+    lay = L.DepthwiseConvolution2D(
+        kernelSize=_pair(cfg["kernel_size"]), stride=_pair(cfg.get("strides", 1)),
+        padding=pad, depthMultiplier=int(cfg.get("depth_multiplier", 1)),
+        convolutionMode=mode, hasBias=bool(cfg.get("use_bias", True)),
+        activation=_act(cfg.get("activation")))
+
+    def fill(kw, pre_it):
+        # keras depthwise kernel [kH, kW, cIn, mult] -> ours [mult, cIn, kH, kW]
+        params = {"W": jnp.asarray(kw["kernel"].transpose(3, 2, 0, 1))}
+        if "bias" in kw:
+            params["b"] = jnp.asarray(kw["bias"])
+        return params, None
+    return _Imported(lay, cfg["name"], fill)
+
+
+def _map_pool2d(cfg, pooling: str) -> _Imported:
+    mode, pad = _conv_mode(cfg.get("padding", "valid"))
+    size = _pair(cfg.get("pool_size", 2))
+    strides = cfg.get("strides")
+    lay = L.SubsamplingLayer(poolingType=pooling, kernelSize=size,
+                             stride=_pair(strides) if strides else size,
+                             padding=pad, convolutionMode=mode)
+    return _Imported(lay, cfg["name"])
+
+
+def _map_batchnorm(cfg) -> _Imported:
+    lay = L.BatchNormalization(decay=float(cfg.get("momentum", 0.99)),
+                               eps=float(cfg.get("epsilon", 1e-3)))
+
+    def fill(kw, pre_it):
+        n = next(iter(kw.values())).shape[0]
+        params = {"gamma": jnp.asarray(kw.get("gamma", np.ones(n, np.float32))),
+                  "beta": jnp.asarray(kw.get("beta", np.zeros(n, np.float32)))}
+        state = {"mean": jnp.asarray(kw["moving_mean"]),
+                 "var": jnp.asarray(kw["moving_variance"])}
+        return params, state
+    return _Imported(lay, cfg["name"], fill)
+
+
+def _map_embedding(cfg) -> _Imported:
+    lay = L.EmbeddingSequenceLayer(nOut=int(cfg["output_dim"]))
+    lay.nIn = int(cfg["input_dim"])
+
+    def fill(kw, pre_it):
+        return {"W": jnp.asarray(kw["embeddings"])}, None
+    return _Imported(lay, cfg["name"], fill)
+
+
+def _rnn_fill(kw, pre_it):
+    params = {"W": jnp.asarray(kw["kernel"]),
+              "RW": jnp.asarray(kw["recurrent_kernel"])}
+    if "bias" in kw:
+        b = kw["bias"]
+        if b.ndim == 2:  # keras GRU/LSTM sometimes [2, 4u] (use_bias x2)
+            b = b.sum(0)
+        params["b"] = jnp.asarray(b)
+    else:
+        params["b"] = jnp.zeros(params["W"].shape[1], jnp.float32)
+    return params, None
+
+
+def _map_lstm(cfg) -> _Imported:
+    if _act(cfg.get("recurrent_activation", "sigmoid")) != "sigmoid":
+        raise KerasImportError("only sigmoid recurrent_activation LSTMs import")
+    inner = L.LSTM(nOut=int(cfg["units"]), activation=_act(cfg.get("activation", "tanh")))
+    lay = inner if cfg.get("return_sequences") else L.LastTimeStep(inner)
+    return _Imported(lay, cfg["name"], _rnn_fill)
+
+
+def _map_simple_rnn(cfg) -> _Imported:
+    inner = L.SimpleRnn(nOut=int(cfg["units"]),
+                        activation=_act(cfg.get("activation", "tanh")))
+    lay = inner if cfg.get("return_sequences") else L.LastTimeStep(inner)
+    return _Imported(lay, cfg["name"], _rnn_fill)
+
+
+def _map_activation(cfg) -> _Imported:
+    return _Imported(L.ActivationLayer(_act(cfg.get("activation"))), cfg["name"])
+
+
+def _map_dropout(cfg) -> _Imported:
+    return _Imported(L.DropoutLayer(float(cfg.get("rate", 0.5))), cfg["name"])
+
+
+def _map_global_pool(cfg, pooling: str) -> _Imported:
+    return _Imported(L.GlobalPoolingLayer(pooling), cfg["name"])
+
+
+_SKIP = {"InputLayer", "Flatten", "Reshape"}  # handled by preprocessors
+
+_MAPPERS = {
+    "Dense": _map_dense,
+    "Conv2D": _map_conv2d,
+    "DepthwiseConv2D": _map_depthwise_conv2d,
+    "MaxPooling2D": lambda c: _map_pool2d(c, "max"),
+    "AveragePooling2D": lambda c: _map_pool2d(c, "avg"),
+    "GlobalMaxPooling2D": lambda c: _map_global_pool(c, "max"),
+    "GlobalAveragePooling2D": lambda c: _map_global_pool(c, "avg"),
+    "BatchNormalization": _map_batchnorm,
+    "Embedding": _map_embedding,
+    "LSTM": _map_lstm,
+    "SimpleRNN": _map_simple_rnn,
+    "Activation": _map_activation,
+    "Dropout": _map_dropout,
+    "SpatialDropout2D": _map_dropout,
+}
+
+
+def _layer_config(entry: Dict) -> Tuple[str, Dict]:
+    """(class_name, config) from one entry of model_config['config']['layers'];
+    tolerates both Keras 2 and Keras 3 JSON shapes."""
+    return entry["class_name"], entry["config"]
+
+
+def _input_type_from_batch_shape(shape: List) -> InputType:
+    dims = [d for d in shape[1:]]
+    if len(dims) == 3:    # keras NHWC -> our convolutional(h, w, c)
+        return InputType.convolutional(dims[0], dims[1], dims[2])
+    if len(dims) == 2:    # keras [T, C] -> our recurrent(C, T)
+        return InputType.recurrent(dims[1], dims[0])
+    if len(dims) == 1:
+        return InputType.feedForward(dims[0])
+    raise KerasImportError(f"unsupported input rank {len(dims) + 1}")
+
+
+_ELEMENTWISE = {"Add": "Add", "Subtract": "Subtract", "Multiply": "Product",
+                "Average": "Average", "Maximum": "Max"}
+
+
+def _layer_refs(spec) -> List[str]:
+    """Layer names from input_layers/output_layers; Keras 3 flattens a
+    single ref to ["name", 0, 0], Keras 2 always nests [["name", 0, 0], ...]."""
+    if not spec:
+        return []
+    if isinstance(spec[0], str):
+        return [spec[0]]
+    return [x[0] for x in spec]
+
+
+def _inbound_names(entry: Dict) -> List[str]:
+    """Producer layer names for one functional-config entry; handles both the
+    Keras 3 keras_history dicts and the Keras 2 nested-list form."""
+    found: List[str] = []
+
+    def walk(o):
+        if isinstance(o, dict):
+            hist = o.get("config", {}).get("keras_history") \
+                if o.get("class_name") == "__keras_tensor__" else None
+            if hist:
+                found.append(hist[0])
+                return
+            for v in o.values():
+                walk(v)
+        elif isinstance(o, (list, tuple)):
+            if (len(o) >= 3 and isinstance(o[0], str)
+                    and isinstance(o[1], int) and isinstance(o[2], int)):
+                found.append(o[0])  # keras 2 [name, node_idx, tensor_idx, {}]
+                return
+            for v in o:
+                walk(v)
+    walk(entry.get("inbound_nodes", []))
+    return found
+
+
+class KerasModelImport:
+    """ref: modelimport.keras.KerasModelImport."""
+
+    @staticmethod
+    def importKerasModelAndWeights(path: str):
+        """Import any full-model h5: Sequential -> MultiLayerNetwork,
+        Functional -> ComputationGraph (ref: KerasModelImport entry point)."""
+        archive = Hdf5Archive(path)
+        try:
+            cls = archive.model_config().get("class_name")
+        finally:
+            archive.close()
+        if cls == "Sequential":
+            return KerasModelImport.importKerasSequentialModelAndWeights(path)
+        if cls in ("Functional", "Model"):
+            return KerasModelImport._import_functional(path)
+        raise KerasImportError(f"unsupported model class '{cls}'")
+
+    @staticmethod
+    def _import_functional(path: str) -> ComputationGraph:
+        archive = Hdf5Archive(path)
+        try:
+            cfg = archive.model_config()["config"]
+            entries = cfg["layers"]
+            in_names = _layer_refs(cfg["input_layers"])
+            out_names = _layer_refs(cfg["output_layers"])
+
+            g = NeuralNetConfiguration.Builder().graphBuilder()
+            alias: Dict[str, str] = {}     # keras name -> our producing node
+            input_types: Dict[str, InputType] = {}
+            imported: List[_Imported] = []
+
+            for entry in entries:
+                cls, lcfg = _layer_config(entry)
+                name = lcfg.get("name") or entry.get("name")
+                inbound = [alias.get(n, n) for n in _inbound_names(entry)]
+                if cls == "InputLayer":
+                    shape = lcfg.get("batch_shape") or lcfg.get("batch_input_shape")
+                    input_types[name] = _input_type_from_batch_shape(shape)
+                    alias[name] = name
+                    continue
+                if cls in _SKIP:  # Flatten/Reshape: auto-preprocessor handles it
+                    alias[name] = inbound[0]
+                    continue
+                if cls in _ELEMENTWISE:
+                    g.addVertex(name, ElementWiseVertex(_ELEMENTWISE[cls]), *inbound)
+                    alias[name] = name
+                    continue
+                if cls == "Concatenate":
+                    axis = lcfg.get("axis", -1)
+                    if axis not in (-1, 1, 3):
+                        raise KerasImportError(
+                            f"Concatenate axis {axis} unsupported (channel "
+                            f"axis only)")
+                    g.addVertex(name, MergeVertex(), *inbound)
+                    alias[name] = name
+                    continue
+                if cls not in _MAPPERS:
+                    raise KerasImportError(f"unsupported Keras layer '{cls}'")
+                imp = _MAPPERS[cls](lcfg)
+                g.addLayer(name, imp.layer, *inbound)
+                alias[name] = name
+                imported.append(imp)
+
+            g.addInputs(*in_names)
+            g.setInputTypes(*[input_types[n] for n in in_names])
+            g.setOutputs(*[alias.get(n, n) for n in out_names])
+            net = ComputationGraph(g.build())
+            net.init()
+
+            types = net.conf.types
+            node_by_name = net.conf.node_by_name
+            for imp in imported:
+                kw = archive.layer_weights(imp.kname)
+                if imp.fill is None:
+                    continue
+                if not kw:
+                    raise KerasImportError(f"no weights for layer '{imp.kname}'")
+                node = node_by_name[imp.kname]
+                src = node.inputs[0]
+                pre_it = types.get(src, input_types.get(src))
+                params, state = imp.fill(kw, pre_it)
+                target = net._params[imp.kname]
+                for k, v in params.items():
+                    if k in target and tuple(target[k].shape) != tuple(v.shape):
+                        raise KerasImportError(
+                            f"layer {imp.kname} param {k}: shape "
+                            f"{tuple(v.shape)} from h5 vs expected "
+                            f"{tuple(target[k].shape)}")
+                net._params[imp.kname] = {**target, **params}
+                if state:
+                    net._states[imp.kname] = {**net._states[imp.kname], **state}
+            return net
+        finally:
+            archive.close()
+
+    @staticmethod
+    def importKerasSequentialModelAndWeights(path: str) -> MultiLayerNetwork:
+        archive = Hdf5Archive(path)
+        try:
+            cfg = archive.model_config()
+            if cfg.get("class_name") != "Sequential":
+                raise KerasImportError(
+                    f"not a Sequential model ({cfg.get('class_name')}); use "
+                    f"importKerasModelAndWeights for functional models")
+            entries = cfg["config"]["layers"]
+
+            input_type: Optional[InputType] = None
+            imported: List[_Imported] = []
+            for entry in entries:
+                cls, lcfg = _layer_config(entry)
+                if cls == "InputLayer":
+                    shape = lcfg.get("batch_shape") or lcfg.get("batch_input_shape")
+                    input_type = _input_type_from_batch_shape(shape)
+                    continue
+                if cls in _SKIP:
+                    continue
+                if cls not in _MAPPERS:
+                    raise KerasImportError(f"unsupported Keras layer '{cls}'")
+                if input_type is None and (
+                        "batch_shape" in lcfg or "batch_input_shape" in lcfg):
+                    shape = lcfg.get("batch_shape") or lcfg.get("batch_input_shape")
+                    input_type = _input_type_from_batch_shape(shape)
+                imported.append(_MAPPERS[cls](lcfg))
+            if input_type is None:
+                raise KerasImportError("model config declares no input shape")
+
+            b = NeuralNetConfiguration.Builder().list()
+            for imp in imported:
+                b.layer(imp.layer)
+            b.setInputType(input_type)
+            net = MultiLayerNetwork(b.build())
+            net.init()
+
+            # pre-preprocessor input types (for flatten-order weight fixes)
+            pre_types = _pre_preprocessor_types(net.conf, input_type)
+            for i, imp in enumerate(imported):
+                if imp.fill is None:
+                    continue
+                kw = archive.layer_weights(imp.kname)
+                if not kw:
+                    raise KerasImportError(f"no weights for layer '{imp.kname}'")
+                params, state = imp.fill(kw, pre_types[i])
+                _assign(net, i, imp.layer, params, state)
+            return net
+        finally:
+            archive.close()
+
+
+def _pre_preprocessor_types(conf, input_type: InputType) -> List[InputType]:
+    """InputType seen at each layer BEFORE any auto-inserted preprocessor
+    (the conv-shaped type a Flatten consumed, for dense-kernel reordering)."""
+    out = []
+    cur = input_type
+    for layer in conf.layers:
+        out.append(cur)
+        pre = pp.preprocessor_for(cur, layer)
+        if pre is not None:
+            cur = pre.output_type(cur)
+        cur = layer.output_type(cur)
+    return out
+
+
+def _assign(net: MultiLayerNetwork, idx: int, layer, params: Dict, state):
+    """Install imported tensors, validating shapes against the initialized net."""
+    target = net._params[idx]
+    holder = params
+    for k, v in holder.items():
+        if k in target and tuple(target[k].shape) != tuple(v.shape):
+            raise KerasImportError(
+                f"layer {idx} param {k}: shape {tuple(v.shape)} from h5 vs "
+                f"expected {tuple(target[k].shape)}")
+    net._params[idx] = {**target, **holder}
+    if state:
+        net._states[idx] = {**net._states[idx], **state}
+
+
+importKerasSequentialModelAndWeights = \
+    KerasModelImport.importKerasSequentialModelAndWeights
+importKerasModelAndWeights = KerasModelImport.importKerasModelAndWeights

@@ -1,0 +1,419 @@
+"""TF frozen-GraphDef import into the SameDiff graph engine.
+
+Reference parity: ``nd4j/samediff-import/samediff-import-tensorflow`` —
+``TensorflowFrameworkImporter.runImport`` maps a TF GraphDef node-by-node
+into SameDiff via a declarative ``OpMappingRegistry`` (SURVEY.md §2.2
+"TF/ONNX import", §3.3 — this is how the reference's BERT enters).
+
+The TPU-native difference: the imported graph is not interpreted op-by-op;
+it becomes a SameDiff program that compiles to ONE XLA executable.
+
+The mapping registry below covers the op set used by frozen inference
+graphs of the reference's workloads (dense/conv nets, BERT-style
+encoders). Ops are recorded as closures over jnp; a frozen graph's Const
+nodes are folded so shape-carrying inputs (Reshape dims, Transpose perms,
+reduction axes) resolve statically, as XLA requires.
+
+TensorFlow is needed only to PARSE protos (tensor decode); the mapping
+and execution are TF-free.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.autodiff.samediff import SameDiff
+
+
+class TFImportError(ValueError):
+    pass
+
+
+_DTYPES = {1: np.float32, 2: np.float64, 3: np.int32, 4: np.uint8,
+           6: np.int8, 7: str, 9: np.int64, 10: bool, 14: np.float16}
+
+
+def _attr(node, name, default=None):
+    if name not in node.attr:
+        return default
+    a = node.attr[name]
+    kind = a.WhichOneof("value")
+    if kind == "i":
+        return int(a.i)
+    if kind == "f":
+        return float(a.f)
+    if kind == "b":
+        return bool(a.b)
+    if kind == "s":
+        return a.s.decode("utf-8")
+    if kind == "type":
+        return _DTYPES.get(a.type)
+    if kind == "shape":
+        return [d.size for d in a.shape.dim]
+    if kind == "list":
+        if a.list.i:
+            return [int(v) for v in a.list.i]
+        if a.list.f:
+            return [float(v) for v in a.list.f]
+        return []
+    return default
+
+
+def _tensor_value(node) -> np.ndarray:
+    """Decode a Const node's tensor proto (uses TF's own decoder)."""
+    from tensorflow.python.framework import tensor_util
+    return np.asarray(tensor_util.MakeNdarray(node.attr["value"].tensor))
+
+
+def _conv_padding(node) -> str:
+    p = _attr(node, "padding", "VALID")
+    if p not in ("SAME", "VALID"):
+        raise TFImportError(f"padding {p} unsupported ({node.name})")
+    return p
+
+
+class _Ctx:
+    """Per-import state handed to each op mapper."""
+
+    def __init__(self, sd: SameDiff):
+        self.sd = sd
+        self.consts: Dict[str, np.ndarray] = {}   # const folding table
+
+    def const_of(self, name: str) -> np.ndarray:
+        if name not in self.consts:
+            raise TFImportError(
+                f"'{name}' must be a Const in a frozen graph (shape/axis "
+                f"inputs resolve statically for XLA)")
+        return self.consts[name]
+
+
+def _rec(ctx: _Ctx, node, fn: Callable, inputs: List[str], n_out: int = 1):
+    out = ctx.sd._record_fn(node.op.lower(), fn, inputs, name=node.name,
+                            n_out=n_out)
+    return out
+
+
+# --------------------------------------------------------------- op mappers
+# each: (ctx, node, inputs[data-input var names]) -> None (records nodes)
+
+def _binop(fn):
+    def m(ctx, node, ins):
+        _rec(ctx, node, fn, ins)
+    return m
+
+
+def _unop(fn):
+    def m(ctx, node, ins):
+        _rec(ctx, node, fn, ins)
+    return m
+
+
+def _m_matmul(ctx, node, ins):
+    ta, tb = _attr(node, "transpose_a", False), _attr(node, "transpose_b", False)
+    def fn(a, b):
+        a = a.T if ta else a
+        b = b.T if tb else b
+        return a @ b
+    _rec(ctx, node, fn, ins)
+
+
+def _m_batchmatmul(ctx, node, ins):
+    ta = _attr(node, "adj_x", False)
+    tb = _attr(node, "adj_y", False)
+    def fn(a, b):
+        a = jnp.swapaxes(a, -1, -2) if ta else a
+        b = jnp.swapaxes(b, -1, -2) if tb else b
+        return jnp.matmul(a, b)
+    _rec(ctx, node, fn, ins)
+
+
+def _m_reduce(jfn):
+    def m(ctx, node, ins):
+        axes = tuple(int(v) for v in np.atleast_1d(ctx.const_of(ins[1])))
+        keep = _attr(node, "keep_dims", False)
+        _rec(ctx, node, lambda x: jfn(x, axis=axes, keepdims=keep), ins[:1])
+    return m
+
+
+def _m_reshape(ctx, node, ins):
+    shape = tuple(int(v) for v in ctx.const_of(ins[1]))
+    _rec(ctx, node, lambda x: jnp.reshape(x, shape), ins[:1])
+
+
+def _m_transpose(ctx, node, ins):
+    perm = tuple(int(v) for v in ctx.const_of(ins[1]))
+    _rec(ctx, node, lambda x: jnp.transpose(x, perm), ins[:1])
+
+
+def _m_concat(ctx, node, ins):
+    axis = int(ctx.const_of(ins[-1]))
+    _rec(ctx, node, lambda *xs: jnp.concatenate(xs, axis=axis), ins[:-1])
+
+
+def _m_split(ctx, node, ins):
+    # Split(axis, value); num_split outputs
+    n = _attr(node, "num_split")
+    axis = int(ctx.const_of(ins[0]))
+    _rec(ctx, node, lambda x: tuple(jnp.split(x, n, axis=axis)), ins[1:],
+         n_out=n)
+
+
+def _m_squeeze(ctx, node, ins):
+    dims = _attr(node, "squeeze_dims", []) or None
+    _rec(ctx, node,
+         lambda x: jnp.squeeze(x, axis=tuple(dims) if dims else None), ins)
+
+
+def _m_expand_dims(ctx, node, ins):
+    axis = int(ctx.const_of(ins[1]))
+    _rec(ctx, node, lambda x: jnp.expand_dims(x, axis), ins[:1])
+
+
+def _m_pack(ctx, node, ins):
+    axis = _attr(node, "axis", 0)
+    _rec(ctx, node, lambda *xs: jnp.stack(xs, axis=axis), ins)
+
+
+def _m_cast(ctx, node, ins):
+    dst = _attr(node, "DstT")
+    _rec(ctx, node, lambda x: x.astype(dst), ins)
+
+
+def _m_pad(ctx, node, ins):
+    pads = [tuple(int(v) for v in row) for row in ctx.const_of(ins[1])]
+    _rec(ctx, node, lambda x: jnp.pad(x, pads), ins[:1])
+
+
+def _m_softmax(ctx, node, ins):
+    _rec(ctx, node, lambda x: jax.nn.softmax(x, axis=-1), ins)
+
+
+def _m_conv2d(ctx, node, ins):
+    if _attr(node, "data_format", "NHWC") != "NHWC":
+        raise TFImportError("only NHWC TF convs import")
+    strides = _attr(node, "strides", [1, 1, 1, 1])
+    dil = _attr(node, "dilations", [1, 1, 1, 1])
+    pad = _conv_padding(node)
+    def fn(x, w):  # x NHWC, w HWIO
+        return jax.lax.conv_general_dilated(
+            x, w, window_strides=strides[1:3], padding=pad,
+            rhs_dilation=dil[1:3],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    _rec(ctx, node, fn, ins)
+
+
+def _m_depthwise_conv2d(ctx, node, ins):
+    strides = _attr(node, "strides", [1, 1, 1, 1])
+    pad = _conv_padding(node)
+    def fn(x, w):  # w [H, W, C, M] -> grouped conv with C groups
+        h, wd, c, m = w.shape
+        return jax.lax.conv_general_dilated(
+            x, jnp.reshape(jnp.transpose(w, (0, 1, 3, 2)), (h, wd, 1, c * m)),
+            window_strides=strides[1:3], padding=pad,
+            feature_group_count=c,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    _rec(ctx, node, fn, ins)
+
+
+def _pool(jfn, init):
+    def m(ctx, node, ins):
+        ks = _attr(node, "ksize", [1, 1, 1, 1])
+        st = _attr(node, "strides", [1, 1, 1, 1])
+        pad = _conv_padding(node)
+        def fn(x):
+            out = jax.lax.reduce_window(
+                x, init, jfn, window_dimensions=ks, window_strides=st,
+                padding=pad)
+            if jfn is jax.lax.add:  # avg pool: divide by window size
+                ones = jnp.ones_like(x)
+                cnt = jax.lax.reduce_window(
+                    ones, 0.0, jax.lax.add, window_dimensions=ks,
+                    window_strides=st, padding=pad)
+                out = out / cnt
+            return out
+        _rec(ctx, node, fn, ins)
+    return m
+
+
+def _m_fused_batchnorm(ctx, node, ins):
+    eps = _attr(node, "epsilon", 1e-3)
+    if _attr(node, "is_training", True):
+        raise TFImportError("only inference-mode FusedBatchNorm imports "
+                            "(freeze the graph)")
+    def fn(x, gamma, beta, mean, var):
+        inv = gamma * jax.lax.rsqrt(var + eps)
+        return x * inv + (beta - mean * inv)
+    _rec(ctx, node, fn, ins)
+
+
+def _m_gather(ctx, node, ins):
+    def fn(params, indices, axis=None):
+        ax = int(ctx.const_of(ins[2])) if len(ins) > 2 else 0
+        return jnp.take(params, indices.astype(jnp.int32), axis=ax)
+    _rec(ctx, node, fn, ins[:2])
+
+
+def _m_strided_slice(ctx, node, ins):
+    begin = [int(v) for v in ctx.const_of(ins[1])]
+    end = [int(v) for v in ctx.const_of(ins[2])]
+    step = [int(v) for v in ctx.const_of(ins[3])]
+    bm = _attr(node, "begin_mask", 0)
+    em = _attr(node, "end_mask", 0)
+    sm = _attr(node, "shrink_axis_mask", 0)
+    nm = _attr(node, "new_axis_mask", 0)
+    el = _attr(node, "ellipsis_mask", 0)
+    if nm or el:
+        raise TFImportError("new_axis/ellipsis masks unsupported in "
+                            "StridedSlice import")
+    idx = []
+    for i in range(len(begin)):
+        if sm & (1 << i):
+            idx.append(begin[i])
+        else:
+            b = None if bm & (1 << i) else begin[i]
+            e = None if em & (1 << i) else end[i]
+            idx.append(slice(b, e, step[i]))
+    _rec(ctx, node, lambda x: x[tuple(idx)], ins[:1])
+
+
+def _m_select(ctx, node, ins):
+    _rec(ctx, node, lambda c, a, b: jnp.where(c, a, b), ins)
+
+
+def _m_argmax(ctx, node, ins):
+    axis = int(ctx.const_of(ins[1])) if len(ins) > 1 else 0
+    _rec(ctx, node, lambda x: jnp.argmax(x, axis=axis), ins[:1])
+
+
+def _m_bias_add(ctx, node, ins):
+    if _attr(node, "data_format", "NHWC") == "NCHW":
+        _rec(ctx, node,
+             lambda x, b: x + b.reshape((1, -1) + (1,) * (x.ndim - 2)), ins)
+    else:
+        _rec(ctx, node, lambda x, b: x + b, ins)
+
+
+_MAPPERS: Dict[str, Callable] = {
+    "Add": _binop(lambda a, b: a + b),
+    "AddV2": _binop(lambda a, b: a + b),
+    "Sub": _binop(lambda a, b: a - b),
+    "Mul": _binop(lambda a, b: a * b),
+    "RealDiv": _binop(lambda a, b: a / b),
+    "Div": _binop(lambda a, b: a / b),
+    "Maximum": _binop(jnp.maximum),
+    "Minimum": _binop(jnp.minimum),
+    "Pow": _binop(jnp.power),
+    "SquaredDifference": _binop(lambda a, b: jnp.square(a - b)),
+    "Greater": _binop(lambda a, b: a > b),
+    "GreaterEqual": _binop(lambda a, b: a >= b),
+    "Less": _binop(lambda a, b: a < b),
+    "Equal": _binop(lambda a, b: a == b),
+    "LogicalAnd": _binop(jnp.logical_and),
+    "Relu": _unop(jax.nn.relu),
+    "Relu6": _unop(lambda x: jnp.clip(x, 0, 6)),
+    "Elu": _unop(jax.nn.elu),
+    "Selu": _unop(jax.nn.selu),
+    "Sigmoid": _unop(jax.nn.sigmoid),
+    "Tanh": _unop(jnp.tanh),
+    "Erf": _unop(jax.lax.erf),
+    "Exp": _unop(jnp.exp),
+    "Log": _unop(jnp.log),
+    "Sqrt": _unop(jnp.sqrt),
+    "Rsqrt": _unop(jax.lax.rsqrt),
+    "Square": _unop(jnp.square),
+    "Neg": _unop(jnp.negative),
+    "Abs": _unop(jnp.abs),
+    "Identity": _unop(lambda x: x),
+    "StopGradient": _unop(jax.lax.stop_gradient),
+    "Softplus": _unop(jax.nn.softplus),
+    "LeakyRelu": lambda ctx, node, ins: _rec(
+        ctx, node,
+        lambda x, alpha=_attr(node, "alpha", 0.2): jnp.where(x >= 0, x, alpha * x),
+        ins),
+    "MatMul": _m_matmul,
+    "BatchMatMul": _m_batchmatmul,
+    "BatchMatMulV2": _m_batchmatmul,
+    "BiasAdd": _m_bias_add,
+    "Softmax": _m_softmax,
+    "Mean": _m_reduce(jnp.mean),
+    "Sum": _m_reduce(jnp.sum),
+    "Max": _m_reduce(jnp.max),
+    "Min": _m_reduce(jnp.min),
+    "Prod": _m_reduce(jnp.prod),
+    "Reshape": _m_reshape,
+    "Transpose": _m_transpose,
+    "ConcatV2": _m_concat,
+    "Split": _m_split,
+    "Squeeze": _m_squeeze,
+    "ExpandDims": _m_expand_dims,
+    "Pack": _m_pack,
+    "Cast": _m_cast,
+    "Pad": _m_pad,
+    "Conv2D": _m_conv2d,
+    "DepthwiseConv2dNative": _m_depthwise_conv2d,
+    "MaxPool": _pool(jax.lax.max, -np.inf),
+    "AvgPool": _pool(jax.lax.add, 0.0),
+    "FusedBatchNorm": _m_fused_batchnorm,
+    "FusedBatchNormV3": _m_fused_batchnorm,
+    "GatherV2": _m_gather,
+    "Gather": _m_gather,
+    "StridedSlice": _m_strided_slice,
+    "Select": _m_select,
+    "SelectV2": _m_select,
+    "ArgMax": _m_argmax,
+}
+
+
+def _var_name(ref: str) -> str:
+    """TF input ref 'name', 'name:0', 'name:k' -> our variable name."""
+    if ":" in ref:
+        base, idx = ref.rsplit(":", 1)
+        return base if idx == "0" else f"{base}:{idx}"
+    return ref
+
+
+class TFGraphImport:
+    """ref: TensorflowFrameworkImporter (samediff-import-tensorflow)."""
+
+    @staticmethod
+    def importGraphDef(graph_def) -> SameDiff:
+        """Frozen GraphDef (or path to a binary .pb) -> SameDiff."""
+        if isinstance(graph_def, (str, bytes)) and not hasattr(graph_def, "node"):
+            from tensorflow.core.framework import graph_pb2
+            gd = graph_pb2.GraphDef()
+            with open(graph_def, "rb") as f:
+                gd.ParseFromString(f.read())
+            graph_def = gd
+
+        sd = SameDiff.create()
+        ctx = _Ctx(sd)
+        for node in graph_def.node:
+            data_ins = [_var_name(i) for i in node.input
+                        if not i.startswith("^")]
+            if node.op == "Const":
+                val = _tensor_value(node)
+                ctx.consts[node.name] = val
+                sd.constant(val, name=node.name)
+            elif node.op == "Placeholder":
+                shape = _attr(node, "shape")
+                shape = tuple(None if d in (-1, 0) and i == 0 else
+                              (None if d == -1 else d)
+                              for i, d in enumerate(shape or []))
+                dt = _attr(node, "dtype") or np.float32
+                sd.placeHolder(node.name, shape=shape or None, dtype=dt)
+            elif node.op == "NoOp":
+                continue
+            elif node.op in _MAPPERS:
+                _MAPPERS[node.op](ctx, node, data_ins)
+            else:
+                raise TFImportError(
+                    f"unmapped TF op '{node.op}' (node '{node.name}') — add "
+                    f"a mapper to modelimport.tensorflow._MAPPERS")
+        return sd
+
+
+importTensorflowGraph = TFGraphImport.importGraphDef

@@ -47,6 +47,13 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16
     use_ring_attention: bool = False
     tie_embeddings: bool = True
+    # "preln" = the TPU-first training layout (pre-LN, approximate gelu);
+    # "postln_bert" = faithful BERT layout (post-LN residuals, embedding
+    # LayerNorm, token-type embeddings, exact-erf gelu) — the layout real
+    # BERT checkpoints import onto (modelimport/bert.py)
+    arch: str = "preln"
+    type_vocab_size: int = 0
+    layer_norm_eps: float = 1e-5     # BERT checkpoints use 1e-12
 
     @staticmethod
     def bert_base(**kw):
@@ -76,6 +83,10 @@ def init_params(cfg: TransformerConfig, key) -> Dict:
         "final_norm": {"g": jnp.ones((E,), dt), "b": jnp.zeros((E,), dt)},
         "layers": [],
     }
+    if cfg.type_vocab_size:
+        params["embed"]["type"] = norm(keys[3], (cfg.type_vocab_size, E))
+    if cfg.arch == "postln_bert":
+        params["emb_norm"] = {"g": jnp.ones((E,), dt), "b": jnp.zeros((E,), dt)}
     if not cfg.tie_embeddings:
         params["lm_head"] = norm(keys[2], (E, V))
     for i in range(cfg.n_layers):
@@ -116,12 +127,17 @@ def param_shardings(cfg: TransformerConfig, mesh: DeviceMesh):
         "final_norm": {"g": s(), "b": s()},
         "layers": [layer] * cfg.n_layers,
     }
+    if cfg.type_vocab_size:
+        out["embed"]["type"] = s()
+    if cfg.arch == "postln_bert":
+        out["emb_norm"] = {"g": s(), "b": s()}
     if not cfg.tie_embeddings:
         out["lm_head"] = s(None, "model")
     return out
 
 
-def _attention(x, lp, cfg: TransformerConfig, mesh: Optional[DeviceMesh]):
+def _attention(x, lp, cfg: TransformerConfig, mesh: Optional[DeviceMesh],
+               attn_mask=None):
     B, T, E = x.shape
     H = cfg.n_heads
     D = E // H
@@ -131,11 +147,15 @@ def _attention(x, lp, cfg: TransformerConfig, mesh: Optional[DeviceMesh]):
     k = k.reshape(B, T, H, D)
     v = v.reshape(B, T, H, D)
     if cfg.use_ring_attention and mesh is not None and mesh.size("seq") > 1:
+        assert attn_mask is None, \
+            "padding masks are not yet supported on the ring-attention path"
         ctx = ring_attention(q, k, v, mesh.mesh, axis_name="seq",
                              is_causal=cfg.causal, batch_axis="data",
                              head_axis="model" if mesh.size("model") > 1 else None)
     else:
-        ctx = attn_ops.dot_product_attention(q, k, v, is_causal=cfg.causal)
+        m = attn_mask[:, None, None, :] if attn_mask is not None else None
+        ctx = attn_ops.dot_product_attention(q, k, v, mask=m,
+                                             is_causal=cfg.causal)
     out = ctx.reshape(B, T, E) @ lp["wo"] + lp["bo"]
     return out
 
@@ -146,9 +166,41 @@ def _constrain(x, mesh: Optional[DeviceMesh], *spec):
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh.mesh, P(*spec)))
 
 
+def encode(params, tokens, cfg: TransformerConfig,
+           mesh: Optional[DeviceMesh] = None, token_type_ids=None,
+           attn_mask=None):
+    """Faithful post-LN BERT encoder: tokens [B, T] -> hidden [B, T, E]
+    (fp32). Matches the reference's imported-BERT semantics (SURVEY.md §3.3):
+    embedding LayerNorm, post-LN residuals, exact-erf gelu."""
+    B, T = tokens.shape
+    x = jnp.take(params["embed"]["tok"], tokens, axis=0) \
+        + params["embed"]["pos"][:T][None]
+    if "type" in params["embed"]:
+        tt = token_type_ids if token_type_ids is not None \
+            else jnp.zeros((B, T), jnp.int32)
+        x = x + jnp.take(params["embed"]["type"], tt, axis=0)
+    ln = lambda v, p: norm_ops.layer_norm(
+        v.astype(jnp.float32), p["g"].astype(jnp.float32),
+        p["b"].astype(jnp.float32), eps=cfg.layer_norm_eps)
+    x = ln(x, params["emb_norm"]).astype(cfg.dtype)
+    x = _constrain(x, mesh, "data", "seq", None)
+    for lp in params["layers"]:
+        a = _attention(x, lp, cfg, mesh, attn_mask=attn_mask)
+        x = ln(x + a, lp["ln1"]).astype(cfg.dtype)
+        h = jax.nn.gelu(x @ lp["w1"] + lp["b1"], approximate=False)
+        h = h @ lp["w2"] + lp["b2"]
+        x = ln(x + h, lp["ln2"]).astype(cfg.dtype)
+        x = _constrain(x, mesh, "data", "seq", None)
+    return x.astype(jnp.float32)
+
+
 def forward(params, tokens, cfg: TransformerConfig,
             mesh: Optional[DeviceMesh] = None):
     """tokens [B, T] int32 -> logits [B, T, V] (fp32)."""
+    if cfg.arch == "postln_bert":
+        x = encode(params, tokens, cfg, mesh)
+        head = params["embed"]["tok"].T if cfg.tie_embeddings else params["lm_head"]
+        return (x.astype(cfg.dtype) @ head.astype(cfg.dtype)).astype(jnp.float32)
     B, T = tokens.shape
     x = jnp.take(params["embed"]["tok"], tokens, axis=0) \
         + params["embed"]["pos"][:T][None]

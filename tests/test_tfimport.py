@@ -1,0 +1,158 @@
+"""TF-graph conformance tests: frozen TF graphs + TF-computed goldens,
+imported into SameDiff and executed as one XLA program.
+
+Reference parity: ``TFGraphTestAllSameDiff`` — thousands of small frozen
+TF graphs with golden input/output tensors (SURVEY.md §4 "TF-graph
+conformance"). TF is available in this environment, so graphs are frozen
+and goldens computed live rather than stored.
+"""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+from tensorflow.python.framework.convert_to_constants import (  # noqa: E402
+    convert_variables_to_constants_v2)
+
+from deeplearning4j_tpu.modelimport.tensorflow import (  # noqa: E402
+    TFImportError, importTensorflowGraph)
+
+
+def _conform(fn, *specs, feeds):
+    """Freeze fn, compute the TF golden, import + execute, compare."""
+    conc = tf.function(fn).get_concrete_function(*specs)
+    frozen = convert_variables_to_constants_v2(conc)
+    gd = frozen.graph.as_graph_def()
+    golden = [np.asarray(t) for t in
+              (frozen(*[tf.constant(v) for v in feeds])
+               if isinstance(frozen(*[tf.constant(v) for v in feeds]), (list, tuple))
+               else [frozen(*[tf.constant(v) for v in feeds])])]
+    sd = importTensorflowGraph(gd)
+    in_names = [t.name.split(":")[0] for t in frozen.inputs]
+    out_names = [t.name.split(":")[0] if t.name.endswith(":0")
+                 else t.name.replace(":", ":") for t in frozen.outputs]
+    out_names = [n.split(":")[0] if n.endswith(":0") else n
+                 for n in [t.name for t in frozen.outputs]]
+    res = sd.output(dict(zip(in_names, feeds)), out_names)
+    for name, want in zip(out_names, golden):
+        np.testing.assert_allclose(np.asarray(res[name]), want,
+                                   rtol=1e-4, atol=1e-5)
+    return sd
+
+
+class TestTFGraphConformance:
+    def test_mlp_matmul_bias_relu_softmax(self):
+        rng = np.random.RandomState(0)
+        w1 = tf.constant(rng.randn(6, 8).astype(np.float32))
+        b1 = tf.constant(rng.randn(8).astype(np.float32))
+        w2 = tf.constant(rng.randn(8, 3).astype(np.float32))
+
+        def f(x):
+            h = tf.nn.relu(tf.nn.bias_add(tf.matmul(x, w1), b1))
+            return tf.nn.softmax(tf.matmul(h, w2))
+        x = rng.randn(4, 6).astype(np.float32)
+        _conform(f, tf.TensorSpec([None, 6], tf.float32), feeds=[x])
+
+    def test_elementwise_and_reductions(self):
+        rng = np.random.RandomState(1)
+
+        def f(x):
+            y = tf.exp(x) + tf.sqrt(tf.abs(x)) * 2.0
+            z = tf.reduce_mean(y, axis=1, keepdims=True)
+            return tf.reduce_sum(tf.square(y - z), axis=-1)
+        x = rng.randn(3, 5).astype(np.float32)
+        _conform(f, tf.TensorSpec([None, 5], tf.float32), feeds=[x])
+
+    def test_reshape_transpose_concat(self):
+        rng = np.random.RandomState(2)
+
+        def f(x):
+            a = tf.reshape(x, [2, 3, 4])
+            b = tf.transpose(a, [0, 2, 1])
+            c = tf.concat([b, b], axis=2)
+            return tf.squeeze(tf.expand_dims(c, 0), [0])
+        x = rng.randn(2, 12).astype(np.float32)
+        _conform(f, tf.TensorSpec([2, 12], tf.float32), feeds=[x])
+
+    def test_conv_pool_nhwc(self):
+        rng = np.random.RandomState(3)
+        w = tf.constant(rng.randn(3, 3, 2, 4).astype(np.float32) * 0.1)
+
+        def f(x):
+            h = tf.nn.relu(tf.nn.conv2d(x, w, strides=1, padding="SAME"))
+            p = tf.nn.max_pool2d(h, 2, 2, padding="VALID")
+            return tf.nn.avg_pool2d(p, 2, 1, padding="VALID")
+        x = rng.randn(2, 8, 8, 2).astype(np.float32)
+        _conform(f, tf.TensorSpec([None, 8, 8, 2], tf.float32), feeds=[x])
+
+    def test_bert_style_attention_block(self):
+        """The BERT entry-path shape: batched matmuls, masked softmax,
+        layernorm from primitives (mean/sqdiff/rsqrt), erf-gelu."""
+        rng = np.random.RandomState(4)
+        d, h = 8, 2
+        wq = tf.constant(rng.randn(d, d).astype(np.float32) * 0.3)
+        wk = tf.constant(rng.randn(d, d).astype(np.float32) * 0.3)
+        wv = tf.constant(rng.randn(d, d).astype(np.float32) * 0.3)
+        g = tf.constant(rng.rand(d).astype(np.float32) + 0.5)
+        be = tf.constant(rng.randn(d).astype(np.float32) * 0.1)
+
+        def layernorm(x):
+            m = tf.reduce_mean(x, axis=-1, keepdims=True)
+            v = tf.reduce_mean(tf.math.squared_difference(x, m), axis=-1,
+                               keepdims=True)
+            return (x - m) * tf.math.rsqrt(v + 1e-12) * g + be
+
+        def gelu(x):
+            return x * 0.5 * (1.0 + tf.math.erf(x / tf.sqrt(2.0)))
+
+        def f(x, mask):
+            B = tf.shape(x)[0]
+            q = tf.reshape(tf.matmul(x, wq), [2, 5, h, d // h])
+            k = tf.reshape(tf.matmul(x, wk), [2, 5, h, d // h])
+            v = tf.reshape(tf.matmul(x, wv), [2, 5, h, d // h])
+            q = tf.transpose(q, [0, 2, 1, 3])
+            k = tf.transpose(k, [0, 2, 1, 3])
+            v = tf.transpose(v, [0, 2, 1, 3])
+            scores = tf.matmul(q, k, transpose_b=True) / 2.0
+            scores += (1.0 - mask[:, None, None, :]) * -1e9
+            ctx = tf.matmul(tf.nn.softmax(scores), v)
+            ctx = tf.reshape(tf.transpose(ctx, [0, 2, 1, 3]), [2, 5, d])
+            return layernorm(x + gelu(ctx))
+        x = rng.randn(2, 5, d).astype(np.float32)
+        mask = np.asarray([[1, 1, 1, 0, 0], [1, 1, 1, 1, 1]], np.float32)
+        _conform(f, tf.TensorSpec([2, 5, d], tf.float32),
+                 tf.TensorSpec([2, 5], tf.float32), feeds=[x, mask])
+
+    def test_gather_slice_select(self):
+        rng = np.random.RandomState(5)
+        table = tf.constant(rng.randn(10, 4).astype(np.float32))
+
+        def f(ids):
+            e = tf.gather(table, ids)
+            head = e[:, 0:2]
+            return tf.where(head > 0.0, head, tf.zeros_like(head))
+        ids = rng.randint(0, 10, (3, 6)).astype(np.int32)
+        _conform(f, tf.TensorSpec([None, 6], tf.int32), feeds=[ids])
+
+    def test_fused_batchnorm_inference(self):
+        rng = np.random.RandomState(6)
+        gamma = tf.constant(rng.rand(3).astype(np.float32) + 0.5)
+        beta = tf.constant(rng.randn(3).astype(np.float32))
+        mean = tf.constant(rng.randn(3).astype(np.float32))
+        var = tf.constant(rng.rand(3).astype(np.float32) + 0.5)
+
+        def f(x):
+            y, _, _ = tf.compat.v1.nn.fused_batch_norm(
+                x, gamma, beta, mean=mean, variance=var, is_training=False)
+            return y
+        x = rng.randn(2, 4, 4, 3).astype(np.float32)
+        _conform(f, tf.TensorSpec([None, 4, 4, 3], tf.float32), feeds=[x])
+
+    def test_unmapped_op_reported(self):
+        def f(x):
+            return tf.raw_ops.Betainc(a=x, b=x, x=x)
+        conc = tf.function(f).get_concrete_function(
+            tf.TensorSpec([2], tf.float32))
+        gd = convert_variables_to_constants_v2(conc).graph.as_graph_def()
+        with pytest.raises(TFImportError, match="Betainc"):
+            importTensorflowGraph(gd)
