@@ -132,8 +132,11 @@ def bench_resnet50(quick: bool = False):
     if quick:
         batch, hw, steps = 8, 64, 3
     else:
-        batch, hw, steps = 64, 224, 8
-    net = zoo.ResNet50(num_classes=1000, input_shape=(3, hw, hw)).init()
+        batch, hw, steps = 256, 224, 8
+    # bf16 dtype policy (BASELINE.md: the reference's TPU-basis MFU target
+    # assumes MXU-native precision; BN stats/loss/updater stay fp32)
+    net = zoo.ResNet50(num_classes=1000, input_shape=(3, hw, hw),
+                       dtype="bfloat16").init()
     rng = np.random.RandomState(0)
     # stage the batch on-device once: the bench measures the train step, not
     # host->device transfer through the tunneled backend

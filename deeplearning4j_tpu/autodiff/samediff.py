@@ -926,6 +926,9 @@ class SameDiff:
                      for k, v in attrs.items()}
             rebuild = nd_spec.get("rebuild")
             if rebuild is not None:
+                if rebuild not in _FN_REBUILDERS and rebuild == "tf":
+                    # TF-imported graphs: the rebuilder registers on import
+                    import deeplearning4j_tpu.modelimport.tensorflow  # noqa: F401
                 fn = _FN_REBUILDERS[rebuild](attrs)
             elif nd_spec.get("rng"):
                 fn = _make_rng_fn(nd_spec["op"], attrs)

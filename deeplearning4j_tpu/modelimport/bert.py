@@ -100,24 +100,40 @@ def bert_config_from_state(state: Dict[str, np.ndarray], **overrides
     return TransformerConfig(**kw)
 
 
-def _linear(state, key) -> Tuple[np.ndarray, np.ndarray]:
-    """HF Linear -> (W [in, out], b [out]).
+def _detect_tf_format(raw_state: Dict[str, Any]) -> bool:
+    """A checkpoint is TF-convention (google-research BERT) iff its raw keys
+    use '/' separators or '.kernel' dense names. Decided ONCE per
+    checkpoint — per-shape heuristics silently mis-orient square attention
+    projections (advisor r2 medium). Note: '.gamma'/'.beta' alone do NOT
+    imply TF — legacy HF torch checkpoints (< transformers 3.0) used
+    'LayerNorm.gamma' with torch-oriented [out,in] Linear weights."""
+    for k in raw_state:
+        if "/" in k or k.endswith(".kernel"):
+            return True
+    return False
 
-    HF stores [out, in]; original TF checkpoints store [in, out] — detect by
-    checking which orientation matches the layer's bias length."""
+
+def _linear(state, key, tf_format: bool) -> Tuple[np.ndarray, np.ndarray]:
+    """Dense weights -> (W [in, out], b [out]).
+
+    HF torch Linear stores [out, in] (transposed here); TF checkpoints
+    store kernels [in, out] (taken as-is). The orientation is keyed off
+    the checkpoint's naming convention, never off the matrix shape."""
     w = state[key + ".weight"]
     b = state.get(key + ".bias")
-    if b is not None and w.shape[0] == b.shape[0] and w.shape[0] != w.shape[1]:
+    if not tf_format:
         w = w.T
-    elif w.shape[0] == w.shape[1]:
-        w = w.T  # square: HF orientation assumed (torch state dicts)
+    if b is not None and b.shape[0] != w.shape[1]:
+        raise BertImportError(
+            f"{key}: bias length {b.shape[0]} does not match output dim "
+            f"{w.shape[1]} (format detection: {'TF' if tf_format else 'HF'})")
     if b is None:
         b = np.zeros(w.shape[1], np.float32)
     return w, b
 
 
-def bert_params_from_state(state: Dict[str, Any], cfg: TransformerConfig
-                           ) -> Dict:
+def bert_params_from_state(state: Dict[str, Any], cfg: TransformerConfig,
+                           tf_format: bool = False) -> Dict:
     """Map a (normalized) BERT state dict onto transformer params."""
     dt = cfg.dtype
     emb = {"tok": jnp.asarray(state["embeddings.word_embeddings.weight"], dt),
@@ -135,12 +151,12 @@ def bert_params_from_state(state: Dict[str, Any], cfg: TransformerConfig
     }
     for i in range(cfg.n_layers):
         p = f"encoder.layer.{i}."
-        wq, bq = _linear(state, p + "attention.self.query")
-        wk, bk = _linear(state, p + "attention.self.key")
-        wv, bv = _linear(state, p + "attention.self.value")
-        wo, bo = _linear(state, p + "attention.output.dense")
-        w1, b1 = _linear(state, p + "intermediate.dense")
-        w2, b2 = _linear(state, p + "output.dense")
+        wq, bq = _linear(state, p + "attention.self.query", tf_format)
+        wk, bk = _linear(state, p + "attention.self.key", tf_format)
+        wv, bv = _linear(state, p + "attention.self.value", tf_format)
+        wo, bo = _linear(state, p + "attention.output.dense", tf_format)
+        w1, b1 = _linear(state, p + "intermediate.dense", tf_format)
+        w2, b2 = _linear(state, p + "output.dense", tf_format)
         params["layers"].append({
             "ln1": {"g": jnp.asarray(state[p + "attention.output.LayerNorm.weight"], dt),
                     "b": jnp.asarray(state[p + "attention.output.LayerNorm.bias"], dt)},
@@ -180,6 +196,8 @@ def importBertModelAndWeights(path: str, **config_overrides
     ref: TensorflowFrameworkImporter.runImport for the BERT GraphDef
     (SURVEY.md §3.3) — here weights map onto the native flagship model.
     """
-    state = _normalize_keys(_strip_prefix(load_state_dict(path)))
+    raw = load_state_dict(path)
+    tf_format = _detect_tf_format(raw)
+    state = _normalize_keys(_strip_prefix(raw))
     cfg = bert_config_from_state(state, **config_overrides)
-    return cfg, bert_params_from_state(state, cfg)
+    return cfg, bert_params_from_state(state, cfg, tf_format=tf_format)

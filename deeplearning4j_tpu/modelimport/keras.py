@@ -242,6 +242,10 @@ def _rnn_fill(kw, pre_it):
 def _map_lstm(cfg) -> _Imported:
     if _act(cfg.get("recurrent_activation", "sigmoid")) != "sigmoid":
         raise KerasImportError("only sigmoid recurrent_activation LSTMs import")
+    if _act(cfg.get("activation", "tanh")) != "tanh":
+        # ops/recurrent.py lstm_cell hard-codes tanh; importing anything else
+        # would silently compute the wrong function (advisor r2 low)
+        raise KerasImportError("only tanh cell-activation LSTMs import")
     inner = L.LSTM(nOut=int(cfg["units"]), activation=_act(cfg.get("activation", "tanh")))
     lay = inner if cfg.get("return_sequences") else L.LastTimeStep(inner)
     return _Imported(lay, cfg["name"], _rnn_fill)

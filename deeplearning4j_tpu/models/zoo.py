@@ -34,17 +34,20 @@ class ZooModel:
     """Base (ref: org.deeplearning4j.zoo.ZooModel)."""
 
     def __init__(self, num_classes: int = 1000, seed: int = 123,
-                 input_shape: Tuple[int, int, int] = None, updater=None):
+                 input_shape: Tuple[int, int, int] = None, updater=None,
+                 dtype: str = "float32"):
         self.num_classes = num_classes
         self.seed = seed
         self.input_shape = input_shape or self.default_input_shape()
         self.updater = updater or updaters.Adam(1e-3)
+        self.dtype = dtype  # "bfloat16" enables the nn/ mixed-precision policy
 
     def default_input_shape(self):
         return (3, 224, 224)  # (channels, H, W)
 
     def init(self):
         net = self.conf_builder()
+        net.conf.base.dtype = self.dtype
         net.init()
         return net
 

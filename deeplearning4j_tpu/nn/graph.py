@@ -26,6 +26,7 @@ from deeplearning4j_tpu.evaluation.evaluation import Evaluation
 from deeplearning4j_tpu.nn import layers as L
 from deeplearning4j_tpu.nn import preprocessors as pp
 from deeplearning4j_tpu.nn.config import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.multilayer import _process_and_apply_grads
 from deeplearning4j_tpu.train import updaters as upd
 
 _MASK_AWARE = (L.LSTM, L.SimpleRnn, L.Bidirectional, L.LastTimeStep,
@@ -364,6 +365,7 @@ class ComputationGraph:
     # --------------------------------------------------------------- forward
     def _forward(self, params, states, inputs: Dict[str, Any], train, key,
                  fmask=None):
+        cdt = L.compute_dtype_of(self.conf.base.dtype)
         env = dict(inputs)
         new_states = {}
         for node in self.conf.topo:
@@ -372,15 +374,26 @@ class ComputationGraph:
                 x = xs[0]
                 if node.name in self.conf.preprocessors:
                     x = self.conf.preprocessors[node.name](x)
+                p = params[node.name]
+                if cdt is not None:
+                    p, x = L.policy_cast(node.obj, p, x, cdt)
                 key, sub = jax.random.split(key)
                 if isinstance(node.obj, _MASK_AWARE):
-                    out, ns = node.obj.apply(params[node.name], states[node.name],
+                    out, ns = node.obj.apply(p, states[node.name],
                                              x, train, sub, mask=fmask)
                 else:
-                    out, ns = node.obj.apply(params[node.name], states[node.name],
+                    out, ns = node.obj.apply(p, states[node.name],
                                              x, train, sub)
                 new_states[node.name] = ns
             else:
+                if cdt is not None and len(xs) > 1:
+                    # merge/elementwise vertices: align mixed fp32/bf16 inputs
+                    # (e.g. a BN branch meeting a conv branch)
+                    if any(getattr(a, "dtype", None) == jnp.bfloat16
+                           for a in xs):
+                        xs = [a.astype(jnp.bfloat16)
+                              if getattr(a, "dtype", None) == jnp.float32 else a
+                              for a in xs]
                 out = node.obj.apply(*xs)
             env[node.name] = out
         return [env[o] for o in self.conf.graph_outputs], new_states
@@ -478,29 +491,12 @@ class ComputationGraph:
                 return self._loss_and_reg(p, states, ins, labels, True, key,
                                           None, lmasks if with_lmasks else None)
             (loss, new_states), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
-            if base.grad_norm == "clip_value":
-                grads = upd.clip_by_value(grads, base.grad_norm_threshold)
-            elif base.grad_norm == "clip_l2":
-                grads = upd.clip_by_norm(grads, base.grad_norm_threshold)
-            elif base.grad_norm == "clip_global":
-                grads = upd.clip_by_global_norm(grads, base.grad_norm_threshold)
-            lr = updater.lr_at(t)
-            path_leaves, treedef = jax.tree_util.tree_flatten_with_path(params)
-            g_leaves = treedef.flatten_up_to(grads)
-            s_leaves = treedef.flatten_up_to(opt_state)
-            new_p, new_s = [], []
-            for (path, pv), gv, sv in zip(path_leaves, g_leaves, s_leaves):
-                u, s2 = updater.apply(gv, sv, lr, t)
-                leaf_name = str(getattr(path[-1], "key", path[-1]))
-                if (isinstance(updater, upd.AdamW) and updater.weight_decay
-                        and leaf_name.startswith(("W", "RW"))):
-                    # decoupled decay on weight matrices only (see multilayer)
-                    u = u + updater.weight_decay_update(pv, lr)
-                new_p.append(pv - u)
-                new_s.append(s2)
-            return (jax.tree_util.tree_unflatten(treedef, new_p), new_states,
-                    jax.tree_util.tree_unflatten(treedef, new_s), loss)
-        return jax.jit(step)
+            new_params, new_opt = _process_and_apply_grads(
+                base, updater, params, grads, opt_state, t)
+            return new_params, new_states, new_opt, loss
+        # donate params/states/opt_state: the step consumes and replaces
+        # them, halving peak HBM for the update
+        return jax.jit(step, donate_argnums=(0, 1, 2))
 
     def _ensure_opt_state(self):
         if self._opt_state is None:

@@ -381,15 +381,21 @@ class BatchNormalization(Layer):
 
     def apply(self, params, state, x, train, key):
         axis = 1 if x.ndim >= 3 else -1
+        # mixed-precision island: statistics always in fp32 (a bf16 mean
+        # over a 224^2 plane loses ~5 bits), activations pass through in
+        # their incoming dtype
+        in_dt = x.dtype
+        if in_dt == jnp.bfloat16:
+            x = x.astype(jnp.float32)
         if train:
             out, new_mean, new_var = norm_ops.batch_norm_train(
                 x, params["gamma"], params["beta"], state["mean"], state["var"],
                 eps=self.eps, decay=self.decay, axis=axis if axis != -1 else x.ndim - 1)
-            return out, {"mean": new_mean, "var": new_var}
+            return out.astype(in_dt), {"mean": new_mean, "var": new_var}
         out = norm_ops.batch_norm(x, params["gamma"], params["beta"],
                                   state["mean"], state["var"], eps=self.eps,
                                   axis=axis if axis != -1 else x.ndim - 1)
-        return out, state
+        return out.astype(in_dt), state
 
     def output_type(self, it: InputType) -> InputType:
         return it
@@ -921,3 +927,44 @@ for _cls in [DenseLayer, EmbeddingLayer, EmbeddingSequenceLayer, ConvolutionLaye
 def layer_from_config(d: Dict) -> Layer:
     cls = _LAYER_CLASSES[d["@class"]]
     return cls.from_config(d)
+
+
+# ------------------------------------------------------------- dtype policy
+# BASELINE.md's open perf item ("bf16 plumbing" in the nn/ stack): master
+# parameters stay fp32 (updater math, BatchNorm statistics, losses), while
+# matmul/conv/pool layers compute in bfloat16 — the MXU-native dtype
+# (SURVEY.md §6). Enabled per-network via NeuralNetConfiguration.dataType
+# ("bfloat16"); the cast happens inside the compiled step so XLA fuses it
+# into the consuming convolution.
+
+# Param-side fp32 islands: BatchNorm/LRN keep fp32 params and cast
+# internally (activations stay bf16 through them); output/loss layers get
+# fp32 activations AND fp32 params (softmax + loss numerics).
+_POLICY_FP32_PARAM_LAYERS = (BatchNormalization, LocalResponseNormalization,
+                             BaseOutputLayer)
+
+
+def compute_dtype_of(conf_dtype) -> Optional[Any]:
+    """None = no policy (pure fp32); jnp.bfloat16 = mixed-precision."""
+    if str(conf_dtype).lower() in ("bfloat16", "bf16"):
+        return jnp.bfloat16
+    return None
+
+
+def policy_cast(layer, params, x, compute_dt):
+    """Cast (params, input) for one layer under the dtype policy."""
+    if compute_dt is None:
+        return params, x
+    if isinstance(layer, BaseOutputLayer):
+        if jnp.issubdtype(x.dtype, jnp.floating) and x.dtype != jnp.float32:
+            x = x.astype(jnp.float32)
+        return params, x
+    if isinstance(layer, _POLICY_FP32_PARAM_LAYERS):
+        return params, x
+    if jnp.issubdtype(x.dtype, jnp.floating) and x.dtype != compute_dt:
+        x = x.astype(compute_dt)
+    if params:
+        params = jax.tree_util.tree_map(
+            lambda a: a.astype(compute_dt)
+            if getattr(a, "dtype", None) == jnp.float32 else a, params)
+    return params, x

@@ -34,6 +34,37 @@ _MASK_AWARE = (L.LSTM, L.SimpleRnn, L.Bidirectional, L.LastTimeStep,
                L.GlobalPoolingLayer)
 
 
+def _process_and_apply_grads(base, updater, params, grads, opt_state, t):
+    """Shared per-step gradient path: gradientNormalization clipping, then
+    updater.apply per leaf with AdamW decoupled decay gated to weight
+    matrices (leaf names W/RW), matching the loss-side L1/L2 gating.
+    Used by BOTH the regular and the TBPTT compiled steps (advisor r2:
+    tBPTT previously skipped clipping + AdamW decay)."""
+    if base.grad_norm == "clip_value":
+        grads = upd.clip_by_value(grads, base.grad_norm_threshold)
+    elif base.grad_norm == "clip_l2":
+        grads = upd.clip_by_norm(grads, base.grad_norm_threshold)
+    elif base.grad_norm == "clip_global":
+        grads = upd.clip_by_global_norm(grads, base.grad_norm_threshold)
+    elif base.grad_norm == "renorm":
+        grads = upd.renormalize_l2(grads)
+    lr = updater.lr_at(t)
+    path_leaves, treedef = jax.tree_util.tree_flatten_with_path(params)
+    g_leaves = treedef.flatten_up_to(grads)
+    s_leaves = treedef.flatten_up_to(opt_state)
+    new_p, new_s = [], []
+    for (path, pv), gv, sv in zip(path_leaves, g_leaves, s_leaves):
+        u, s2 = updater.apply(gv, sv, lr, t)
+        leaf_name = str(getattr(path[-1], "key", path[-1]))
+        if (isinstance(updater, upd.AdamW) and updater.weight_decay
+                and leaf_name.startswith(("W", "RW"))):
+            u = u + updater.weight_decay_update(pv, lr)
+        new_p.append(pv - u)
+        new_s.append(s2)
+    return (jax.tree_util.tree_unflatten(treedef, new_p),
+            jax.tree_util.tree_unflatten(treedef, new_s))
+
+
 class MultiLayerNetwork:
     """Sequential network (ref: MultiLayerNetwork)."""
 
@@ -72,15 +103,19 @@ class MultiLayerNetwork:
 
     # --------------------------------------------------------------- forward
     def _forward(self, params, states, x, train: bool, key, fmask=None):
+        cdt = L.compute_dtype_of(self.conf.base.dtype)
         new_states = []
         for i, layer in enumerate(self.layers):
             if i in self.conf.preprocessors:
                 x = self.conf.preprocessors[i](x)
+            p = params[i]
+            if cdt is not None:
+                p, x = L.policy_cast(layer, p, x, cdt)
             key, sub = jax.random.split(key)
             if isinstance(layer, _MASK_AWARE):
-                x, ns = layer.apply(params[i], states[i], x, train, sub, mask=fmask)
+                x, ns = layer.apply(p, states[i], x, train, sub, mask=fmask)
             else:
-                x, ns = layer.apply(params[i], states[i], x, train, sub)
+                x, ns = layer.apply(p, states[i], x, train, sub)
             new_states.append(ns)
         return x, new_states
 
@@ -141,39 +176,27 @@ class MultiLayerNetwork:
         base = self.conf.base
         updater = base.updater
 
+        # frozen layers (transfer learning, ref: FrozenLayer) keep their
+        # params/opt-state; handled inside the jit so buffer donation and
+        # XLA DCE of the unused updates both apply
+        frozen = getattr(self, "_frozen_layers", None) or set()
+
         def step(params, states, opt_state, t, x, y, fmask, lmask, key):
             def loss_fn(p):
                 return self._loss_and_reg(p, states, x, y, True, key,
                                           fmask if with_fmask else None,
                                           lmask if with_lmask else None)
             (loss, new_states), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
-            if base.grad_norm == "clip_value":
-                grads = upd.clip_by_value(grads, base.grad_norm_threshold)
-            elif base.grad_norm == "clip_l2":
-                grads = upd.clip_by_norm(grads, base.grad_norm_threshold)
-            elif base.grad_norm == "clip_global":
-                grads = upd.clip_by_global_norm(grads, base.grad_norm_threshold)
-            elif base.grad_norm == "renorm":
-                grads = upd.renormalize_l2(grads)
-            lr = updater.lr_at(t)
-            path_leaves, treedef = jax.tree_util.tree_flatten_with_path(params)
-            p_leaves = [leaf for _, leaf in path_leaves]
-            g_leaves = treedef.flatten_up_to(grads)
-            s_leaves = treedef.flatten_up_to(opt_state)
-            new_p, new_s = [], []
-            for (path, pv), gv, sv in zip(path_leaves, g_leaves, s_leaves):
-                u, s2 = updater.apply(gv, sv, lr, t)
-                leaf_name = str(getattr(path[-1], "key", path[-1]))
-                if (isinstance(updater, upd.AdamW) and updater.weight_decay
-                        and leaf_name.startswith(("W", "RW"))):
-                    # decoupled decay on weight matrices only, matching the
-                    # loss-side L1/L2 gating in _loss_and_reg
-                    u = u + updater.weight_decay_update(pv, lr)
-                new_p.append(pv - u)
-                new_s.append(s2)
-            return (jax.tree_util.tree_unflatten(treedef, new_p), new_states,
-                    jax.tree_util.tree_unflatten(treedef, new_s), loss)
-        return jax.jit(step)
+            new_params, new_opt = _process_and_apply_grads(
+                base, updater, params, grads, opt_state, t)
+            if frozen:
+                new_params = [params[i] if i in frozen else new_params[i]
+                              for i in range(len(params))]
+                new_opt = [opt_state[i] if i in frozen else new_opt[i]
+                           for i in range(len(opt_state))]
+            return new_params, new_states, new_opt, loss
+        # donate params/states/opt_state: consumed and replaced each step
+        return jax.jit(step, donate_argnums=(0, 1, 2))
 
     def _ensure_opt_state(self):
         if self._opt_state is None:
@@ -430,17 +453,9 @@ class MultiLayerNetwork:
                 return loss, new_seg
 
             (loss, new_seg), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
-            lr = updater.lr_at(t)
-            p_leaves, treedef = jax.tree_util.tree_flatten(params)
-            g_leaves = treedef.flatten_up_to(grads)
-            s_leaves = treedef.flatten_up_to(opt_state)
-            new_p, new_s = [], []
-            for pv, gv, sv in zip(p_leaves, g_leaves, s_leaves):
-                u, s2 = updater.apply(gv, sv, lr, t)
-                new_p.append(pv - u)
-                new_s.append(s2)
-            return (jax.tree_util.tree_unflatten(treedef, new_p),
-                    jax.tree_util.tree_unflatten(treedef, new_s), loss, new_seg)
+            new_params, new_opt = _process_and_apply_grads(
+                base, updater, params, grads, opt_state, t)
+            return new_params, new_opt, loss, new_seg
         return jax.jit(step)
 
     def _fit_one_tbptt(self, ds: DataSet, seg_states):

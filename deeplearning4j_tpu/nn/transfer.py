@@ -140,31 +140,10 @@ class TransferLearning:
             return net
 
 
-def _patch_frozen_training():
-    """Teach MultiLayerNetwork's train step about frozen layers: their
-    params receive a zero update (ref: FrozenLayer wrapping)."""
-    orig = MultiLayerNetwork._make_train_step
-
-    def make(self, with_fmask, with_lmask):
-        step = orig(self, with_fmask, with_lmask)
-        frozen = getattr(self, "_frozen_layers", None)
-        if not frozen:
-            return step
-
-        def wrapped(params, states, opt_state, t, x, y, fmask, lmask, key):
-            new_p, new_s, new_o, loss = step(params, states, opt_state, t, x, y,
-                                             fmask, lmask, key)
-            # restore frozen layers' params/opt-state (zero effective update)
-            new_p = [params[i] if i in frozen else new_p[i]
-                     for i in range(len(params))]
-            new_o = [opt_state[i] if i in frozen else new_o[i]
-                     for i in range(len(opt_state))]
-            return new_p, new_s, new_o, loss
-        return wrapped
-    MultiLayerNetwork._make_train_step = make
-
-
-_patch_frozen_training()
+# Frozen-layer handling lives inside MultiLayerNetwork._make_train_step
+# (the restore must happen INSIDE the jit: the step donates its param
+# buffers, so re-using the caller's old arrays outside it would read
+# deleted buffers).
 
 
 class TransferLearningHelper:

@@ -47,9 +47,10 @@ def _conv_dims(ndim: int, data_format: str):
     return (lhs, rhs, lhs)
 
 
-def _acc_type(x):
-    """bf16 inputs accumulate in fp32 on the MXU (consistent across ranks)."""
-    return jnp.float32 if x.dtype == jnp.bfloat16 else None
+# NOTE on accumulation dtype: bf16 convs accumulate in fp32 in the MXU
+# natively; an explicit preferred_element_type=fp32 would make the
+# primitive's OUTPUT fp32 and break the conv transpose (AD) rule on
+# mixed-dtype cotangents, so none is passed.
 
 
 def _padding(mode: str, kernel, stride, dilation, pad):
@@ -81,7 +82,6 @@ def conv2d(x, w, b=None, *, kernel=None, stride: IntOrPair = 1, pad: IntOrPair =
         rhs_dilation=dilation,
         dimension_numbers=dims,
         feature_group_count=groups,
-        preferred_element_type=_acc_type(x),
     )
     if out.dtype != x.dtype:
         out = out.astype(x.dtype)
@@ -98,8 +98,7 @@ def conv1d(x, w, b=None, *, stride: int = 1, pad: int = 0, dilation: int = 1,
     dims = _conv_dims(1, data_format)
     out = lax.conv_general_dilated(
         x, w, stride_, _padding(mode, kernel, stride_, dil_, pad_),
-        rhs_dilation=dil_, dimension_numbers=dims, feature_group_count=groups,
-        preferred_element_type=_acc_type(x))
+        rhs_dilation=dil_, dimension_numbers=dims, feature_group_count=groups)
     if out.dtype != x.dtype:
         out = out.astype(x.dtype)
     if b is not None:
@@ -115,8 +114,7 @@ def conv3d(x, w, b=None, *, stride: IntOrPair = 1, pad: IntOrPair = 0,
     dims = _conv_dims(3, data_format)
     out = lax.conv_general_dilated(
         x, w, stride, _padding(mode, kernel, stride, dilation, pad),
-        rhs_dilation=dilation, dimension_numbers=dims,
-        preferred_element_type=_acc_type(x))
+        rhs_dilation=dilation, dimension_numbers=dims)
     if out.dtype != x.dtype:
         out = out.astype(x.dtype)
     if b is not None:
@@ -148,8 +146,7 @@ def deconv2d(x, w, b=None, *, stride: IntOrPair = 1, pad: IntOrPair = 0,
         padding = [(kh - 1 - pad[0], kh - 1 - pad[0]), (kw - 1 - pad[1], kw - 1 - pad[1])]
     out = lax.conv_general_dilated(
         x, w_t, window_strides=(1, 1), padding=padding,
-        lhs_dilation=stride, dimension_numbers=dims,
-        preferred_element_type=_acc_type(x))
+        lhs_dilation=stride, dimension_numbers=dims)
     if out.dtype != x.dtype:
         out = out.astype(x.dtype)
     if b is not None:
