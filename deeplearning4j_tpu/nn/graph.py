@@ -26,8 +26,10 @@ from deeplearning4j_tpu.evaluation.evaluation import Evaluation
 from deeplearning4j_tpu.nn import layers as L
 from deeplearning4j_tpu.nn import preprocessors as pp
 from deeplearning4j_tpu.nn.config import InputType, NeuralNetConfiguration
-from deeplearning4j_tpu.nn.multilayer import _process_and_apply_grads
+from deeplearning4j_tpu.nn.multilayer import (_maybe_attach_env_profiler,
+                                              _process_and_apply_grads)
 from deeplearning4j_tpu.train import updaters as upd
+from deeplearning4j_tpu.utils import environment as _environment
 
 _MASK_AWARE = (L.LSTM, L.SimpleRnn, L.Bidirectional, L.LastTimeStep,
                L.GlobalPoolingLayer)
@@ -510,6 +512,7 @@ class ComputationGraph:
         if not self._initialized:
             self.init()
         self._ensure_opt_state()
+        _maybe_attach_env_profiler(self)
 
         def batches():
             if isinstance(data, DataSetIterator):
@@ -550,6 +553,11 @@ class ComputationGraph:
         step = self._train_step_cache[sig]
         key = jax.random.PRNGKey(self.conf.base.seed + self._iteration + 1)
         dummy = [jnp.zeros((1,))] * len(labels)
+        for lst in self._listeners:
+            if hasattr(lst, "onIterationStart"):
+                # 1-based, matching iterationDone: hook pair refers to the
+                # same step number
+                lst.onIterationStart(self, self._iteration + 1)
         self._params, self._states, self._opt_state, loss = step(
             self._params, self._states, self._opt_state,
             jnp.asarray(self._iteration, jnp.float32), ins, labels,
@@ -557,6 +565,7 @@ class ComputationGraph:
         # on-device; score() converts lazily (per-step host sync is ~20x the
         # step cost through a high-latency device link)
         self._score = loss
+        _environment.panic_check(loss, f"loss at iteration {self._iteration}")
         self._last_batch_size = int(next(iter(ins.values())).shape[0])
         self._iteration += 1
         for lst in self._listeners:

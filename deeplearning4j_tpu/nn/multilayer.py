@@ -29,9 +29,20 @@ from deeplearning4j_tpu.evaluation.evaluation import Evaluation, RegressionEvalu
 from deeplearning4j_tpu.nn import layers as L
 from deeplearning4j_tpu.nn.config import MultiLayerConfiguration
 from deeplearning4j_tpu.train import updaters as upd
+from deeplearning4j_tpu.utils import environment as _environment
 
 _MASK_AWARE = (L.LSTM, L.SimpleRnn, L.Bidirectional, L.LastTimeStep,
                L.GlobalPoolingLayer)
+
+
+def _maybe_attach_env_profiler(model):
+    """DL4J_TPU_PROFILING=1 auto-attaches a ProfilingListener writing to
+    DL4J_TPU_PROFILE_DIR (the env registry's advertised behaviour)."""
+    if not _environment.Environment.get().profiling:
+        return
+    from deeplearning4j_tpu.train.listeners import ProfilingListener
+    if not any(isinstance(l, ProfilingListener) for l in model._listeners):
+        model._listeners.append(ProfilingListener())
 
 
 def _process_and_apply_grads(base, updater, params, grads, opt_state, t):
@@ -211,6 +222,7 @@ class MultiLayerNetwork:
         if not self._initialized:
             self.init()
         self._ensure_opt_state()
+        _maybe_attach_env_profiler(self)
 
         def batches():
             if isinstance(data, DataSetIterator):
@@ -247,6 +259,11 @@ class MultiLayerNetwork:
         step = self._train_step_cache[sig]
         key = jax.random.PRNGKey(self.conf.base.seed + self._iteration + 1)
         dummy = jnp.zeros((1,))
+        for lst in self._listeners:
+            if hasattr(lst, "onIterationStart"):
+                # 1-based, matching iterationDone: hook pair refers to the
+                # same step number
+                lst.onIterationStart(self, self._iteration + 1)
         self._params, self._states, self._opt_state, loss = step(
             self._params, self._states, self._opt_state,
             jnp.asarray(self._iteration, jnp.float32), x, y,
@@ -256,6 +273,7 @@ class MultiLayerNetwork:
         # step through the (high-latency) host<->device link every iteration;
         # score() converts lazily when someone actually asks
         self._score = loss
+        _environment.panic_check(loss, f"loss at iteration {self._iteration}")
         self._last_batch_size = int(ds.features.shape[0])
         self._iteration += 1
         for lst in self._listeners:

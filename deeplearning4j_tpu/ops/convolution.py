@@ -367,13 +367,22 @@ def cropping2d(x, crop, data_format: str = "NCHW"):
 def conv_output_size(size: int, kernel: int, stride: int, pad: int,
                      dilation: int = 1, mode: str = "truncate") -> int:
     """Shape inference for conv/pool (ref: ``InputType`` propagation /
-    ``ConvolutionUtils.getOutputSize``)."""
+    ``ConvolutionUtils.getOutputSize`` — which, like here, REJECTS configs
+    whose spatial output collapses to zero instead of silently building
+    zero-size weights)."""
     if mode.lower() == "same":
         return -(-size // stride)  # ceil
     eff_k = kernel + (kernel - 1) * (dilation - 1)
     if mode.lower() == "causal":
         return size  # causal left-pad keeps length (stride 1)
-    return (size + 2 * pad - eff_k) // stride + 1
+    out = (size + 2 * pad - eff_k) // stride + 1
+    if out <= 0:
+        raise ValueError(
+            f"conv/pool output size {out} <= 0 for input size {size}, "
+            f"kernel {kernel} (dilation {dilation}), stride {stride}, "
+            f"pad {pad} — the layer cannot be applied to this input "
+            f"(ref: ConvolutionUtils.getOutputSize validation)")
+    return out
 
 
 # ------------------------------------------------------- parity helpers

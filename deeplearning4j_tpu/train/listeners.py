@@ -138,3 +138,175 @@ class EvaluativeListener(TrainingListener):
             ev = model.evaluate(self.iterator, self.factory())
             self.last_evaluation = ev
             self.out(f"iter {iteration}: accuracy={ev.accuracy():.4f}")
+
+
+class StatsListener(TrainingListener):
+    """Collect per-iteration training statistics into a StatsStorage
+    (ref: org.deeplearning4j.ui.model.stats.StatsListener — the producer
+    side of the StatsListener -> StatsStorage -> UIServer chain,
+    SURVEY.md §1 L8, §5 "Metrics/logging").
+
+    TPU-native capture: all per-layer summaries (param/update means, stds,
+    L2 norms, update:param ratios, optional histograms) are computed ON
+    DEVICE in one jitted program per sampled iteration and pulled to the
+    host as a handful of scalars — never the weight tensors themselves.
+    The pre-step parameter snapshot is a device-side copy (the train step
+    donates its input buffers, so the listener must not alias them).
+    """
+
+    def __init__(self, storage, frequency: int = 1, session_id: str = None,
+                 with_histograms: bool = False, hist_bins: int = 20):
+        import uuid
+        self.storage = storage
+        self.frequency = max(1, frequency)
+        self.session_id = session_id or f"sess_{uuid.uuid4().hex[:12]}"
+        self.with_histograms = with_histograms
+        self.hist_bins = hist_bins
+        self._snapshot = None
+        self._static_sent = False
+        self._stats_fn = None
+        self._t_iter_start = None
+
+    # -------------------------------------------------------------- capture
+    def _sampled(self, iteration: int) -> bool:
+        return iteration % self.frequency == 0
+
+    def onIterationStart(self, model, iteration: int):
+        import jax
+        if not self._sampled(iteration):
+            return
+        self._t_iter_start = time.time()
+        # device-side copy (donation-safe; freed after the diff is taken)
+        self._snapshot = jax.tree_util.tree_map(lambda a: a + 0,
+                                                model._params)
+
+    def _leaf_name(self, path) -> str:
+        parts = []
+        for p in path:
+            parts.append(str(getattr(p, "key", getattr(p, "idx", p))))
+        return "/".join(parts)
+
+    def _build_stats_fn(self):
+        import jax
+        import jax.numpy as jnp
+        bins = self.hist_bins
+        with_hist = self.with_histograms
+
+        def stats(new_params, old_params):
+            out = {}
+            leaves = jax.tree_util.tree_flatten_with_path(new_params)[0]
+            old_leaves = jax.tree_util.tree_flatten_with_path(old_params)[0]
+            for (path, w), (_, w0) in zip(leaves, old_leaves):
+                if w.size == 0:
+                    continue
+                name = self._leaf_name(path)
+                w32 = w.astype(jnp.float32)
+                upd = w32 - w0.astype(jnp.float32)
+                pn = jnp.sqrt(jnp.sum(jnp.square(w32)))
+                un = jnp.sqrt(jnp.sum(jnp.square(upd)))
+                rec = {"param_mean": jnp.mean(w32),
+                       "param_std": jnp.std(w32),
+                       "param_norm": pn,
+                       "update_norm": un,
+                       "update_ratio": un / (pn + 1e-12)}
+                if with_hist:
+                    lo, hi = jnp.min(w32), jnp.max(w32)
+                    counts, _ = jnp.histogram(w32, bins=bins)
+                    rec["hist_counts"] = counts
+                    rec["hist_range"] = jnp.stack([lo, hi])
+                out[name] = rec
+            return out
+        return jax.jit(stats)
+
+    def iterationDone(self, model, iteration, epoch):
+        import jax
+        if not self._sampled(iteration) or self._snapshot is None:
+            return
+        if not self._static_sent:
+            self._send_static(model)
+        if self._stats_fn is None:
+            self._stats_fn = self._build_stats_fn()
+        per_layer = jax.device_get(self._stats_fn(model._params,
+                                                  self._snapshot))
+        self._snapshot = None
+        layers = {}
+        for name, rec in per_layer.items():
+            layers[name] = {k: (v.tolist() if hasattr(v, "tolist") and
+                                getattr(v, "ndim", 0) else float(v))
+                            for k, v in rec.items()}
+        dur = (time.time() - self._t_iter_start) if self._t_iter_start else None
+        self.storage.putUpdate({
+            "session_id": self.session_id,
+            "worker_id": "0",
+            "iteration": iteration,
+            "epoch": epoch,
+            "score": float(model.score()),
+            "minibatch_size": getattr(model, "_last_batch_size", None),
+            "iteration_time_sec": dur,
+            "layers": layers,
+        })
+
+    def _send_static(self, model):
+        import jax
+        import numpy as _np
+        n_params = sum(int(_np.prod(l.shape))
+                       for l in jax.tree_util.tree_leaves(model._params))
+        self.storage.putStaticInfo({
+            "session_id": self.session_id,
+            "worker_id": "0",
+            "model_class": type(model).__name__,
+            "n_parameters": n_params,
+            "backend": jax.default_backend(),
+            "n_devices": jax.device_count(),
+        })
+        self._static_sent = True
+
+
+class ProfilingListener(TrainingListener):
+    """Chrome-trace profiling of training iterations (ref:
+    ProfilingListener / OpProfiler, SURVEY.md §5 "Tracing/profiling").
+
+    TPU-native: delegates to ``jax.profiler`` — the trace captures XLA
+    device ops, host dispatch, and transfers; view in Perfetto/TensorBoard.
+    Traces iterations [start_iter, end_iter) once, then stops."""
+
+    def __init__(self, log_dir: str = None, start_iter: int = 2,
+                 n_iters: int = 3, create_perfetto_trace: bool = True):
+        if log_dir is None:
+            # honour the env registry's DL4J_TPU_PROFILE_DIR knob
+            from deeplearning4j_tpu.utils.environment import Environment
+            log_dir = Environment.get().profile_dir
+        self.log_dir = log_dir
+        self.start_iter = start_iter
+        self.n_iters = n_iters
+        self.create_perfetto = create_perfetto_trace
+        self._active = False
+        self._done = False
+        self._trace_start = None
+
+    def onIterationStart(self, model, iteration: int):
+        import jax
+        if self._done or self._active or iteration < self.start_iter:
+            return
+        os.makedirs(self.log_dir, exist_ok=True)
+        jax.profiler.start_trace(self.log_dir,
+                                 create_perfetto_trace=self.create_perfetto)
+        self._active = True
+        self._trace_start = iteration   # window is RELATIVE to actual start
+
+    def _stop(self, model):
+        import jax
+        model.score()  # sync before stopping so device ops land in-trace
+        jax.profiler.stop_trace()
+        self._active = False
+        self._done = True
+
+    def iterationDone(self, model, iteration, epoch):
+        # both hooks are 1-based; trace covers exactly n_iters steps from
+        # wherever the trace actually started
+        if self._active and iteration - self._trace_start + 1 >= self.n_iters:
+            self._stop(model)
+
+    def onEpochEnd(self, model):
+        if self._active:  # epoch shorter than the trace window
+            self._stop(model)

@@ -1,0 +1,10 @@
+"""Training UI / stats dashboard (ref: deeplearning4j-ui-parent, SURVEY.md
+§1 L8): StatsListener (train.listeners) -> StatsStorage (ui.stats) ->
+UIServer (ui.server)."""
+
+from deeplearning4j_tpu.ui.stats import (FileStatsStorage, InMemoryStatsStorage,
+                                         StatsStorage, StatsStorageRouter)
+from deeplearning4j_tpu.ui.server import UIServer
+
+__all__ = ["StatsStorage", "InMemoryStatsStorage", "FileStatsStorage",
+           "StatsStorageRouter", "UIServer"]

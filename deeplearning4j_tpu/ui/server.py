@@ -1,0 +1,227 @@
+"""Training dashboard server.
+
+Reference parity: ``org.deeplearning4j.ui.api.UIServer`` (the play-based
+DL4J training UI, ``deeplearning4j-ui-parent`` — SURVEY.md §1 L8): attach
+a StatsStorage, browse score/throughput and per-layer parameter/update
+charts while (or after) training runs.
+
+TPU-native/minimal: a stdlib ``http.server`` on a background thread
+serving a self-contained HTML page (inline SVG charts, zero external
+assets — the build environment is egress-free and so are most TPU pods).
+JSON endpoints mirror the dashboard's needs:
+
+- ``GET /api/sessions``                     -> list of session ids
+- ``GET /api/static?session=S``             -> static info record
+- ``GET /api/overview?session=S``           -> score + timing series
+- ``GET /api/model?session=S``              -> per-layer stats series
+- ``GET /``                                 -> dashboard page
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from deeplearning4j_tpu.ui.stats import StatsStorage
+
+_PAGE = """<!DOCTYPE html>
+<html><head><meta charset="utf-8"><title>deeplearning4j-tpu training UI</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:20px;background:#fafafa}
+ h1{font-size:18px} h2{font-size:14px;margin:18px 0 4px}
+ .card{background:#fff;border:1px solid #ddd;border-radius:6px;
+       padding:10px;margin-bottom:14px}
+ svg{width:100%;height:180px} .meta{color:#666;font-size:12px}
+ select{margin-bottom:10px}
+ table{border-collapse:collapse;font-size:12px}
+ td,th{border:1px solid #ddd;padding:3px 8px;text-align:right}
+ th:first-child,td:first-child{text-align:left}
+</style></head><body>
+<h1>deeplearning4j-tpu training UI</h1>
+<select id="sess"></select>
+<div class="card"><h2>Score vs iteration</h2><svg id="score"></svg></div>
+<div class="card"><h2>Update:parameter ratio (log10) vs iteration</h2>
+  <svg id="ratio"></svg></div>
+<div class="card"><h2>Latest layer stats</h2><div id="layers"></div></div>
+<div class="card"><h2>Session</h2><div id="static" class="meta"></div></div>
+<script>
+function line(svg, series, names){
+  const W=900,H=170,P=30; svg.innerHTML=""; svg.setAttribute("viewBox",
+    "0 0 "+W+" "+H);
+  let all=series.flatMap(s=>s.y).filter(v=>isFinite(v));
+  if(!all.length)return;
+  let xs=series[0].x, xmin=Math.min(...xs), xmax=Math.max(...xs)||1;
+  let ymin=Math.min(...all), ymax=Math.max(...all);
+  if(ymin===ymax){ymin-=1;ymax+=1}
+  const sx=x=>P+(x-xmin)/(xmax-xmin||1)*(W-2*P);
+  const sy=y=>H-P-(y-ymin)/(ymax-ymin)*(H-2*P);
+  const colors=["#1f77b4","#ff7f0e","#2ca02c","#d62728","#9467bd",
+                "#8c564b","#e377c2","#7f7f7f"];
+  series.forEach((s,i)=>{
+    let d=s.x.map((x,j)=>(j?"L":"M")+sx(x)+" "+sy(s.y[j])).join(" ");
+    let p=document.createElementNS("http://www.w3.org/2000/svg","path");
+    p.setAttribute("d",d); p.setAttribute("fill","none");
+    p.setAttribute("stroke",colors[i%colors.length]); svg.appendChild(p);
+  });
+  [[ymin,H-P],[ymax,P]].forEach(([v,y])=>{
+    let t=document.createElementNS("http://www.w3.org/2000/svg","text");
+    t.textContent=v.toPrecision(3); t.setAttribute("x",0);
+    t.setAttribute("y",y); t.setAttribute("font-size","10");
+    svg.appendChild(t);});
+}
+async function refresh(){
+  const sess=document.getElementById("sess").value; if(!sess)return;
+  const ov=await (await fetch("/api/overview?session="+sess)).json();
+  line(document.getElementById("score"),
+       [{x:ov.iterations,y:ov.scores}]);
+  const mo=await (await fetch("/api/model?session="+sess)).json();
+  const rsvg=document.getElementById("ratio");
+  const rser=Object.entries(mo.ratio_series).slice(0,8).map(([k,v])=>(
+      {x:mo.iterations,y:v.map(r=>Math.log10(r+1e-12))}));
+  line(rsvg,rser);
+  let rows="<table><tr><th>layer/param</th><th>mean</th><th>std</th>"+
+      "<th>norm</th><th>upd norm</th><th>upd ratio</th></tr>";
+  for(const [k,v] of Object.entries(mo.latest))
+    rows+=`<tr><td>${k}</td><td>${v.param_mean.toExponential(2)}</td>`+
+      `<td>${v.param_std.toExponential(2)}</td>`+
+      `<td>${v.param_norm.toExponential(2)}</td>`+
+      `<td>${v.update_norm.toExponential(2)}</td>`+
+      `<td>${v.update_ratio.toExponential(2)}</td></tr>`;
+  document.getElementById("layers").innerHTML=rows+"</table>";
+  const st=await (await fetch("/api/static?session="+sess)).json();
+  document.getElementById("static").textContent=JSON.stringify(st);
+}
+async function syncSessions(){
+  const ss=await (await fetch("/api/sessions")).json();
+  const sel=document.getElementById("sess");
+  const cur=sel.value;
+  if(ss.length !== sel.options.length){
+    sel.innerHTML=ss.map(s=>`<option>${s}</option>`).join("");
+    if(ss.includes(cur)) sel.value=cur;
+  }
+}
+async function init(){
+  await syncSessions();
+  const sel=document.getElementById("sess");
+  sel.onchange=refresh; refresh();
+  setInterval(async()=>{await syncSessions(); refresh();}, 3000);
+}
+init();
+</script></body></html>"""
+
+
+def _sanitize(x):
+    if isinstance(x, dict):
+        return {k: _sanitize(v) for k, v in x.items()}
+    if isinstance(x, (list, tuple)):
+        return [_sanitize(v) for v in x]
+    if isinstance(x, float) and (x != x or x in (float("inf"), float("-inf"))):
+        return None
+    return x
+
+
+class _Handler(BaseHTTPRequestHandler):
+    storage: StatsStorage = None  # set per-server via subclass
+
+    def log_message(self, *a):   # silence request logging
+        pass
+
+    def _json(self, payload, code=200):
+        # bare NaN/Infinity tokens are invalid JSON for browsers; map
+        # non-finite floats (e.g. a NaN score) to null so the dashboard
+        # keeps rendering exactly when diagnostics matter most
+        body = json.dumps(_sanitize(payload)).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        url = urlparse(self.path)
+        q = {k: v[0] for k, v in parse_qs(url.query).items()}
+        st = self.storage
+        if url.path == "/":
+            body = _PAGE.encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/html")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        if url.path == "/api/sessions":
+            return self._json(st.listSessionIDs())
+        sid = q.get("session", "")
+        if url.path == "/api/static":
+            return self._json(st.getStaticInfo(sid) or {})
+        if url.path == "/api/overview":
+            ups = st.getAllUpdates(sid)
+            return self._json({
+                "iterations": [u.get("iteration") for u in ups],
+                "scores": [u.get("score") for u in ups],
+                "times": [u.get("iteration_time_sec") for u in ups],
+            })
+        if url.path == "/api/model":
+            ups = st.getAllUpdates(sid)
+            ratio_series = {}
+            for u in ups:
+                for lname, rec in (u.get("layers") or {}).items():
+                    ratio_series.setdefault(lname, []).append(
+                        rec.get("update_ratio", 0.0))
+            latest = (ups[-1].get("layers") or {}) if ups else {}
+            return self._json({
+                "iterations": [u.get("iteration") for u in ups],
+                "ratio_series": ratio_series,
+                "latest": latest,
+            })
+        self._json({"error": "not found"}, 404)
+
+
+class UIServer:
+    """ref: UIServer.getInstance().attach(statsStorage)."""
+
+    _instance: Optional["UIServer"] = None
+
+    def __init__(self, port: int = 9000):
+        self.port = port
+        self._storage: Optional[StatsStorage] = None
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @classmethod
+    def getInstance(cls, port: int = 9000) -> "UIServer":
+        if cls._instance is None:
+            cls._instance = cls(port)
+        return cls._instance
+
+    def attach(self, storage: StatsStorage):
+        self._storage = storage
+        if self._httpd is None:
+            handler = type("BoundHandler", (_Handler,), {"storage": storage})
+            self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), handler)
+            self.port = self._httpd.server_address[1]   # resolve port 0
+            self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                            daemon=True)
+            self._thread.start()
+        else:
+            self._httpd.RequestHandlerClass.storage = storage
+        return self
+
+    def detach(self):
+        self.stop()
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+            self._thread = None
+        if UIServer._instance is self:
+            UIServer._instance = None
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}/"

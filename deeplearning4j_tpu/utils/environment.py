@@ -98,3 +98,24 @@ KNOBS = {
     "prefetch_buffer": "Async iterator prefetch depth (ref: AsyncDataSetIterator)",
     "loader_threads": "Host data-loading threads (ref: libnd4j Threads, data only)",
 }
+
+
+class NumericsPanicError(ArithmeticError):
+    """Raised by NAN_PANIC/INF_PANIC debug modes (ref: OpExecutioner
+    ProfilingMode.NAN_PANIC / INF_PANIC)."""
+
+
+def panic_check(value, context: str = "loss"):
+    """Debug-mode numerics gate: when ``nan_panic``/``inf_panic`` is set,
+    synchronously pull ``value`` and raise on NaN/Inf with the training
+    context. Costs a host sync per call — a DEBUG mode, matching the
+    reference's profiling-mode semantics (off by default)."""
+    env = Environment.get()
+    if not (env.nan_panic or env.inf_panic):
+        return
+    import numpy as _np
+    v = _np.asarray(value)
+    if env.nan_panic and _np.isnan(v).any():
+        raise NumericsPanicError(f"NAN_PANIC: NaN detected in {context}")
+    if env.inf_panic and _np.isinf(v).any():
+        raise NumericsPanicError(f"INF_PANIC: Inf detected in {context}")

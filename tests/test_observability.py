@@ -1,0 +1,183 @@
+"""Observability subsystem tests: StatsListener -> StatsStorage -> UIServer,
+profiler tracing, NaN/Inf panic debug modes.
+
+Reference parity: SURVEY.md §5 "Metrics/logging" (StatsListener/
+InMemoryStatsStorage/FileStatsStorage/UIServer of deeplearning4j-ui-parent),
+"Tracing/profiling" (ProfilingListener -> Chrome trace), and OpExecutioner
+ProfilingMode NAN_PANIC/INF_PANIC.
+"""
+
+import glob
+import json
+import os
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.models import zoo
+from deeplearning4j_tpu.train.listeners import (ProfilingListener,
+                                                StatsListener)
+from deeplearning4j_tpu.ui import (FileStatsStorage, InMemoryStatsStorage,
+                                   StatsStorageRouter, UIServer)
+from deeplearning4j_tpu.utils.environment import (Environment,
+                                                  NumericsPanicError)
+
+
+def _tiny_net_and_data(seed=0):
+    net = zoo.LeNet(num_classes=3, input_shape=(1, 16, 16)).init()
+    rng = np.random.RandomState(seed)
+    x = rng.randn(8, 16 * 16).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 8)]
+    return net, DataSet(x, y)
+
+
+class TestStatsStorage:
+    def test_in_memory_sessions_and_updates(self):
+        st = InMemoryStatsStorage()
+        events = []
+        st.registerStatsStorageListener(lambda e: events.append(e.kind))
+        st.putStaticInfo({"session_id": "a", "model_class": "X"})
+        st.putUpdate({"session_id": "a", "iteration": 1, "score": 1.0})
+        st.putUpdate({"session_id": "a", "iteration": 2, "score": 0.5})
+        assert st.listSessionIDs() == ["a"]
+        assert st.getStaticInfo("a")["model_class"] == "X"
+        assert [u["iteration"] for u in st.getAllUpdates("a")] == [1, 2]
+        assert st.getLatestUpdate("a")["score"] == 0.5
+        assert st.getAllUpdatesAfter("a", 1)[0]["iteration"] == 2
+        assert "new_session" in events and "update" in events
+
+    def test_file_storage_reload(self, tmp_path):
+        p = str(tmp_path / "stats.jsonl")
+        st = FileStatsStorage(p)
+        st.putStaticInfo({"session_id": "s1", "n_parameters": 7})
+        st.putUpdate({"session_id": "s1", "iteration": 3, "score": 0.1})
+        st.close()
+        st2 = FileStatsStorage(p)   # reload from disk
+        assert st2.listSessionIDs() == ["s1"]
+        assert st2.getStaticInfo("s1")["n_parameters"] == 7
+        assert st2.getLatestUpdate("s1")["iteration"] == 3
+        st2.close()
+
+    def test_router_fans_out(self, tmp_path):
+        a, b = InMemoryStatsStorage(), InMemoryStatsStorage()
+        r = StatsStorageRouter(a, b)
+        r.putUpdate({"session_id": "x", "iteration": 1})
+        assert a.getAllUpdates("x") and b.getAllUpdates("x")
+
+
+class TestStatsListener:
+    def test_records_per_layer_stats_from_fit(self):
+        net, ds = _tiny_net_and_data()
+        st = InMemoryStatsStorage()
+        lst = StatsListener(st, frequency=1, session_id="t1")
+        net.setListeners(lst)
+        for _ in range(3):
+            net.fit(ds)
+        ups = st.getAllUpdates("t1")
+        assert len(ups) == 3
+        u = ups[-1]
+        assert np.isfinite(u["score"])
+        assert u["minibatch_size"] == 8
+        # per-layer records carry param/update stats incl. the ratio chart's
+        # numerator/denominator
+        assert u["layers"], "no layer stats captured"
+        some = next(iter(u["layers"].values()))
+        for k in ("param_mean", "param_std", "param_norm", "update_norm",
+                  "update_ratio"):
+            assert np.isfinite(some[k])
+        # training actually moved the weights
+        assert any(rec["update_norm"] > 0 for rec in u["layers"].values())
+        static = st.getStaticInfo("t1")
+        assert static["n_parameters"] > 0
+        assert static["model_class"] == "MultiLayerNetwork"
+
+    def test_frequency_sampling(self):
+        net, ds = _tiny_net_and_data()
+        st = InMemoryStatsStorage()
+        net.setListeners(StatsListener(st, frequency=2, session_id="t2"))
+        for _ in range(4):
+            net.fit(ds)
+        iters = [u["iteration"] for u in st.getAllUpdates("t2")]
+        assert iters == [2, 4]
+
+    def test_histograms(self):
+        net, ds = _tiny_net_and_data()
+        st = InMemoryStatsStorage()
+        net.setListeners(StatsListener(st, frequency=1, session_id="t3",
+                                       with_histograms=True, hist_bins=10))
+        net.fit(ds)
+        u = st.getLatestUpdate("t3")
+        some = next(iter(u["layers"].values()))
+        assert len(some["hist_counts"]) == 10
+        assert len(some["hist_range"]) == 2
+
+    def test_works_on_computation_graph(self):
+        g = zoo.SqueezeNet(num_classes=3, input_shape=(3, 32, 32)).init()
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 3, 32, 32).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 2)]
+        st = InMemoryStatsStorage()
+        g.setListeners(StatsListener(st, frequency=1, session_id="g1"))
+        g.fit(DataSet(x, y))
+        u = st.getLatestUpdate("g1")
+        assert u is not None and u["layers"]
+
+
+class TestUIServer:
+    def test_dashboard_endpoints(self):
+        net, ds = _tiny_net_and_data()
+        st = InMemoryStatsStorage()
+        net.setListeners(StatsListener(st, frequency=1, session_id="ui1"))
+        net.fit(ds)
+        net.fit(ds)
+        server = UIServer(port=0).attach(st)
+        try:
+            base = server.url
+            sessions = json.load(urllib.request.urlopen(base + "api/sessions"))
+            assert "ui1" in sessions
+            ov = json.load(urllib.request.urlopen(
+                base + "api/overview?session=ui1"))
+            assert len(ov["iterations"]) == 2
+            assert all(np.isfinite(s) for s in ov["scores"])
+            mo = json.load(urllib.request.urlopen(
+                base + "api/model?session=ui1"))
+            assert mo["latest"] and mo["ratio_series"]
+            page = urllib.request.urlopen(base).read().decode()
+            assert "training UI" in page and "Score vs iteration" in page
+        finally:
+            server.stop()
+
+
+class TestProfiling:
+    def test_profiling_listener_writes_trace(self, tmp_path):
+        net, ds = _tiny_net_and_data()
+        d = str(tmp_path / "trace")
+        net.setListeners(ProfilingListener(d, start_iter=1, n_iters=2))
+        for _ in range(4):
+            net.fit(ds)
+        files = glob.glob(os.path.join(d, "**", "*"), recursive=True)
+        assert any(("trace" in f or f.endswith(".pb") or ".xplane" in f)
+                   and os.path.isfile(f) for f in files), files
+
+
+class TestNumericsPanic:
+    def test_nan_panic_raises(self):
+        net, ds = _tiny_net_and_data()
+        bad = DataSet(np.full((8, 256), np.nan, np.float32), ds.labels)
+        Environment.reset()
+        os.environ["DL4J_TPU_NAN_PANIC"] = "1"
+        try:
+            with pytest.raises(NumericsPanicError, match="NAN_PANIC"):
+                net.fit(bad)
+        finally:
+            os.environ.pop("DL4J_TPU_NAN_PANIC", None)
+            Environment.reset()
+
+    def test_no_panic_when_disabled(self):
+        net, ds = _tiny_net_and_data()
+        bad = DataSet(np.full((8, 256), np.nan, np.float32), ds.labels)
+        Environment.reset()
+        net.fit(bad)   # silently produces NaN loss, as configured
+        assert np.isnan(net.score())
