@@ -1,0 +1,14 @@
+"""Native (C++) runtime layer over the PJRT C API.
+
+Reference parity: libnd4j + nd4j-native JNI bridge (SURVEY.md §2.1 L0,
+§7 item 1). Build: ``make -C deeplearning4j_tpu/native`` (or
+``build_native_lib()``); see src/pjrt_runtime.cc.
+"""
+
+from deeplearning4j_tpu.native.runtime import (NativeExecutable,
+                                               NativeRuntime,
+                                               NativeRuntimeError,
+                                               build_native_lib)
+
+__all__ = ["NativeRuntime", "NativeExecutable", "NativeRuntimeError",
+           "build_native_lib"]

@@ -487,11 +487,16 @@ class MultiLayerNetwork:
         if sig not in self._tbptt_step_cache:
             self._tbptt_step_cache[sig] = self._make_tbptt_step(sig)
         step = self._tbptt_step_cache[sig]
+        for lst in self._listeners:
+            if hasattr(lst, "onIterationStart"):
+                lst.onIterationStart(self, self._iteration + 1)
         self._params, self._opt_state, loss, new_seg = step(
             self._params, self._states, self._opt_state,
             jnp.asarray(self._iteration, jnp.float32), x, y,
             lmask if lmask is not None else jnp.zeros((1,)), seg_states)
         self._score = loss  # on-device; score() converts lazily
+        _environment.panic_check(
+            loss, f"tBPTT loss at iteration {self._iteration}")
         self._iteration += 1
         return new_seg
 

@@ -119,17 +119,14 @@ class InMemoryStatsStorage(StatsStorage):
             return list(self._updates.get(session_id, []))
 
 
-class FileStatsStorage(StatsStorage):
-    """ref: FileStatsStorage — append-only JSONL file, reload-on-open.
-
-    One record per line; survives process restarts (the UI can be pointed
-    at the file of a finished or remote run)."""
+class FileStatsStorage(InMemoryStatsStorage):
+    """ref: FileStatsStorage — the in-memory index plus an append-only
+    JSONL file, reloaded on open (the UI can be pointed at the file of a
+    finished or remote run)."""
 
     def __init__(self, path: str):
         super().__init__()
         self.path = path
-        self._static: Dict[str, Dict] = {}
-        self._updates: Dict[str, List[Dict]] = {}
         if os.path.exists(path):
             with open(path) as f:
                 for line in f:
@@ -148,27 +145,11 @@ class FileStatsStorage(StatsStorage):
         self._fh = open(path, "a")
 
     def _store(self, record, static):
-        sid = record["session_id"]
+        is_new = super()._store(record, static)
         with self._lock:
-            is_new = sid not in self._static and sid not in self._updates
-            if static:
-                self._static[sid] = record
-            else:
-                self._updates.setdefault(sid, []).append(record)
             self._fh.write(json.dumps(record) + "\n")
             self._fh.flush()
         return is_new
-
-    def listSessionIDs(self):
-        with self._lock:
-            return sorted(set(self._static) | set(self._updates))
-
-    def getStaticInfo(self, session_id):
-        return self._static.get(session_id)
-
-    def getAllUpdates(self, session_id):
-        with self._lock:
-            return list(self._updates.get(session_id, []))
 
     def close(self):
         self._fh.close()
