@@ -2,6 +2,19 @@
 (ref: deeplearning4j-scaleout — SURVEY.md §2.3; redesigned as synchronous
 SPMD over a device mesh with XLA collectives)."""
 
+from deeplearning4j_tpu.parallel.checkpoint import (  # noqa: F401
+    load_sharded,
+    save_sharded,
+)
+from deeplearning4j_tpu.parallel.data import (  # noqa: F401
+    ShardedDataSetIterator,
+    make_global_view,
+)
+from deeplearning4j_tpu.parallel.init import (  # noqa: F401
+    distributed_info,
+    initializeDistributed,
+    shutdownDistributed,
+)
 from deeplearning4j_tpu.parallel.mesh import DeviceMesh, ShardingRule  # noqa: F401
 from deeplearning4j_tpu.parallel.sequence import ring_attention  # noqa: F401
 from deeplearning4j_tpu.parallel.wrapper import (  # noqa: F401

@@ -1,0 +1,192 @@
+"""Multi-host-safe sharded checkpointing.
+
+Reference parity: the reference checkpoints from the Spark driver after
+parameter averaging (one writer, full array — SURVEY.md §2.3); on a TPU
+pod the parameters may be SHARDED across processes (FSDP/TP), so the
+TPU-native layout is: every process writes exactly the shards it can
+address (``arr.addressable_shards``), plus a process-0 manifest recording
+tree structure, global shapes, and which file holds which shard index.
+Loading is the mirror: each process reads only the shards its target
+sharding makes addressable and assembles them with
+``jax.make_array_from_single_device_arrays`` — no gather, no full-array
+host materialization on any single host.
+
+Layout on disk::
+
+    <dir>/manifest.json                  (process 0)
+    <dir>/shards_p<K>.npz                (process K: its addressable data)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding
+
+
+def _flatten(tree):
+    leaves, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    names = []
+    for path, _ in leaves:
+        names.append("/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                              for p in path))
+    return names, [l for _, l in leaves], treedef
+
+
+def _index_key(index: Tuple[slice, ...], shape: Tuple[int, ...]) -> str:
+    """Canonical key for a shard's global index: explicit starts/stops."""
+    return ";".join(
+        f"{s.start or 0}:{s.stop if s.stop is not None else dim}"
+        for s, dim in zip(index, shape))
+
+
+def save_sharded(directory: str, tree, step: int = 0):
+    """Each process writes its addressable shards; process 0 writes the
+    manifest. Barrier-free (the filesystem is the rendezvous; callers on
+    multi-host should barrier before reading, as trainers naturally do
+    between steps)."""
+    os.makedirs(directory, exist_ok=True)
+    names, leaves, _ = _flatten(tree)
+    pidx = jax.process_index()
+    local: Dict[str, np.ndarray] = {}
+    manifest: Dict[str, Any] = {"step": step, "leaves": {}}
+    for name, leaf in zip(names, leaves):
+        is_array = isinstance(leaf, jax.Array)
+        arr = leaf if is_array else jax.numpy.asarray(leaf)
+        entry: Dict[str, Any] = {"shape": list(arr.shape),
+                                 "dtype": str(arr.dtype), "shards": {}}
+        if not is_array and np.ndim(leaf) == 0:
+            # plain Python scalar leaf: restore with the original type
+            entry["pytype"] = type(leaf).__name__
+        for sh in arr.addressable_shards:
+            if sh.replica_id != 0:
+                # replicated shards are written exactly once GLOBALLY —
+                # a 64-host pure-DP job must not write 64 copies
+                continue
+            key = _index_key(sh.index, arr.shape)
+            if f"{name}::{key}" in local:
+                continue
+            local[f"{name}::{key}"] = np.asarray(sh.data)
+            entry["shards"][key] = f"shards_p{pidx}.npz"
+        manifest["leaves"][name] = entry
+    np.savez(os.path.join(directory, f"shards_p{pidx}.npz"), **local)
+
+    if jax.process_count() > 1:
+        # merge shard->file maps across processes: each rank atomically
+        # writes a step-stamped sub-manifest; rank 0 merges the set for
+        # THIS step (stale files from earlier saves can't satisfy it)
+        _atomic_json(os.path.join(directory, f"manifest_p{pidx}.json"),
+                     manifest)
+        _merge_manifests(directory, step)
+    else:
+        _atomic_json(os.path.join(directory, "manifest.json"), manifest)
+
+
+def _atomic_json(path: str, payload):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, path)
+
+
+def _merge_manifests(directory: str, step: int, timeout_s: float = 60.0):
+    import glob as _glob
+    import time
+    if jax.process_index() != 0:
+        return
+    expect = jax.process_count()
+    deadline = time.monotonic() + timeout_s
+    merged: Optional[Dict] = None
+    while True:
+        subs = sorted(_glob.glob(os.path.join(directory, "manifest_p*.json")))
+        current = []
+        for p in subs:
+            try:
+                with open(p) as f:
+                    m = json.load(f)
+            except (json.JSONDecodeError, OSError):
+                continue       # mid-rename from a non-atomic filesystem
+            if m.get("step") == step:
+                current.append(m)
+        if len(current) >= expect:
+            merged = current[0]
+            for m in current[1:]:
+                for name, entry in m["leaves"].items():
+                    merged["leaves"][name]["shards"].update(entry["shards"])
+            break
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"checkpoint merge: only {len(current)}/{expect} rank "
+                f"manifests for step {step} appeared in {directory} within "
+                f"{timeout_s}s")
+        time.sleep(0.05)
+    _atomic_json(os.path.join(directory, "manifest.json"), merged)
+
+
+def load_sharded(directory: str, target_tree, mesh=None, specs=None):
+    """Load into the sharding of ``target_tree`` (a pytree of jax.Arrays
+    whose shardings define what this process needs), or — when ``mesh``
+    and ``specs`` (same-structure pytree of PartitionSpecs) are given —
+    into fresh arrays with those shardings.
+
+    Returns (tree, step)."""
+    import time
+    man_path = os.path.join(directory, "manifest.json")
+    for _ in range(600):          # rank-0 merge may still be in flight
+        if os.path.exists(man_path):
+            break
+        time.sleep(0.05)
+    with open(man_path) as f:
+        manifest = json.load(f)
+
+    names, leaves, treedef = _flatten(target_tree)
+    if specs is not None:
+        spec_leaves = treedef.flatten_up_to(specs)
+    # open every shard file lazily
+    files: Dict[str, Any] = {}
+
+    def shard_data(name: str, key: str) -> np.ndarray:
+        fname = manifest["leaves"][name]["shards"][key]
+        if fname not in files:
+            files[fname] = np.load(os.path.join(directory, fname))
+        return files[fname][f"{name}::{key}"]
+
+    out_leaves: List[Any] = []
+    for i, (name, leaf) in enumerate(zip(names, leaves)):
+        entry = manifest["leaves"][name]
+        if specs is not None and mesh is not None:
+            sharding = NamedSharding(mesh, spec_leaves[i])
+        elif isinstance(leaf, jax.Array) and hasattr(leaf, "sharding"):
+            sharding = leaf.sharding
+        else:
+            data = shard_data(name, next(iter(entry["shards"])))
+            pytype = entry.get("pytype")
+            if pytype in ("int", "float", "bool"):
+                out_leaves.append(
+                    {"int": int, "float": float, "bool": bool}[pytype](
+                        np.asarray(data).item()))
+            else:
+                out_leaves.append(jax.numpy.asarray(data))
+            continue
+        shape = tuple(entry["shape"])
+        # assemble from per-device addressable shards
+        dev_arrays = []
+        devices = []
+        index_map = sharding.addressable_devices_indices_map(shape)
+        for device, index in index_map.items():
+            key = _index_key(index, shape)
+            if key not in entry["shards"]:
+                raise FileNotFoundError(
+                    f"checkpoint {directory} has no shard {key} of {name} "
+                    f"(saved with a different sharding/topology?)")
+            dev_arrays.append(jax.device_put(shard_data(name, key), device))
+            devices.append(device)
+        arr = jax.make_array_from_single_device_arrays(shape, sharding,
+                                                       dev_arrays)
+        out_leaves.append(arr)
+    return (jax.tree_util.tree_unflatten(treedef, out_leaves),
+            manifest.get("step", 0))

@@ -1,0 +1,120 @@
+"""Multi-host distributed initialization.
+
+Reference parity: the reference's multi-node entry points — Spark
+``SharedTrainingMaster`` + Aeron ``MeshOrganizer`` bootstrap (SURVEY.md
+§2.3, §3.4) and the ``NativeOpsHolder`` MPI/NCCL init underneath — whose
+TPU-native replacement is one call: ``jax.distributed.initialize`` wires
+every process into one global device mesh; afterwards the SAME
+``Mesh``/``pjit`` code that runs single-host runs multi-host, with XLA
+placing collectives on ICI within a slice and DCN across slices
+(SURVEY.md §5 "Distributed communication backend", §7 hard-part #7).
+
+Environment-variable driven (all optional on TPU pods, where jax
+auto-discovers the topology):
+
+- ``DL4J_TPU_COORDINATOR``   — "host:port" of process 0
+- ``DL4J_TPU_NUM_PROCESSES`` — world size
+- ``DL4J_TPU_PROCESS_ID``    — this process's rank
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+
+@dataclass
+class DistributedInfo:
+    process_index: int
+    process_count: int
+    local_device_count: int
+    global_device_count: int
+    coordinator: Optional[str]
+
+
+_initialized: Optional[DistributedInfo] = None
+
+
+def initializeDistributed(coordinator_address: str = None,
+                          num_processes: int = None,
+                          process_id: int = None,
+                          local_device_ids: Sequence[int] = None,
+                          ) -> DistributedInfo:
+    """ref: the SharedTrainingMaster bootstrap, collapsed to one call.
+
+    On a TPU pod slice all arguments are auto-discovered (call with no
+    args in every process). For CPU/GPU clusters or tests, pass (or set
+    via DL4J_TPU_* env vars) the coordinator address, world size, and
+    rank. Idempotent per process."""
+    global _initialized
+    import jax
+
+    if _initialized is not None:
+        return _initialized
+
+    coordinator_address = coordinator_address or os.environ.get(
+        "DL4J_TPU_COORDINATOR")
+    if num_processes is None and "DL4J_TPU_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["DL4J_TPU_NUM_PROCESSES"])
+    if process_id is None and "DL4J_TPU_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["DL4J_TPU_PROCESS_ID"])
+
+    if coordinator_address is not None or num_processes is not None:
+        # CPU backends need a cross-process collectives implementation.
+        # NOTE: must not touch jax.devices()/default_backend() here — the
+        # backend must not initialize before distributed.initialize().
+        platforms = (jax.config.jax_platforms or
+                     os.environ.get("JAX_PLATFORMS", ""))
+        if str(platforms).startswith("cpu"):
+            try:
+                jax.config.update("jax_cpu_collectives_implementation", "gloo")
+            except Exception:
+                pass
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            local_device_ids=local_device_ids)
+    else:
+        # TPU pod: everything auto-discovered (no-op on a single host
+        # with no coordinator configured)
+        try:
+            jax.distributed.initialize()
+        except Exception:
+            # Swallow ONLY when nothing in the environment says this is a
+            # real multi-host job — silently degrading a pod to isolated
+            # single-process training (wrong grads, corrupt checkpoints)
+            # is far worse than failing loud.
+            cluster_markers = ("COORDINATOR_ADDRESS",
+                               "JAX_COORDINATOR_ADDRESS",
+                               "MEGASCALE_COORDINATOR_ADDRESS",
+                               "TPU_CLUSTER_COORDINATOR")
+            if any(m in os.environ for m in cluster_markers):
+                raise
+            hosts = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+            if "," in hosts:
+                raise
+
+    _initialized = DistributedInfo(
+        process_index=jax.process_index(),
+        process_count=jax.process_count(),
+        local_device_count=jax.local_device_count(),
+        global_device_count=jax.device_count(),
+        coordinator=coordinator_address)
+    return _initialized
+
+
+def shutdownDistributed():
+    global _initialized
+    import jax
+    if _initialized is not None:
+        try:
+            jax.distributed.shutdown()
+        except Exception:
+            pass
+        _initialized = None
+
+
+def distributed_info() -> Optional[DistributedInfo]:
+    return _initialized

@@ -1,0 +1,198 @@
+"""Multi-host wiring tests: 2 real processes on one machine, wired into a
+single global device mesh via ``initializeDistributed`` (gloo CPU
+collectives), per-process data sharding, and the sharded checkpoint
+layout.
+
+Reference parity: SURVEY.md §5 "Distributed communication backend" / §7
+hard-part #7 — the reference proves its Spark+Aeron plumbing with
+multi-worker integration tests; here two OS processes really rendezvous,
+train the same SPMD step on a mesh spanning both, and checkpoint/restore
+shard-wise.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_WORKER = r"""
+import json, os, sys
+sys.path.insert(0, os.environ["DL4J_REPO"])
+import numpy as np
+
+# the environment's TPU bootstrap (sitecustomize) pins jax_platforms to the
+# TPU plugin; pin back to CPU BEFORE the backend initializes (same move as
+# tests/conftest.py)
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+from deeplearning4j_tpu.parallel.init import initializeDistributed
+info = initializeDistributed()
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.data.dataset import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.parallel.data import (ShardedDataSetIterator,
+                                              make_global_view)
+from deeplearning4j_tpu.parallel import checkpoint as ckpt
+
+assert info.process_count == 2, info
+assert info.global_device_count == 4, info
+
+mesh = Mesh(np.asarray(jax.devices()).reshape(4), ("data",))
+
+# deterministic global dataset, identical on both ranks
+rng = np.random.RandomState(0)
+X = rng.randn(16, 8).astype(np.float32)
+W_true = rng.randn(8, 1).astype(np.float32)
+Y = X @ W_true
+base = ListDataSetIterator(DataSet(X, Y), batch_size=16)
+it = ShardedDataSetIterator(base)
+assert it.batch() == 8
+
+params = {"W": jnp.zeros((8, 1), jnp.float32)}
+rep = NamedSharding(mesh, P())
+params = jax.device_put(params, rep)
+
+@jax.jit
+def step(params, x, y):
+    def loss_fn(p):
+        return jnp.mean((x @ p["W"] - y) ** 2)
+    l, g = jax.value_and_grad(loss_fn)(params)
+    return jax.tree_util.tree_map(lambda p, gg: p - 0.1 * gg, params, g), l
+
+losses = []
+for _ in range(12):
+    it.reset()
+    while it.hasNext():
+        ds = it.next()
+        x = make_global_view(ds.features, mesh, P("data"))
+        y = make_global_view(ds.labels, mesh, P("data"))
+        params, l = step(params, x, y)
+        losses.append(float(l))
+
+out_dir = os.environ["DL4J_CKPT_DIR"]
+ckpt.save_sharded(out_dir, params, step=12)
+
+# restore into the same sharding and verify
+restored, got_step = ckpt.load_sharded(out_dir, params)
+np.testing.assert_allclose(np.asarray(restored["W"]),
+                           np.asarray(params["W"]), rtol=0, atol=0)
+assert got_step == 12
+
+print("RESULT " + json.dumps({
+    "rank": info.process_index,
+    "losses": [round(v, 8) for v in losses],
+    "w_sum": float(np.asarray(params["W"]).sum()),
+}))
+"""
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def test_two_process_train_and_checkpoint(tmp_path):
+    port = _free_port()
+    ckpt_dir = str(tmp_path / "ckpt")
+    worker = str(tmp_path / "worker.py")
+    with open(worker, "w") as f:
+        f.write(_WORKER)
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+            "DL4J_TPU_COORDINATOR": f"127.0.0.1:{port}",
+            "DL4J_TPU_NUM_PROCESSES": "2",
+            "DL4J_TPU_PROCESS_ID": str(rank),
+            "DL4J_REPO": os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))),
+            "DL4J_CKPT_DIR": ckpt_dir,
+        })
+        procs.append(subprocess.Popen([sys.executable, worker],
+                                      stdout=subprocess.PIPE,
+                                      stderr=subprocess.STDOUT, env=env,
+                                      text=True))
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multi-process workers timed out")
+        outs.append(out)
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out[-3000:]}"
+    results = []
+    for out in outs:
+        line = [l for l in out.splitlines() if l.startswith("RESULT ")][-1]
+        results.append(json.loads(line[len("RESULT "):]))
+    assert {r["rank"] for r in results} == {0, 1}
+    # SPMD: both processes computed identical global losses and params
+    assert results[0]["losses"] == results[1]["losses"]
+    assert results[0]["w_sum"] == pytest.approx(results[1]["w_sum"])
+    # training converged on the global (not process-local) problem
+    assert results[0]["losses"][-1] < results[0]["losses"][0] * 0.1
+    # both processes' shard files exist + one merged manifest
+    files = os.listdir(ckpt_dir)
+    assert "manifest.json" in files
+    assert "shards_p0.npz" in files and "shards_p1.npz" in files
+
+
+class TestShardedCheckpointSingleProcess:
+    """Same layout on the 8-virtual-device mesh: sharded leaves write one
+    shard per device index; load assembles exactly the addressable set."""
+
+    def test_sharded_params_roundtrip(self, tmp_path):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        from deeplearning4j_tpu.parallel import checkpoint as ckpt
+
+        mesh = Mesh(np.array(jax.devices()).reshape(8), ("data",))
+        tree = {
+            "W": jax.device_put(jnp.arange(64, dtype=jnp.float32).reshape(8, 8),
+                                NamedSharding(mesh, P("data"))),
+            "b": jax.device_put(jnp.ones((8,)), NamedSharding(mesh, P())),
+            "step_count": 7,   # non-array leaf
+        }
+        d = str(tmp_path / "ck")
+        ckpt.save_sharded(d, tree, step=3)
+        restored, step = ckpt.load_sharded(d, tree)
+        assert step == 3
+        np.testing.assert_array_equal(np.asarray(restored["W"]),
+                                      np.asarray(tree["W"]))
+        np.testing.assert_array_equal(np.asarray(restored["b"]),
+                                      np.asarray(tree["b"]))
+        # shardings preserved; scalar leaves keep their Python type
+        assert restored["W"].sharding.spec == P("data")
+        assert restored["step_count"] == 7
+        assert isinstance(restored["step_count"], int)
+
+    def test_topology_mismatch_reported(self, tmp_path):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        from deeplearning4j_tpu.parallel import checkpoint as ckpt
+
+        mesh = Mesh(np.array(jax.devices()).reshape(8), ("data",))
+        sharded = jax.device_put(jnp.zeros((8, 4)),
+                                 NamedSharding(mesh, P("data")))
+        d = str(tmp_path / "ck2")
+        ckpt.save_sharded(d, {"W": sharded})
+        # a REPLICATED target needs the full array in one shard — saved
+        # 8-way, so this topology change must fail loudly, not silently
+        repl = jax.device_put(jnp.zeros((8, 4)), NamedSharding(mesh, P()))
+        with pytest.raises(FileNotFoundError, match="different sharding"):
+            ckpt.load_sharded(d, {"W": repl})
