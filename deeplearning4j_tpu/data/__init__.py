@@ -14,3 +14,13 @@ from deeplearning4j_tpu.data.iterators import (  # noqa: F401
     IrisDataSetIterator,
     MnistDataSetIterator,
 )
+from deeplearning4j_tpu.data.image import (  # noqa: F401
+    ImageRecordReader,
+    ImageRecordReaderDataSetIterator,
+    NativeImageLoader,
+    ObjectDetectionDataSetIterator,
+    ObjectDetectionRecordReader,
+    ParentPathLabelGenerator,
+    PipelineImageTransform,
+)
+from deeplearning4j_tpu.data.iterators import Cifar10DataSetIterator  # noqa: F401

@@ -157,3 +157,73 @@ def _iris_data():
         [6.7,3.3,5.7,2.5,2],[6.7,3.0,5.2,2.3,2],[6.3,2.5,5.0,1.9,2],[6.5,3.0,5.2,2.0,2],
         [6.2,3.4,5.4,2.3,2],[5.9,3.0,5.1,1.8,2]], dtype=np.float32)
     return raw[:, :4], raw[:, 4].astype(np.int64)
+
+
+def _find_cifar10(train: bool):
+    """CIFAR-10 python-pickle batches under DL4J_TPU_DATA_DIR/cifar10
+    (data_batch_1..5 / test_batch, optionally inside
+    cifar-10-batches-py/)."""
+    import pickle
+    for sub in ("cifar10", os.path.join("cifar10", "cifar-10-batches-py"),
+                "cifar-10-batches-py"):
+        base = os.path.join(_data_dir(), sub)
+        names = [f"data_batch_{i}" for i in range(1, 6)] if train \
+            else ["test_batch"]
+        if not all(os.path.exists(os.path.join(base, n)) for n in names):
+            continue
+        xs, ys = [], []
+        for n in names:
+            with open(os.path.join(base, n), "rb") as f:
+                d = pickle.load(f, encoding="bytes")
+            xs.append(np.asarray(d[b"data"], np.uint8))
+            ys.append(np.asarray(d[b"labels"], np.int64))
+        x = np.concatenate(xs).reshape(-1, 3, 32, 32)
+        return x, np.concatenate(ys)
+    return None
+
+
+def _synthetic_cifar(n: int, seed: int):
+    """Class-dependent colored blobs standing in for CIFAR-10 when the real
+    batches are absent (same honest-fallback policy as MNIST)."""
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, 10, n)
+    x = rng.rand(n, 3, 32, 32).astype(np.float32) * 0.25
+    yy, xx = np.mgrid[0:32, 0:32].astype(np.float32)
+    for i in range(n):
+        c = y[i]
+        cx, cy = 8 + 2 * (c % 4), 8 + 2 * (c // 4)
+        blob = np.exp(-(((xx - cx * 1.5) ** 2 + (yy - cy * 1.5) ** 2)
+                        / (2.0 * (3 + c % 3) ** 2)))
+        x[i, c % 3] += blob
+        x[i, (c + 1) % 3] += 0.5 * blob.T
+    return (np.clip(x, 0, 1) * 255).astype(np.uint8), y
+
+
+class Cifar10DataSetIterator(ListDataSetIterator):
+    """ref: org.deeplearning4j.datasets.iterator.impl.Cifar10DataSetIterator.
+
+    Loads the real CIFAR-10 python batches when present under
+    DL4J_TPU_DATA_DIR (zero-egress environment: no download); otherwise
+    synthesizes class-dependent colored blobs so pipelines/tests run.
+    Features are NCHW float32 in [0, 1]."""
+
+    NUM_CLASSES = 10
+
+    def __init__(self, batch_size: int, train: bool = True,
+                 num_examples: int = None, seed: int = 123,
+                 shuffle: bool = True):
+        found = _find_cifar10(train)
+        self.real_data = found is not None
+        if found is not None:
+            x, y = found
+        else:
+            # split-dependent seed: a synthetic 'test' set must not be the
+            # training set (same policy as MnistDataSetIterator)
+            x, y = _synthetic_cifar(num_examples or 2048,
+                                    seed + (0 if train else 777))
+        if num_examples is not None:
+            x, y = x[:num_examples], y[:num_examples]
+        feats = x.astype(np.float32) / 255.0
+        labels = np.eye(self.NUM_CLASSES, dtype=np.float32)[y]
+        super().__init__(DataSet(feats, labels), batch_size=batch_size,
+                         shuffle=shuffle, seed=seed)

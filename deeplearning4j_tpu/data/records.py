@@ -341,6 +341,99 @@ class TransformProcess:
             self.steps.append(("custom", fn))
             return self
 
+        # -- column management (ref: transform.column.*) --
+        def addConstantColumn(self, name, col_type, value):
+            self.steps.append(("add_const", (name, col_type, value)))
+            return self
+
+        def duplicateColumns(self, names, new_names):
+            self.steps.append(("duplicate", (tuple(names), tuple(new_names))))
+            return self
+
+        def reorderColumns(self, *names):
+            self.steps.append(("reorder", names))
+            return self
+
+        def convertToString(self, name):
+            self.steps.append(("convert", (name, str, ColumnType.STRING)))
+            return self
+
+        def convertToDouble(self, name):
+            self.steps.append(("convert", (name, float, ColumnType.DOUBLE)))
+            return self
+
+        def convertToInteger(self, name):
+            self.steps.append(("convert", (name, lambda v: int(float(v)),
+                                           ColumnType.INTEGER)))
+            return self
+
+        # -- numeric (ref: transform.doubletransform.*) --
+        def doubleMathFunction(self, name, fn_name):
+            self.steps.append(("mathfn", (name, fn_name)))
+            return self
+
+        def doubleColumnsMathOp(self, new_name, op, *columns):
+            self.steps.append(("colmath", (new_name, op, columns)))
+            return self
+
+        def integerMathOp(self, name, op, value):
+            self.steps.append(("math", (name, op, value)))
+            return self
+
+        longMathOp = integerMathOp
+
+        def clipValues(self, name, lo, hi):
+            self.steps.append(("clip", (name, lo, hi)))
+            return self
+
+        def replaceInvalidWithInteger(self, name, value):
+            self.steps.append(("replace_invalid", (name, value)))
+            return self
+
+        # -- strings (ref: transform.string.*) --
+        def appendStringColumnTransform(self, name, suffix):
+            self.steps.append(("append_str", (name, suffix)))
+            return self
+
+        def changeCase(self, name, case: str = "LOWER"):
+            self.steps.append(("change_case", (name, case)))
+            return self
+
+        def stringMapTransform(self, name, mapping: Dict[str, str]):
+            self.steps.append(("str_map", (name, dict(mapping))))
+            return self
+
+        def stringRemoveWhitespaceTransform(self, name):
+            self.steps.append(("rm_ws", (name,)))
+            return self
+
+        def replaceStringTransform(self, name, regex_map: Dict[str, str]):
+            self.steps.append(("str_regex", (name, dict(regex_map))))
+            return self
+
+        def concatenateStringColumns(self, new_name, delimiter, *columns):
+            self.steps.append(("concat_str", (new_name, delimiter, columns)))
+            return self
+
+        # -- time (ref: transform.time.*) --
+        def stringToTimeTransform(self, name, fmt: str):
+            self.steps.append(("str2time", (name, fmt)))
+            return self
+
+        def timeMathOp(self, name, op, amount_ms: int):
+            self.steps.append(("math", (name, op, amount_ms)))
+            return self
+
+        def deriveColumnsFromTime(self, name, *fields):
+            """fields from: hourOfDay, dayOfWeek, dayOfMonth, monthOfYear,
+            year, minuteOfHour, secondOfMinute."""
+            self.steps.append(("derive_time", (name, fields)))
+            return self
+
+        def firstDigitTransform(self, name, new_name):
+            self.steps.append(("first_digit", (name, new_name)))
+            return self
+
         def build(self):
             return TransformProcess(self.schema, self.steps)
 
@@ -447,6 +540,152 @@ class TransformProcess:
             return rows, schema
         if kind == "custom":
             return arg(rows, schema)
+        if kind == "add_const":
+            name, col_type, value = arg
+            for r in rows:
+                r.append(value)
+            schema.columns.append({"name": name, "type": col_type})
+            return rows, schema
+        if kind == "duplicate":
+            src, dst = arg
+            idxs = [names.index(n) for n in src]
+            for r in rows:
+                r.extend(r[i] for i in idxs)
+            for n, i in zip(dst, idxs):
+                schema.columns.append({**schema.columns[i], "name": n})
+            return rows, schema
+        if kind == "reorder":
+            idxs = [names.index(n) for n in arg]
+            idxs += [i for i in range(len(names)) if i not in idxs]
+            return ([[r[i] for i in idxs] for r in rows],
+                    Schema([schema.columns[i] for i in idxs]))
+        if kind == "convert":
+            name, caster, col_type = arg
+            i = names.index(name)
+            for r in rows:
+                r[i] = caster(r[i])
+            schema.columns[i] = {"name": name, "type": col_type}
+            return rows, schema
+        if kind == "mathfn":
+            import math
+            name, fn_name = arg
+            i = names.index(name)
+            fn = {"Log": math.log, "Log2": lambda v: math.log2(v),
+                  "Log10": math.log10, "Sqrt": math.sqrt, "Abs": abs,
+                  "Exp": math.exp, "Sin": math.sin, "Cos": math.cos,
+                  "Tan": math.tan, "Floor": math.floor, "Ceil": math.ceil,
+                  "Sign": lambda v: (v > 0) - (v < 0)}[fn_name]
+            for r in rows:
+                r[i] = float(fn(float(r[i])))
+            return rows, schema
+        if kind == "colmath":
+            new_name, op, cols = arg
+            idxs = [names.index(n) for n in cols]
+            red = {"Add": lambda vs: sum(vs),
+                   "Subtract": lambda vs: vs[0] - sum(vs[1:]),
+                   "Multiply": lambda vs: float(np.prod(vs)),
+                   "Divide": lambda vs: vs[0] / vs[1],
+                   "Max": max, "Min": min,
+                   "Average": lambda vs: sum(vs) / len(vs)}[op]
+            for r in rows:
+                r.append(float(red([float(r[i]) for i in idxs])))
+            schema.columns.append({"name": new_name, "type": ColumnType.DOUBLE})
+            return rows, schema
+        if kind == "clip":
+            name, lo, hi = arg
+            i = names.index(name)
+            for r in rows:
+                v = float(r[i])
+                r[i] = min(max(v, lo), hi)
+            return rows, schema
+        if kind == "replace_invalid":
+            name, value = arg
+            i = names.index(name)
+            for r in rows:
+                try:
+                    float(r[i])
+                except (TypeError, ValueError):
+                    r[i] = value
+            return rows, schema
+        if kind == "append_str":
+            name, suffix = arg
+            i = names.index(name)
+            for r in rows:
+                r[i] = str(r[i]) + suffix
+            return rows, schema
+        if kind == "change_case":
+            name, case = arg
+            i = names.index(name)
+            for r in rows:
+                r[i] = str(r[i]).upper() if case.upper() == "UPPER" \
+                    else str(r[i]).lower()
+            return rows, schema
+        if kind == "str_map":
+            name, mapping = arg
+            i = names.index(name)
+            for r in rows:
+                r[i] = mapping.get(str(r[i]), r[i])
+            return rows, schema
+        if kind == "rm_ws":
+            (name,) = arg
+            i = names.index(name)
+            for r in rows:
+                r[i] = "".join(str(r[i]).split())
+            return rows, schema
+        if kind == "str_regex":
+            import re as _re
+            name, regex_map = arg
+            i = names.index(name)
+            for r in rows:
+                v = str(r[i])
+                for pat, rep in regex_map.items():
+                    v = _re.sub(pat, rep, v)
+                r[i] = v
+            return rows, schema
+        if kind == "concat_str":
+            new_name, delim, cols = arg
+            idxs = [names.index(n) for n in cols]
+            for r in rows:
+                r.append(delim.join(str(r[i]) for i in idxs))
+            schema.columns.append({"name": new_name, "type": ColumnType.STRING})
+            return rows, schema
+        if kind == "str2time":
+            from datetime import datetime, timezone
+            name, fmt = arg
+            i = names.index(name)
+            for r in rows:
+                dt = datetime.strptime(str(r[i]), fmt)
+                if dt.tzinfo is None:
+                    dt = dt.replace(tzinfo=timezone.utc)
+                r[i] = int(dt.timestamp() * 1000)
+            schema.columns[i] = {"name": name, "type": ColumnType.TIME}
+            return rows, schema
+        if kind == "derive_time":
+            from datetime import datetime, timezone
+            name, fields = arg
+            i = names.index(name)
+            getters = {"hourOfDay": lambda d: d.hour,
+                       "minuteOfHour": lambda d: d.minute,
+                       "secondOfMinute": lambda d: d.second,
+                       "dayOfWeek": lambda d: d.isoweekday(),
+                       "dayOfMonth": lambda d: d.day,
+                       "monthOfYear": lambda d: d.month,
+                       "year": lambda d: d.year}
+            for r in rows:
+                d = datetime.fromtimestamp(int(r[i]) / 1000.0, tz=timezone.utc)
+                r.extend(getters[f](d) for f in fields)
+            for f in fields:
+                schema.columns.append({"name": f"{name}[{f}]",
+                                       "type": ColumnType.INTEGER})
+            return rows, schema
+        if kind == "first_digit":
+            name, new_name = arg
+            i = names.index(name)
+            for r in rows:
+                s = str(abs(float(r[i]))).lstrip("0.")
+                r.append(int(s[0]) if s and s[0].isdigit() else 0)
+            schema.columns.append({"name": new_name, "type": ColumnType.INTEGER})
+            return rows, schema
         raise ValueError(kind)
 
 
