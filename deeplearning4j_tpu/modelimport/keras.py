@@ -82,7 +82,17 @@ class Hdf5Archive:
         out = {}
         for n in names:
             key = n.decode("utf-8") if isinstance(n, bytes) else str(n)
-            base = key.rsplit("/", 1)[-1].split(":")[0]
+            parts = key.split("/")
+            base = parts[-1].split(":")[0]
+            # Bidirectional wrappers store forward_*/backward_* twin path
+            # COMPONENTS whose basenames collide; match components only (a
+            # user layer merely NAMED 'feed_forward' must not be prefixed)
+            if any(p == "backward" or p.startswith("backward_")
+                   for p in parts[:-1]):
+                base = "bwd/" + base
+            elif any(p == "forward" or p.startswith("forward_")
+                     for p in parts[:-1]):
+                base = "fwd/" + base
             out[base] = np.asarray(g[key])
         return out
 
@@ -258,6 +268,164 @@ def _map_simple_rnn(cfg) -> _Imported:
     return _Imported(lay, cfg["name"], _rnn_fill)
 
 
+def _map_gru(cfg) -> _Imported:
+    """Keras GRU: gate order [z, r, h] -> ours [r, z, n]; only the Keras-2
+    default reset_after=True matches gruCell's bias-inside-reset form."""
+    if not cfg.get("reset_after", True):
+        raise KerasImportError(
+            "GRU(reset_after=False) computes tanh(i_n + (r*h)Wn) which "
+            "gruCell does not implement; re-save with reset_after=True")
+    if _act(cfg.get("recurrent_activation", "sigmoid")) != "sigmoid":
+        raise KerasImportError("only sigmoid recurrent_activation GRUs import")
+    if _act(cfg.get("activation", "tanh")) != "tanh":
+        raise KerasImportError("only tanh cell-activation GRUs import")
+    inner = L.GRU(nOut=int(cfg["units"]))
+    lay = inner if cfg.get("return_sequences") else L.LastTimeStep(inner)
+
+    def fill(kw, pre_it):
+        def reorder(m):   # [.., 3H] columns z,r,h -> r,z,h
+            z, r, h = np.split(np.asarray(m), 3, axis=-1)
+            return np.concatenate([r, z, h], axis=-1)
+        W, RW = reorder(kw["kernel"]), reorder(kw["recurrent_kernel"])
+        H3 = W.shape[-1]
+        if "bias" in kw:
+            b = np.asarray(kw["bias"])
+            bi, br = (b[0], b[1]) if b.ndim == 2 else (b, np.zeros_like(b))
+            bi, br = reorder(bi), reorder(br)
+        else:
+            bi = np.zeros(H3, np.float32)
+            br = np.zeros(H3, np.float32)
+        return {"W": jnp.asarray(W), "RW": jnp.asarray(RW),
+                "b": jnp.asarray(bi), "bR": jnp.asarray(br)}, None
+    return _Imported(lay, cfg["name"], fill)
+
+
+def _map_bidirectional(cfg) -> _Imported:
+    entry = cfg["layer"]
+    icls, icfg = entry["class_name"], dict(entry["config"])
+    if icls not in ("LSTM", "GRU", "SimpleRNN"):
+        raise KerasImportError(
+            f"Bidirectional wrapping '{icls}' is not supported")
+    ret_seq = icfg.get("return_sequences", False)
+    fwd = _MAPPERS[icls]({**icfg, "return_sequences": True,
+                          "name": icfg.get("name", cfg["name"])})
+    bwd = _MAPPERS[icls]({**icfg, "return_sequences": True,
+                          "name": icfg.get("name", cfg["name"])})
+    mode = {None: "concat", "concat": "concat", "sum": "add", "mul": "mul",
+            "ave": "average"}.get(cfg.get("merge_mode", "concat"))
+    if mode is None:
+        raise KerasImportError(
+            f"Bidirectional merge_mode '{cfg.get('merge_mode')}' unsupported")
+    # return_sequences=False has KERAS step semantics: fwd last output +
+    # bwd FINAL STATE (position 0) — not LastTimeStep(Bidirectional(...))
+    cls = L.Bidirectional if ret_seq else L.BidirectionalLastStep
+    lay = cls(fwd.layer, mode=mode)
+    lay.bwd = bwd.layer         # independently-weighted backward direction
+
+    def fill(kw, pre_it):
+        fwd_kw = {k[4:]: v for k, v in kw.items() if k.startswith("fwd/")}
+        bwd_kw = {k[4:]: v for k, v in kw.items() if k.startswith("bwd/")}
+        if not fwd_kw or not bwd_kw:
+            raise KerasImportError(
+                "Bidirectional weights missing forward/backward groups")
+        pf, _ = fwd.fill(fwd_kw, pre_it)
+        pb, _ = bwd.fill(bwd_kw, pre_it)
+        return {"fwd": pf, "bwd": pb}, None
+    return _Imported(lay, cfg["name"], fill)
+
+
+def _first(v) -> int:
+    """Keras 1-D hyperparams arrive as [k] or k."""
+    return int(v[0] if isinstance(v, (list, tuple)) else v)
+
+
+def _map_conv1d(cfg) -> _Imported:
+    p = str(cfg.get("padding", "valid")).lower()
+    if p == "causal":
+        mode, pad = "causal", 0
+    else:
+        mode, pad = _conv_mode(p)
+        pad = 0
+    lay = L.Convolution1D(
+        kernelSize=_first(cfg["kernel_size"]),
+        stride=_first(cfg.get("strides", 1)),
+        padding=pad, nOut=int(cfg["filters"]), convolutionMode=mode,
+        dilation=_first(cfg.get("dilation_rate", 1)),
+        hasBias=bool(cfg.get("use_bias", True)),
+        activation=_act(cfg.get("activation")))
+
+    def fill(kw, pre_it):
+        # keras [k, cIn, cOut] -> ours [cOut, cIn, k]
+        params = {"W": jnp.asarray(np.transpose(kw["kernel"], (2, 1, 0)))}
+        if "bias" in kw:
+            params["b"] = jnp.asarray(kw["bias"])
+        return params, None
+    return _Imported(lay, cfg["name"], fill)
+
+
+def _map_separable_conv2d(cfg) -> _Imported:
+    mode, pad = _conv_mode(cfg.get("padding", "valid"))
+    lay = L.SeparableConvolution2D(
+        kernelSize=_pair(cfg["kernel_size"]),
+        stride=_pair(cfg.get("strides", 1)), padding=pad,
+        depthMultiplier=int(cfg.get("depth_multiplier", 1)),
+        nOut=int(cfg["filters"]), convolutionMode=mode,
+        dilation=_pair(cfg.get("dilation_rate", 1)),
+        hasBias=bool(cfg.get("use_bias", True)),
+        activation=_act(cfg.get("activation")))
+
+    def fill(kw, pre_it):
+        # depthwise [kH, kW, cIn, mult] -> [mult, cIn, kH, kW];
+        # pointwise [1, 1, cIn*mult, cOut] -> [cOut, cIn*mult, 1, 1]
+        params = {
+            "Wd": jnp.asarray(kw["depthwise_kernel"].transpose(3, 2, 0, 1)),
+            "Wp": jnp.asarray(kw["pointwise_kernel"].transpose(3, 2, 0, 1)),
+        }
+        if "bias" in kw:
+            params["b"] = jnp.asarray(kw["bias"])
+        return params, None
+    return _Imported(lay, cfg["name"], fill)
+
+
+def _norm_2d_spec(v):
+    """Keras ((t, b), (l, r)) | (h, w) | int -> our layer's spec."""
+    if isinstance(v, int):
+        return (v, v)
+    v = list(v)
+    if all(isinstance(x, int) for x in v):
+        return tuple(v)
+    return tuple(tuple(x) for x in v)
+
+
+def _map_zero_padding2d(cfg) -> _Imported:
+    return _Imported(
+        L.ZeroPaddingLayer(padding=_norm_2d_spec(cfg.get("padding", 1))),
+        cfg["name"])
+
+
+def _map_cropping2d(cfg) -> _Imported:
+    return _Imported(
+        L.Cropping2D(crop=_norm_2d_spec(cfg.get("cropping", 1))), cfg["name"])
+
+
+def _map_upsampling2d(cfg) -> _Imported:
+    if str(cfg.get("interpolation", "nearest")) != "nearest":
+        raise KerasImportError("only nearest-neighbour UpSampling2D imports")
+    return _Imported(L.Upsampling2D(size=_pair(cfg.get("size", 2))),
+                     cfg["name"])
+
+
+def _map_leaky_relu(cfg) -> _Imported:
+    # any fixed slope maps exactly onto PReLULayer with constant alpha
+    alpha = float(cfg.get("alpha", cfg.get("negative_slope", 0.3)))
+    lay = L.PReLULayer()
+
+    def fill(kw, pre_it):
+        n = pre_it.arrayElementsPerExample() if pre_it is not None else 1
+        return {"alpha": jnp.full((n,), alpha, jnp.float32)}, None
+    return _Imported(lay, cfg["name"], fill)
+
+
 def _map_activation(cfg) -> _Imported:
     return _Imported(L.ActivationLayer(_act(cfg.get("activation"))), cfg["name"])
 
@@ -274,17 +442,27 @@ _SKIP = {"InputLayer", "Flatten", "Reshape"}  # handled by preprocessors
 
 _MAPPERS = {
     "Dense": _map_dense,
+    "Conv1D": _map_conv1d,
     "Conv2D": _map_conv2d,
     "DepthwiseConv2D": _map_depthwise_conv2d,
+    "SeparableConv2D": _map_separable_conv2d,
     "MaxPooling2D": lambda c: _map_pool2d(c, "max"),
     "AveragePooling2D": lambda c: _map_pool2d(c, "avg"),
     "GlobalMaxPooling2D": lambda c: _map_global_pool(c, "max"),
     "GlobalAveragePooling2D": lambda c: _map_global_pool(c, "avg"),
+    "GlobalMaxPooling1D": lambda c: _map_global_pool(c, "max"),
+    "GlobalAveragePooling1D": lambda c: _map_global_pool(c, "avg"),
+    "ZeroPadding2D": _map_zero_padding2d,
+    "Cropping2D": _map_cropping2d,
+    "UpSampling2D": _map_upsampling2d,
     "BatchNormalization": _map_batchnorm,
     "Embedding": _map_embedding,
     "LSTM": _map_lstm,
+    "GRU": _map_gru,
     "SimpleRNN": _map_simple_rnn,
+    "Bidirectional": _map_bidirectional,
     "Activation": _map_activation,
+    "LeakyReLU": _map_leaky_relu,
     "Dropout": _map_dropout,
     "SpatialDropout2D": _map_dropout,
 }
@@ -429,12 +607,7 @@ class KerasModelImport:
                 pre_it = types.get(src, input_types.get(src))
                 params, state = imp.fill(kw, pre_it)
                 target = net._params[imp.kname]
-                for k, v in params.items():
-                    if k in target and tuple(target[k].shape) != tuple(v.shape):
-                        raise KerasImportError(
-                            f"layer {imp.kname} param {k}: shape "
-                            f"{tuple(v.shape)} from h5 vs expected "
-                            f"{tuple(target[k].shape)}")
+                _check_shapes(target, params, f"layer {imp.kname}")
                 net._params[imp.kname] = {**target, **params}
                 if state:
                     net._states[imp.kname] = {**net._states[imp.kname], **state}
@@ -509,16 +682,24 @@ def _pre_preprocessor_types(conf, input_type: InputType) -> List[InputType]:
     return out
 
 
+def _check_shapes(target: Dict, holder: Dict, where: str):
+    """Recursive shape validation (Bidirectional nests {'fwd':..,'bwd':..})."""
+    for k, v in holder.items():
+        if k not in target:
+            continue
+        if isinstance(v, dict):
+            _check_shapes(target[k], v, f"{where}.{k}")
+        elif tuple(target[k].shape) != tuple(v.shape):
+            raise KerasImportError(
+                f"{where} param {k}: shape {tuple(v.shape)} from h5 vs "
+                f"expected {tuple(target[k].shape)}")
+
+
 def _assign(net: MultiLayerNetwork, idx: int, layer, params: Dict, state):
     """Install imported tensors, validating shapes against the initialized net."""
     target = net._params[idx]
-    holder = params
-    for k, v in holder.items():
-        if k in target and tuple(target[k].shape) != tuple(v.shape):
-            raise KerasImportError(
-                f"layer {idx} param {k}: shape {tuple(v.shape)} from h5 vs "
-                f"expected {tuple(target[k].shape)}")
-    net._params[idx] = {**target, **holder}
+    _check_shapes(target, params, f"layer {idx}")
+    net._params[idx] = {**target, **params}
     if state:
         net._states[idx] = {**net._states[idx], **state}
 

@@ -1,0 +1,487 @@
+"""ONNX model import into the SameDiff graph engine.
+
+Reference parity: ``nd4j/samediff-import/samediff-import-onnx`` —
+``OnnxFrameworkImporter.runImport`` maps an ONNX GraphProto node-by-node
+into SameDiff via the op mapping registry (SURVEY.md §2.2 "TF/ONNX
+import"). Same architecture as :mod:`.tensorflow`: each ONNX op maps
+through a builder ``_BUILDERS[op](params) -> fn`` with JSON-able params,
+records as a namespaced ``onnx.<Op>`` node with ``rebuild="onnx"`` (so
+imported graphs serialize through ``SameDiff.save()``), and const-folds
+shape arithmetic over initializers.
+
+Proto parsing is :mod:`.onnx_proto` (no onnx package in this image);
+semantics follow opset 13+ (Softmax axis-wise, Squeeze/Unsqueeze axes as
+inputs accepted as attrs too).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.autodiff import samediff as _sdmod
+from deeplearning4j_tpu.autodiff.samediff import SameDiff
+from deeplearning4j_tpu.modelimport import onnx_proto as op_
+from deeplearning4j_tpu.modelimport.onnx_proto import ModelProto, NodeProto
+
+
+class OnnxImportError(ValueError):
+    pass
+
+
+_FOLD_LIMIT = 1 << 20
+
+# ------------------------------------------------------------------ builders
+
+_BUILDERS: Dict[str, Callable[[dict], Callable]] = {}
+
+
+def _simple(op: str, fn: Callable):
+    _BUILDERS[op] = lambda p, _f=fn: _f
+
+
+_SIMPLE_OPS = {
+    "Add": lambda a, b: a + b,
+    "Sub": lambda a, b: a - b,
+    "Mul": lambda a, b: a * b,
+    "Div": lambda a, b: a / b,
+    "Pow": jnp.power,
+    "Max": jnp.maximum,
+    "Min": jnp.minimum,
+    "Neg": jnp.negative,
+    "Abs": jnp.abs,
+    "Exp": jnp.exp,
+    "Log": jnp.log,
+    "Sqrt": jnp.sqrt,
+    "Reciprocal": jnp.reciprocal,
+    "Floor": jnp.floor,
+    "Ceil": jnp.ceil,
+    "Round": jnp.round,
+    "Sign": jnp.sign,
+    "Relu": jax.nn.relu,
+    "Sigmoid": jax.nn.sigmoid,
+    "Tanh": jnp.tanh,
+    "Erf": jax.lax.erf,
+    "Softplus": jax.nn.softplus,
+    "Softsign": jax.nn.soft_sign,
+    "Selu": jax.nn.selu,
+    "Identity": lambda x: x,
+    "MatMul": jnp.matmul,
+    "Sin": jnp.sin,
+    "Cos": jnp.cos,
+    "Where": lambda c, a, b: jnp.where(c, a, b),
+    "Equal": lambda a, b: a == b,
+    "Greater": lambda a, b: a > b,
+    "GreaterOrEqual": lambda a, b: a >= b,
+    "Less": lambda a, b: a < b,
+    "LessOrEqual": lambda a, b: a <= b,
+    "Not": jnp.logical_not,
+    "And": jnp.logical_and,
+    "Or": jnp.logical_or,
+    "GlobalAveragePool": lambda x: jnp.mean(x, axis=tuple(range(2, x.ndim)),
+                                            keepdims=True),
+    "GlobalMaxPool": lambda x: jnp.max(x, axis=tuple(range(2, x.ndim)),
+                                       keepdims=True),
+    "Shape": lambda x: jnp.asarray(jnp.shape(x), jnp.int64),
+    "Size": lambda x: jnp.asarray(jnp.size(x), jnp.int64),
+}
+for _op, _fn in _SIMPLE_OPS.items():
+    _simple(_op, _fn)
+
+
+def _b(op):
+    def deco(fn):
+        _BUILDERS[op] = fn
+        return fn
+    return deco
+
+
+@_b("Gemm")
+def _b_gemm(p):
+    alpha, beta = p.get("alpha", 1.0), p.get("beta", 1.0)
+    ta, tb = p.get("transA", 0), p.get("transB", 0)
+    def fn(a, b, c=None):
+        a = a.T if ta else a
+        b = b.T if tb else b
+        y = alpha * (a @ b)
+        if c is not None:
+            y = y + beta * c
+        return y
+    return fn
+
+
+@_b("Softmax")
+def _b_softmax(p):
+    axis = p.get("axis", -1)
+    return lambda x: jax.nn.softmax(x, axis=axis)
+
+
+@_b("LogSoftmax")
+def _b_logsoftmax(p):
+    axis = p.get("axis", -1)
+    return lambda x: jax.nn.log_softmax(x, axis=axis)
+
+
+@_b("LeakyRelu")
+def _b_leaky(p):
+    alpha = p.get("alpha", 0.01)
+    return lambda x: jnp.where(x >= 0, x, alpha * x)
+
+
+@_b("Elu")
+def _b_elu(p):
+    alpha = p.get("alpha", 1.0)
+    return lambda x: jnp.where(x >= 0, x, alpha * (jnp.exp(x) - 1.0))
+
+
+@_b("HardSigmoid")
+def _b_hardsigmoid(p):
+    a, b = p.get("alpha", 0.2), p.get("beta", 0.5)
+    return lambda x: jnp.clip(a * x + b, 0.0, 1.0)
+
+
+@_b("Gelu")
+def _b_gelu(p):
+    approx = p.get("approximate", "none")
+    if isinstance(approx, bytes):
+        approx = approx.decode()
+    return lambda x: jax.nn.gelu(x, approximate=(approx == "tanh"))
+
+
+@_b("Clip")
+def _b_clip(p):
+    lo = p.get("min")
+    hi = p.get("max")
+    def fn(x, *mm):
+        lo_v = mm[0] if len(mm) > 0 else lo
+        hi_v = mm[1] if len(mm) > 1 else hi
+        return jnp.clip(x, lo_v, hi_v)
+    return fn
+
+
+@_b("Transpose")
+def _b_transpose(p):
+    perm = p.get("perm")
+    return lambda x: jnp.transpose(x, tuple(perm) if perm else None)
+
+
+@_b("Reshape")
+def _b_reshape(p):
+    shape = tuple(p["shape"])
+    return lambda x: jnp.reshape(x, shape)
+
+
+@_b("Flatten")
+def _b_flatten(p):
+    axis = p.get("axis", 1)
+    def fn(x):
+        lead = int(np.prod(x.shape[:axis])) if axis else 1
+        return jnp.reshape(x, (lead, -1))
+    return fn
+
+
+@_b("Concat")
+def _b_concat(p):
+    axis = p["axis"]
+    return lambda *xs: jnp.concatenate(xs, axis=axis)
+
+
+@_b("Squeeze")
+def _b_squeeze(p):
+    axes = p.get("axes")
+    return lambda x: jnp.squeeze(x, axis=tuple(axes) if axes else None)
+
+
+@_b("Unsqueeze")
+def _b_unsqueeze(p):
+    axes = sorted(p["axes"])
+    def fn(x):
+        for a in axes:
+            x = jnp.expand_dims(x, a)
+        return x
+    return fn
+
+
+@_b("Gather")
+def _b_gather(p):
+    axis = p.get("axis", 0)
+    return lambda x, idx: jnp.take(x, idx.astype(jnp.int32), axis=axis)
+
+
+@_b("Slice")
+def _b_slice(p):
+    starts, ends = list(p["starts"]), list(p["ends"])
+    axes = list(p.get("axes") or range(len(starts)))
+    steps = list(p.get("steps") or [1] * len(starts))
+    def fn(x):
+        idx = [slice(None)] * x.ndim
+        for s, e, a, st in zip(starts, ends, axes, steps):
+            # ONNX uses INT64_MAX-ish sentinels for "to the end"
+            e_ = None if e >= (1 << 31) else e
+            s_ = None if (st > 0 and s == 0) else s
+            idx[a] = slice(s_, e_, st)
+        return x[tuple(idx)]
+    return fn
+
+
+@_b("Cast")
+def _b_cast(p):
+    dt = op_.np_dtype(p["to"])
+    return lambda x: x.astype(dt)
+
+
+def _b_reduce(jfn):
+    def build(p):
+        axes = p.get("axes")
+        keep = bool(p.get("keepdims", 1))
+        ax = tuple(axes) if axes else None
+        return lambda x: jfn(x, axis=ax, keepdims=keep)
+    return build
+
+
+for _op, _jfn in [("ReduceMean", jnp.mean), ("ReduceSum", jnp.sum),
+                  ("ReduceMax", jnp.max), ("ReduceMin", jnp.min),
+                  ("ReduceProd", jnp.prod)]:
+    _BUILDERS[_op] = _b_reduce(_jfn)
+
+
+@_b("Conv")
+def _b_conv(p):
+    strides = tuple(p.get("strides") or (1, 1))
+    dil = tuple(p.get("dilations") or (1, 1))
+    group = p.get("group", 1)
+    pads = p.get("pads")
+    auto = p.get("auto_pad", "NOTSET")
+    if isinstance(auto, bytes):
+        auto = auto.decode()
+    if auto in ("SAME_UPPER", "SAME_LOWER"):
+        padding = "SAME"
+    else:
+        pads = pads or [0] * (2 * len(strides))
+        n = len(pads) // 2
+        padding = [(pads[i], pads[i + n]) for i in range(n)]
+    def fn(x, w, b=None):
+        nd = w.ndim - 2
+        dn = ("NCHW", "OIHW", "NCHW") if nd == 2 else ("NCW", "OIW", "NCW")
+        out = jax.lax.conv_general_dilated(
+            x, w, strides[:nd], padding, rhs_dilation=dil[:nd],
+            dimension_numbers=dn, feature_group_count=group)
+        if b is not None:
+            out = out + b.reshape((1, -1) + (1,) * nd)
+        return out
+    return fn
+
+
+def _b_pool(max_pool: bool):
+    def build(p):
+        ks = tuple(p["kernel_shape"])
+        strides = tuple(p.get("strides") or ks)
+        pads = p.get("pads") or [0] * (2 * len(ks))
+        n = len(ks)
+        pad = [(0, 0), (0, 0)] + [(pads[i], pads[i + n]) for i in range(n)]
+        count_include_pad = bool(p.get("count_include_pad", 0))
+        def fn(x):
+            dims = (1, 1) + ks
+            strd = (1, 1) + strides
+            if max_pool:
+                return jax.lax.reduce_window(x, -jnp.inf, jax.lax.max,
+                                             dims, strd, pad)
+            s = jax.lax.reduce_window(x, 0.0, jax.lax.add, dims, strd, pad)
+            if count_include_pad:
+                return s / float(np.prod(ks))
+            cnt = jax.lax.reduce_window(jnp.ones_like(x), 0.0, jax.lax.add,
+                                        dims, strd, pad)
+            return s / cnt
+        return fn
+    return build
+
+
+_BUILDERS["MaxPool"] = _b_pool(True)
+_BUILDERS["AveragePool"] = _b_pool(False)
+
+
+@_b("BatchNormalization")
+def _b_batchnorm(p):
+    eps = p.get("epsilon", 1e-5)
+    def fn(x, gamma, beta, mean, var):
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+        inv = gamma * jax.lax.rsqrt(var + eps)
+        return x * inv.reshape(shape) + (beta - mean * inv).reshape(shape)
+    return fn
+
+
+@_b("Pad")
+def _b_pad(p):
+    pads = list(p["pads"])
+    mode = p.get("mode", "constant")
+    if isinstance(mode, bytes):
+        mode = mode.decode()
+    value = p.get("value", 0.0)
+    n = len(pads) // 2
+    widths = [(pads[i], pads[i + n]) for i in range(n)]
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "edge": "edge"}[mode]
+    def fn(x):
+        if jmode == "constant":
+            return jnp.pad(x, widths, constant_values=value)
+        return jnp.pad(x, widths, mode=jmode)
+    return fn
+
+
+@_b("Expand")
+def _b_expand(p):
+    shape = tuple(p["shape"])
+    return lambda x: jnp.broadcast_to(x, jnp.broadcast_shapes(x.shape, shape))
+
+
+@_b("Split")
+def _b_split(p):
+    axis = p.get("axis", 0)
+    sizes = p.get("split")
+    n = p["n_out"]
+    def fn(x):
+        if sizes:
+            idx = np.cumsum(list(sizes))[:-1].tolist()
+            return tuple(jnp.split(x, idx, axis=axis))
+        return tuple(jnp.split(x, n, axis=axis))
+    return fn
+
+
+@_b("Dropout")
+def _b_dropout(p):
+    return lambda x, *rest: x          # inference import
+
+
+def _onnx_rebuild(attrs: dict) -> Callable:
+    fn = _BUILDERS[attrs["onnx_op"]](dict(attrs.get("params") or {}))
+    return lambda *a, **kw: fn(*a)
+
+
+_sdmod._FN_REBUILDERS["onnx"] = _onnx_rebuild
+
+
+# ------------------------------------------------------------------ importer
+
+# inputs that must be compile-time constants, per op: (input_idx, param_key,
+# transform). Consumed into params and dropped from the node's data inputs.
+_CONST_INPUTS = {
+    "Reshape": [(1, "shape", lambda a: [int(v) for v in a])],
+    "Expand": [(1, "shape", lambda a: [int(v) for v in a])],
+    "Squeeze": [(1, "axes", lambda a: [int(v) for v in a])],
+    "Unsqueeze": [(1, "axes", lambda a: [int(v) for v in a])],
+    "Slice": [(1, "starts", lambda a: [int(v) for v in a]),
+              (2, "ends", lambda a: [int(v) for v in a]),
+              (3, "axes", lambda a: [int(v) for v in a]),
+              (4, "steps", lambda a: [int(v) for v in a])],
+    "Pad": [(1, "pads", lambda a: [int(v) for v in a]),
+            (2, "value", lambda a: float(np.asarray(a).reshape(()))),
+            ],
+    "ReduceSum": [(1, "axes", lambda a: [int(v) for v in a])],
+    "ReduceMean": [(1, "axes", lambda a: [int(v) for v in a])],
+    "Split": [(1, "split", lambda a: [int(v) for v in a])],
+}
+
+
+class OnnxGraphImport:
+    """ref: OnnxFrameworkImporter (samediff-import-onnx)."""
+
+    @staticmethod
+    def importOnnxModel(src) -> SameDiff:
+        """.onnx path / bytes / parsed ModelProto -> SameDiff."""
+        model = src if isinstance(src, ModelProto) else op_.load_model(src)
+        g = model.graph
+        if g is None:
+            raise OnnxImportError("model has no graph")
+        sd = SameDiff.create()
+        consts: Dict[str, np.ndarray] = {}
+        for t in g.initializers:
+            consts[t.name] = t.array
+            sd.constant(t.array, name=t.name)
+        init_names = set(consts)
+        for vi in g.inputs:
+            if vi.name in init_names:
+                continue
+            shape = tuple(vi.shape) if vi.shape else None
+            sd.placeHolder(vi.name, shape=shape,
+                           dtype=op_.np_dtype(vi.elem_type))
+        for node in g.nodes:
+            _import_node(sd, consts, node)
+        return sd
+
+
+def _import_node(sd: SameDiff, consts: Dict[str, np.ndarray], node: NodeProto):
+    op = node.op_type
+    if op == "Constant":
+        t = node.attr("value")
+        if t is None:
+            raise OnnxImportError(f"Constant '{node.name}' without tensor")
+        consts[node.outputs[0]] = t.array
+        sd.constant(t.array, name=node.outputs[0])
+        return
+    if op not in _BUILDERS:
+        raise OnnxImportError(
+            f"unmapped ONNX op '{op}' (node '{node.name}') — add a builder "
+            f"to modelimport.onnx._BUILDERS")
+
+    params = {a.name: _attr_value(a) for a in node.attrs.values()}
+    ins = [i for i in node.inputs if i]      # "" = absent optional input
+    # consume const-only inputs into params
+    for idx, key, conv in _CONST_INPUTS.get(op, []):
+        if idx < len(node.inputs) and node.inputs[idx]:
+            name = node.inputs[idx]
+            if name not in consts:
+                raise OnnxImportError(
+                    f"{op} input '{name}' must be a constant/initializer "
+                    f"(static shapes under XLA)")
+            params[key] = conv(consts[name])
+            ins = [i for i in ins if i != name]
+    n_out = len([o for o in node.outputs if o])
+    if op == "Dropout":
+        n_out = 1                            # optional mask output unused
+    if op == "Split":
+        params["n_out"] = n_out
+
+    fn = _BUILDERS[op](params)
+
+    # const folding (shape arithmetic over initializers)
+    if ins and all(i in consts for i in ins) and \
+            sum(consts[i].size for i in ins) <= _FOLD_LIMIT:
+        try:
+            res = fn(*[consts[i] for i in ins])
+            outs = res if n_out > 1 else (res,)
+            total = sum(int(np.asarray(r).size) for r in outs)
+            if total <= _FOLD_LIMIT:
+                for name, r in zip(node.outputs, outs):
+                    arr = np.asarray(r)
+                    consts[name] = arr
+                    sd.constant(arr, name=name)
+                return
+        except Exception:
+            pass                              # fall through to runtime node
+
+    wrapped = (lambda _f: lambda *a, **kw: _f(*a))(fn)
+    out = sd._record_fn(f"onnx.{op}", wrapped, ins, name=node.outputs[0],
+                        n_out=n_out, rebuild="onnx",
+                        attrs={"onnx_op": op, "params": params})
+    if n_out > 1:
+        # _record_fn names outputs '<base>:i'; align with the graph's names
+        for i, oname in enumerate(node.outputs[:n_out]):
+            cur = f"{node.outputs[0]}:{i}"
+            if cur != oname:
+                sd._rename(cur, oname)
+
+
+def _attr_value(a):
+    v = a.value
+    if isinstance(v, bytes):
+        return v.decode("utf-8", "replace")
+    if hasattr(v, "array"):                  # TensorProto attr
+        arr = np.asarray(v.array)
+        return arr.tolist() if arr.size < 64 else arr
+    return v
+
+
+importOnnxModel = OnnxGraphImport.importOnnxModel

@@ -1,0 +1,447 @@
+"""Minimal ONNX protobuf wire-format codec (decode + encode).
+
+Reference parity: ``nd4j/samediff-import/samediff-import-onnx`` parses
+ONNX ModelProtos via the generated protobuf classes (SURVEY.md §2.2).
+This environment has no ``onnx`` package, so the subset of the (public,
+stable) ``onnx.proto3`` schema needed for inference-graph import is
+decoded directly from the protobuf wire format: ModelProto, GraphProto,
+NodeProto, AttributeProto, TensorProto, ValueInfoProto.
+
+The encoder exists so tests can CONSTRUCT well-formed .onnx files without
+the onnx package; the wire format is standard protobuf, so files written
+by real exporters decode identically.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+# ONNX TensorProto.DataType values (public enum)
+DT_FLOAT, DT_UINT8, DT_INT8, DT_UINT16, DT_INT16 = 1, 2, 3, 4, 5
+DT_INT32, DT_INT64, DT_STRING, DT_BOOL, DT_FLOAT16 = 6, 7, 8, 9, 10
+DT_DOUBLE, DT_UINT32, DT_UINT64 = 11, 12, 13
+DT_BFLOAT16 = 16
+
+_NP_OF = {DT_FLOAT: np.float32, DT_UINT8: np.uint8, DT_INT8: np.int8,
+          DT_UINT16: np.uint16, DT_INT16: np.int16, DT_INT32: np.int32,
+          DT_INT64: np.int64, DT_BOOL: np.bool_, DT_FLOAT16: np.float16,
+          DT_DOUBLE: np.float64, DT_UINT32: np.uint32, DT_UINT64: np.uint64}
+_DT_OF = {np.dtype(v): k for k, v in _NP_OF.items()}
+
+
+def np_dtype(data_type: int):
+    if data_type == DT_BFLOAT16:
+        import ml_dtypes
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(_NP_OF[data_type])
+
+
+def onnx_dtype(dt) -> int:
+    dt = np.dtype(dt)
+    if dt.name == "bfloat16":
+        return DT_BFLOAT16
+    return _DT_OF[dt]
+
+
+# ----------------------------------------------------------------- decoding
+
+def _read_varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _fields(buf: bytes):
+    """Yield (field_number, wire_type, value) over a message's bytes."""
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        tag, pos = _read_varint(buf, pos)
+        fnum, wt = tag >> 3, tag & 7
+        if wt == 0:
+            v, pos = _read_varint(buf, pos)
+        elif wt == 1:
+            v = buf[pos:pos + 8]
+            pos += 8
+        elif wt == 2:
+            ln, pos = _read_varint(buf, pos)
+            v = buf[pos:pos + ln]
+            pos += ln
+        elif wt == 5:
+            v = buf[pos:pos + 4]
+            pos += 4
+        else:
+            raise ValueError(f"unsupported wire type {wt}")
+        yield fnum, wt, v
+
+
+def _s64(v: int) -> int:
+    """varint -> signed int64 (two's complement)."""
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+@dataclass
+class TensorProto:
+    name: str = ""
+    data_type: int = DT_FLOAT
+    dims: List[int] = field(default_factory=list)
+    array: Optional[np.ndarray] = None
+
+    @staticmethod
+    def parse(buf: bytes) -> "TensorProto":
+        t = TensorProto()
+        float_data: List[float] = []
+        int_data: List[int] = []
+        raw = b""
+        for fnum, wt, v in _fields(buf):
+            if fnum == 1:           # dims (int64, may be packed)
+                if wt == 0:
+                    t.dims.append(_s64(v))
+                else:
+                    p = 0
+                    while p < len(v):
+                        d, p = _read_varint(v, p)
+                        t.dims.append(_s64(d))
+            elif fnum == 2 and wt == 0:
+                t.data_type = v
+            elif fnum == 4:         # float_data (packed floats)
+                if wt == 5:
+                    float_data.append(struct.unpack("<f", v)[0])
+                else:
+                    float_data.extend(
+                        struct.unpack(f"<{len(v) // 4}f", v))
+            elif fnum in (5, 7, 11):  # int32/int64/uint64_data
+                if wt == 0:
+                    int_data.append(_s64(v))
+                else:
+                    p = 0
+                    while p < len(v):
+                        d, p = _read_varint(v, p)
+                        int_data.append(_s64(d))
+            elif fnum == 8 and wt == 2:
+                t.name = v.decode("utf-8")
+            elif fnum == 9 and wt == 2:
+                raw = v
+            elif fnum == 10:        # double_data
+                if wt == 1:
+                    float_data.append(struct.unpack("<d", v)[0])
+                else:
+                    float_data.extend(struct.unpack(f"<{len(v) // 8}d", v))
+        dt = np_dtype(t.data_type)
+        shape = tuple(t.dims)
+        if raw:
+            t.array = np.frombuffer(raw, dtype=dt).reshape(shape)
+        elif float_data:
+            t.array = np.asarray(float_data, dt).reshape(shape)
+        elif int_data:
+            t.array = np.asarray(int_data, dt).reshape(shape)
+        else:
+            t.array = np.zeros(shape, dt)
+        return t
+
+
+@dataclass
+class AttributeProto:
+    name: str = ""
+    f: Optional[float] = None
+    i: Optional[int] = None
+    s: Optional[bytes] = None
+    t: Optional[TensorProto] = None
+    floats: List[float] = field(default_factory=list)
+    ints: List[int] = field(default_factory=list)
+    strings: List[bytes] = field(default_factory=list)
+
+    @property
+    def value(self):
+        for v in (self.i, self.f, self.s, self.t):
+            if v is not None:
+                return v
+        if self.ints:
+            return self.ints
+        if self.floats:
+            return self.floats
+        if self.strings:
+            return self.strings
+        return None
+
+    @staticmethod
+    def parse(buf: bytes) -> "AttributeProto":
+        a = AttributeProto()
+        for fnum, wt, v in _fields(buf):
+            if fnum == 1 and wt == 2:
+                a.name = v.decode("utf-8")
+            elif fnum == 2 and wt == 5:
+                a.f = struct.unpack("<f", v)[0]
+            elif fnum == 3 and wt == 0:
+                a.i = _s64(v)
+            elif fnum == 4 and wt == 2:
+                a.s = v
+            elif fnum == 5 and wt == 2:
+                a.t = TensorProto.parse(v)
+            elif fnum == 7:
+                if wt == 5:
+                    a.floats.append(struct.unpack("<f", v)[0])
+                else:
+                    a.floats.extend(struct.unpack(f"<{len(v) // 4}f", v))
+            elif fnum == 8:
+                if wt == 0:
+                    a.ints.append(_s64(v))
+                else:
+                    p = 0
+                    while p < len(v):
+                        d, p = _read_varint(v, p)
+                        a.ints.append(_s64(d))
+            elif fnum == 9 and wt == 2:
+                a.strings.append(v)
+        return a
+
+
+@dataclass
+class NodeProto:
+    op_type: str = ""
+    name: str = ""
+    inputs: List[str] = field(default_factory=list)
+    outputs: List[str] = field(default_factory=list)
+    attrs: Dict[str, AttributeProto] = field(default_factory=dict)
+
+    @staticmethod
+    def parse(buf: bytes) -> "NodeProto":
+        n = NodeProto()
+        for fnum, wt, v in _fields(buf):
+            if fnum == 1 and wt == 2:
+                n.inputs.append(v.decode("utf-8"))
+            elif fnum == 2 and wt == 2:
+                n.outputs.append(v.decode("utf-8"))
+            elif fnum == 3 and wt == 2:
+                n.name = v.decode("utf-8")
+            elif fnum == 4 and wt == 2:
+                n.op_type = v.decode("utf-8")
+            elif fnum == 5 and wt == 2:
+                a = AttributeProto.parse(v)
+                n.attrs[a.name] = a
+        return n
+
+    def attr(self, name, default=None):
+        a = self.attrs.get(name)
+        return default if a is None else a.value
+
+
+@dataclass
+class ValueInfoProto:
+    name: str = ""
+    elem_type: int = DT_FLOAT
+    shape: List[Optional[int]] = field(default_factory=list)
+
+    @staticmethod
+    def parse(buf: bytes) -> "ValueInfoProto":
+        vi = ValueInfoProto()
+        for fnum, wt, v in _fields(buf):
+            if fnum == 1 and wt == 2:
+                vi.name = v.decode("utf-8")
+            elif fnum == 2 and wt == 2:      # TypeProto
+                for f2, w2, v2 in _fields(v):
+                    if f2 == 1 and w2 == 2:  # tensor_type
+                        for f3, w3, v3 in _fields(v2):
+                            if f3 == 1 and w3 == 0:
+                                vi.elem_type = v3
+                            elif f3 == 2 and w3 == 2:  # shape
+                                for f4, w4, v4 in _fields(v3):
+                                    if f4 == 1 and w4 == 2:  # dim
+                                        dim = None
+                                        for f5, w5, v5 in _fields(v4):
+                                            if f5 == 1 and w5 == 0:
+                                                dim = _s64(v5)
+                                        vi.shape.append(dim)
+        return vi
+
+
+@dataclass
+class GraphProto:
+    name: str = ""
+    nodes: List[NodeProto] = field(default_factory=list)
+    initializers: List[TensorProto] = field(default_factory=list)
+    inputs: List[ValueInfoProto] = field(default_factory=list)
+    outputs: List[ValueInfoProto] = field(default_factory=list)
+
+    @staticmethod
+    def parse(buf: bytes) -> "GraphProto":
+        g = GraphProto()
+        for fnum, wt, v in _fields(buf):
+            if fnum == 1 and wt == 2:
+                g.nodes.append(NodeProto.parse(v))
+            elif fnum == 2 and wt == 2:
+                g.name = v.decode("utf-8")
+            elif fnum == 5 and wt == 2:
+                g.initializers.append(TensorProto.parse(v))
+            elif fnum == 11 and wt == 2:
+                g.inputs.append(ValueInfoProto.parse(v))
+            elif fnum == 12 and wt == 2:
+                g.outputs.append(ValueInfoProto.parse(v))
+        return g
+
+
+@dataclass
+class ModelProto:
+    ir_version: int = 8
+    opset_version: int = 17
+    graph: Optional[GraphProto] = None
+
+    @staticmethod
+    def parse(buf: bytes) -> "ModelProto":
+        m = ModelProto()
+        for fnum, wt, v in _fields(buf):
+            if fnum == 1 and wt == 0:
+                m.ir_version = v
+            elif fnum == 7 and wt == 2:
+                m.graph = GraphProto.parse(v)
+            elif fnum == 8 and wt == 2:      # opset_import
+                for f2, w2, v2 in _fields(v):
+                    if f2 == 2 and w2 == 0:
+                        m.opset_version = v2
+        return m
+
+
+def load_model(path_or_bytes) -> ModelProto:
+    if isinstance(path_or_bytes, bytes):
+        return ModelProto.parse(path_or_bytes)
+    with open(path_or_bytes, "rb") as f:
+        return ModelProto.parse(f.read())
+
+
+# ----------------------------------------------------------------- encoding
+# (for tests/tools: build .onnx files without the onnx package)
+
+def _w_varint(out: bytearray, v: int):
+    v &= (1 << 64) - 1
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return
+
+
+def _w_tag(out: bytearray, fnum: int, wt: int):
+    _w_varint(out, (fnum << 3) | wt)
+
+
+def _w_bytes(out: bytearray, fnum: int, data: bytes):
+    _w_tag(out, fnum, 2)
+    _w_varint(out, len(data))
+    out.extend(data)
+
+
+def _w_str(out, fnum, s: str):
+    _w_bytes(out, fnum, s.encode("utf-8"))
+
+
+def _w_int(out, fnum, v: int):
+    _w_tag(out, fnum, 0)
+    _w_varint(out, v)
+
+
+def encode_tensor(name: str, arr: np.ndarray) -> bytes:
+    out = bytearray()
+    for d in arr.shape:
+        _w_int(out, 1, d)
+    _w_int(out, 2, onnx_dtype(arr.dtype))
+    _w_str(out, 8, name)
+    _w_bytes(out, 9, np.ascontiguousarray(arr).tobytes())
+    return bytes(out)
+
+
+def encode_attr(name: str, value) -> bytes:
+    out = bytearray()
+    _w_str(out, 1, name)
+    if isinstance(value, bool) or isinstance(value, (int, np.integer)):
+        _w_int(out, 3, int(value))
+        _w_int(out, 20, 2)       # type = INT
+    elif isinstance(value, float):
+        _w_tag(out, 2, 5)
+        out.extend(struct.pack("<f", value))
+        _w_int(out, 20, 1)       # FLOAT
+    elif isinstance(value, str):
+        _w_bytes(out, 4, value.encode())
+        _w_int(out, 20, 3)       # STRING
+    elif isinstance(value, np.ndarray):
+        _w_bytes(out, 5, encode_tensor("", value))
+        _w_int(out, 20, 4)       # TENSOR
+    elif isinstance(value, (list, tuple)) and value and \
+            isinstance(value[0], float):
+        for f in value:
+            _w_tag(out, 7, 5)
+            out.extend(struct.pack("<f", f))
+        _w_int(out, 20, 6)       # FLOATS
+    elif isinstance(value, (list, tuple)):
+        for i in value:
+            _w_int(out, 8, int(i))
+        _w_int(out, 20, 7)       # INTS
+    else:
+        raise TypeError(f"attr {name}: {type(value)}")
+    return bytes(out)
+
+
+def encode_node(op_type: str, inputs, outputs, name: str = "", **attrs) -> bytes:
+    out = bytearray()
+    for i in inputs:
+        _w_str(out, 1, i)
+    for o in outputs:
+        _w_str(out, 2, o)
+    _w_str(out, 3, name or f"{op_type}_{outputs[0]}")
+    _w_str(out, 4, op_type)
+    for k, v in attrs.items():
+        _w_bytes(out, 5, encode_attr(k, v))
+    return bytes(out)
+
+
+def encode_value_info(name: str, dtype, shape) -> bytes:
+    shp = bytearray()
+    for d in (shape or ()):
+        dim = bytearray()
+        if d is not None:
+            _w_int(dim, 1, d)
+        _w_bytes(shp, 1, bytes(dim))
+    tt = bytearray()
+    _w_int(tt, 1, onnx_dtype(dtype))
+    _w_bytes(tt, 2, bytes(shp))
+    tp = bytearray()
+    _w_bytes(tp, 1, bytes(tt))
+    out = bytearray()
+    _w_str(out, 1, name)
+    _w_bytes(out, 2, bytes(tp))
+    return bytes(out)
+
+
+def encode_model(nodes: List[bytes], inputs: List[bytes],
+                 outputs: List[bytes], initializers: List[bytes],
+                 opset: int = 17, graph_name: str = "g") -> bytes:
+    g = bytearray()
+    for n in nodes:
+        _w_bytes(g, 1, n)
+    _w_str(g, 2, graph_name)
+    for t in initializers:
+        _w_bytes(g, 5, t)
+    for i in inputs:
+        _w_bytes(g, 11, i)
+    for o in outputs:
+        _w_bytes(g, 12, o)
+    m = bytearray()
+    _w_int(m, 1, 8)               # ir_version
+    _w_bytes(m, 7, bytes(g))
+    ops = bytearray()
+    _w_str(ops, 1, "")            # default domain
+    _w_int(ops, 2, opset)
+    _w_bytes(m, 8, bytes(ops))
+    return bytes(m)

@@ -54,17 +54,40 @@ class ZooModel:
     def conf_builder(self):
         raise NotImplementedError
 
-    def initPretrained(self, pretrained_type: str = "IMAGENET"):
+    def initPretrained(self, pretrained_type: str = "IMAGENET",
+                       path: str = None):
         """ref: ZooModel.initPretrained — checksummed download; here: load
-        from local cache only (zero-egress environment)."""
-        path = os.path.join(
-            os.environ.get("DL4J_TPU_DATA_DIR",
-                           os.path.expanduser("~/.deeplearning4j_tpu")),
-            "pretrained", f"{type(self).__name__.lower()}_{pretrained_type.lower()}.zip")
+        from a local file (zero-egress environment). Accepts the native
+        zip checkpoint format OR a Keras .h5 full-model save (routed
+        through modelimport.keras — the reference's pretrained zoo zips
+        are themselves Keras-derived)."""
+        if path is None:
+            base = os.path.join(
+                os.environ.get("DL4J_TPU_DATA_DIR",
+                               os.path.expanduser("~/.deeplearning4j_tpu")),
+                "pretrained",
+                f"{type(self).__name__.lower()}_{pretrained_type.lower()}")
+            for cand in (base + ".zip", base + ".h5"):
+                if os.path.exists(cand):
+                    path = cand
+                    break
+            if path is None:
+                raise FileNotFoundError(
+                    f"pretrained weights not found at {base}.zip|.h5 (no "
+                    f"network egress; place the checkpoint there manually)")
         if not os.path.exists(path):
-            raise FileNotFoundError(
-                f"pretrained weights not found at {path} (no network egress; "
-                f"place the checkpoint there manually)")
+            raise FileNotFoundError(path)
+        if path.endswith((".h5", ".hdf5", ".keras")):
+            from deeplearning4j_tpu.modelimport.keras import (Hdf5Archive,
+                                                              KerasModelImport)
+            arch = Hdf5Archive(path)
+            try:
+                kind = arch.model_config().get("class_name")
+            finally:
+                arch.close()
+            if kind == "Sequential":
+                return KerasModelImport.importKerasSequentialModelAndWeights(path)
+            return KerasModelImport.importKerasModelAndWeights(path)
         try:
             return MultiLayerNetwork.load(path)
         except Exception:

@@ -471,8 +471,12 @@ class ZeroPaddingLayer(Layer):
 
     def __init__(self, padding=(1, 1), **kw):
         super().__init__(**kw)
-        self.pad = _pair(padding) if isinstance(padding, (int,)) or len(padding) == 2 \
-            else tuple(padding)
+        if isinstance(padding, int):
+            self.pad = (padding, padding)
+        elif all(isinstance(p, (int, np.integer)) for p in padding):
+            self.pad = tuple(int(p) for p in padding)
+        else:   # asymmetric ((top, bottom), (left, right))
+            self.pad = tuple(tuple(int(v) for v in p) for p in padding)
 
     def infer_nin(self, it):
         self.nIn = self.nOut = it.channels
@@ -612,6 +616,95 @@ class LSTM(Layer):
         return InputType.recurrent(self.nOut, it.dims.get("timesteps", -1))
 
 
+class GRU(Layer):
+    """ref: layers.recurrent.GRU (gruCell op underneath) — input
+    [N, nIn, T] -> [N, nOut, T], gate order [r, z, n] like the reference's
+    libnd4j gruCell (and torch)."""
+
+    input_kind = "rnn"
+
+    def __init__(self, nOut=None, **kw):
+        super().__init__(nOut=nOut, **kw)
+        if self.activation in (None, "identity"):
+            self.activation = "tanh"
+
+    def set_defaults(self, base):
+        super().set_defaults(base)
+        if self.activation == "identity":
+            self.activation = "tanh"
+
+    def initialize(self, key):
+        k1, k2 = jax.random.split(key)
+        H = self.nOut
+        params = {
+            "W": _initialize((self.nIn, 3 * H), self.weight_init, k1),
+            "RW": _initialize((H, 3 * H), self.weight_init, k2),
+            "b": jnp.zeros((3 * H,), jnp.float32),
+            "bR": jnp.zeros((3 * H,), jnp.float32),
+        }
+        return params, {}
+
+    def apply(self, params, state, x, train, key, mask=None):
+        x_tnc = jnp.transpose(x, (2, 0, 1))
+        mask_tn = jnp.transpose(mask, (1, 0)) if mask is not None else None
+        outs, _ = rnn_ops.gru(x_tnc, params["W"], params["RW"], params["b"],
+                              params["bR"], mask_tn=mask_tn)
+        return jnp.transpose(outs, (1, 2, 0)), state
+
+    def apply_with_state(self, params, x, rnn_state, mask=None):
+        x_tnc = jnp.transpose(x, (2, 0, 1))
+        mask_tn = jnp.transpose(mask, (1, 0)) if mask is not None else None
+        outs, hT = rnn_ops.gru(x_tnc, params["W"], params["RW"], params["b"],
+                               params["bR"], h0=rnn_state, mask_tn=mask_tn)
+        return jnp.transpose(outs, (1, 2, 0)), hT
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.recurrent(self.nOut, it.dims.get("timesteps", -1))
+
+
+class Convolution1D(Layer):
+    """ref: layers.convolution.Convolution1DLayer — input [N, nIn, T]
+    (NCW), W [nOut, nIn, k]; supports causal mode like the reference."""
+
+    input_kind = "rnn"
+
+    def __init__(self, kernelSize: int = 3, stride: int = 1, padding: int = 0,
+                 nOut=None, dilation: int = 1, convolutionMode: str = "same",
+                 hasBias: bool = True, **kw):
+        super().__init__(nOut=nOut, **kw)
+        self.kernel = int(kernelSize if not isinstance(kernelSize, (tuple, list))
+                          else kernelSize[0])
+        self.stride = int(stride if not isinstance(stride, (tuple, list))
+                          else stride[0])
+        self.padding = int(padding if not isinstance(padding, (tuple, list))
+                           else padding[0])
+        self.dilation = int(dilation if not isinstance(dilation, (tuple, list))
+                            else dilation[0])
+        self.mode = convolutionMode
+        self.has_bias = hasBias
+
+    def initialize(self, key):
+        params = {"W": _initialize((self.nOut, self.nIn, self.kernel),
+                                   self.weight_init, key)}
+        if self.has_bias:
+            params["b"] = jnp.full((self.nOut,), self.bias_init, jnp.float32)
+        return params, {}
+
+    def apply(self, params, state, x, train, key, mask=None):
+        out = conv_ops.conv1d(x, params["W"], params.get("b"),
+                              stride=self.stride, pad=self.padding,
+                              dilation=self.dilation, mode=self.mode)
+        return act.get(self.activation)(out), state
+
+    def output_type(self, it: InputType) -> InputType:
+        t = it.dims.get("timesteps", -1)
+        if t and t > 0:
+            t = conv_ops.conv_output_size(t, self.kernel, self.stride,
+                                          self.padding, self.dilation,
+                                          self.mode)
+        return InputType.recurrent(self.nOut, t)
+
+
 class GravesLSTM(LSTM):
     """ref: layers.recurrent.GravesLSTM (legacy peephole variant; the
     peephole connections are omitted — reference deprecated it in favor of
@@ -712,16 +805,50 @@ class Bidirectional(Layer):
         return InputType.recurrent(self.nOut, it.dims.get("timesteps", -1))
 
     def to_config(self):
-        return {"@class": "Bidirectional", "mode": self.mode,
-                "fwd": self.fwd.to_config(), "name": self.name,
-                "nIn": self.nIn, "nOut": self.nOut}
+        # class-aware: subclasses (BidirectionalLastStep) round-trip intact
+        return {"@class": type(self).__name__, "mode": self.mode,
+                "fwd": self.fwd.to_config(), "bwd": self.bwd.to_config(),
+                "name": self.name, "nIn": self.nIn, "nOut": self.nOut}
 
     @classmethod
     def from_config(cls, d):
         inner = layer_from_config(d["fwd"])
-        obj = Bidirectional(inner, mode=d["mode"])
+        obj = cls(inner, mode=d["mode"])
+        if "bwd" in d:    # independently-weighted directions (Keras import)
+            obj.bwd = layer_from_config(d["bwd"])
         obj.nIn, obj.nOut = d.get("nIn"), d.get("nOut")
         return obj
+
+
+class BidirectionalLastStep(Bidirectional):
+    """Bidirectional collapsed to one step with KERAS semantics: the
+    forward direction's LAST output merged with the backward direction's
+    FINAL state (which corresponds to input position 0). NOTE this differs
+    from LastTimeStep(Bidirectional(...)), which takes position T-1 of
+    both directions (the reference's composition); this class exists for
+    Keras model import parity."""
+
+    def apply(self, params, state, x, train, key, mask=None):
+        if mask is not None:
+            raise ValueError("BidirectionalLastStep does not support "
+                             "sequence masks (imported-model inference "
+                             "path); pad-free batches only")
+        yf, _ = self.fwd.apply(params["fwd"], {}, x, train, key, mask=None)
+        x_rev = jnp.flip(x, axis=2)
+        yb, _ = self.bwd.apply(params["bwd"], {}, x_rev, train, key,
+                               mask=None)
+        f = yf[:, :, -1]
+        b = yb[:, :, -1]       # last step of reversed run = state at t=0
+        if self.mode == "concat":
+            return jnp.concatenate([f, b], axis=1), state
+        if self.mode == "add":
+            return f + b, state
+        if self.mode == "mul":
+            return f * b, state
+        return (f + b) / 2.0, state
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.feedForward(self.nOut)
 
 
 class LastTimeStep(Layer):
@@ -911,11 +1038,12 @@ class PReLULayer(Layer):
 
 _LAYER_CLASSES = {}
 for _cls in [DenseLayer, EmbeddingLayer, EmbeddingSequenceLayer, ConvolutionLayer,
-             Deconvolution2D, DepthwiseConvolution2D, SeparableConvolution2D,
+             Convolution1D, Deconvolution2D, DepthwiseConvolution2D, SeparableConvolution2D,
              SubsamplingLayer, BatchNormalization, LocalResponseNormalization,
              ActivationLayer, DropoutLayer, ZeroPaddingLayer, Upsampling2D,
-             Cropping2D, GlobalPoolingLayer, LSTM, GravesLSTM, SimpleRnn,
-             Bidirectional, LastTimeStep, OutputLayer, LossLayer, RnnOutputLayer,
+             Cropping2D, GlobalPoolingLayer, LSTM, GravesLSTM, GRU, SimpleRnn,
+             Bidirectional, BidirectionalLastStep, LastTimeStep,
+             OutputLayer, LossLayer, RnnOutputLayer,
              PReLULayer]:
     _LAYER_CLASSES[_cls.__name__] = _cls
 

@@ -374,7 +374,9 @@ def conv_output_size(size: int, kernel: int, stride: int, pad: int,
         return -(-size // stride)  # ceil
     eff_k = kernel + (kernel - 1) * (dilation - 1)
     if mode.lower() == "causal":
-        return size  # causal left-pad keeps length (stride 1)
+        # causal left-pad (k-1)*d keeps length at stride 1; strided causal
+        # subsamples like SAME
+        return (size - 1) // stride + 1
     out = (size + 2 * pad - eff_k) // stride + 1
     if out <= 0:
         raise ValueError(

@@ -98,6 +98,73 @@ class TestSequentialImport:
         got = np.asarray(net.output(np.transpose(x, (0, 2, 1))))
         np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
 
+    def test_gru_parity(self, tmp_path):
+        m = keras.Sequential([
+            keras.Input(shape=(5, 4)),
+            KL.GRU(6, return_sequences=True, name="g1"),
+            KL.GRU(3, name="g2"),
+        ])
+        x = np.random.RandomState(1).randn(2, 5, 4).astype(np.float32)
+        want = m.predict(x, verbose=0)
+        net = importKerasSequentialModelAndWeights(_save(tmp_path, m))
+        got = np.asarray(net.output(np.transpose(x, (0, 2, 1))))  # [N,C,T]
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_bidirectional_lstm_parity(self, tmp_path):
+        m = keras.Sequential([
+            keras.Input(shape=(6, 3)),
+            KL.Bidirectional(KL.LSTM(5, return_sequences=True), name="bi1"),
+            KL.Bidirectional(KL.LSTM(4), merge_mode="sum", name="bi2"),
+        ])
+        x = np.random.RandomState(2).randn(2, 6, 3).astype(np.float32)
+        want = m.predict(x, verbose=0)
+        net = importKerasSequentialModelAndWeights(_save(tmp_path, m))
+        got = np.asarray(net.output(np.transpose(x, (0, 2, 1))))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_conv1d_parity(self, tmp_path):
+        m = keras.Sequential([
+            keras.Input(shape=(10, 3)),
+            KL.Conv1D(8, 3, padding="causal", activation="relu", name="c1"),
+            KL.Conv1D(4, 3, padding="same", name="c2"),
+            KL.GlobalAveragePooling1D(name="gp"),
+        ])
+        x = np.random.RandomState(3).randn(2, 10, 3).astype(np.float32)
+        want = m.predict(x, verbose=0)
+        net = importKerasSequentialModelAndWeights(_save(tmp_path, m))
+        got = np.asarray(net.output(np.transpose(x, (0, 2, 1))))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_separable_pad_crop_upsample_parity(self, tmp_path):
+        m = keras.Sequential([
+            keras.Input(shape=(8, 8, 3)),
+            KL.ZeroPadding2D(((1, 2), (0, 1)), name="zp"),
+            KL.SeparableConv2D(6, (3, 3), padding="valid",
+                               activation="relu", name="sc"),
+            KL.UpSampling2D((2, 2), name="up"),
+            KL.Cropping2D(((1, 1), (2, 2)), name="cr"),
+            KL.GlobalAveragePooling2D(name="gp"),
+        ])
+        x = np.random.RandomState(4).rand(2, 8, 8, 3).astype(np.float32)
+        want = m.predict(x, verbose=0)
+        net = importKerasSequentialModelAndWeights(_save(tmp_path, m))
+        got = np.asarray(net.output(_nchw(x)))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_init_pretrained_from_h5(self, tmp_path):
+        from deeplearning4j_tpu.models.zoo import LeNet
+        m = keras.Sequential([
+            keras.Input(shape=(6,)),
+            KL.Dense(4, activation="relu"),
+            KL.Dense(2, activation="softmax"),
+        ])
+        p = _save(tmp_path, m, "pre.h5")
+        net = LeNet().initPretrained(path=p)
+        x = np.random.RandomState(5).randn(3, 6).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(net.output(x)),
+                                   m.predict(x, verbose=0),
+                                   rtol=1e-4, atol=1e-5)
+
     def test_unsupported_layer_reported(self, tmp_path):
         m = keras.Sequential([
             keras.Input(shape=(4,)),

@@ -1,0 +1,226 @@
+"""ONNX import conformance tests.
+
+Reference parity: ``samediff-import-onnx``'s conformance suite (SURVEY.md
+§2.2). No ``onnx`` package exists in this image, so test models are
+CONSTRUCTED with the in-repo wire-format encoder (the wire format is
+standard protobuf; files from real exporters decode identically) and
+goldens are computed with numpy.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.modelimport import onnx_proto as P
+from deeplearning4j_tpu.modelimport.onnx import (OnnxImportError,
+                                                 importOnnxModel)
+
+
+def _model(nodes, inputs, outputs, initializers=()):
+    return P.encode_model(
+        nodes=nodes,
+        inputs=[P.encode_value_info(n, d, s) for n, d, s in inputs],
+        outputs=[P.encode_value_info(n, d, s) for n, d, s in outputs],
+        initializers=[P.encode_tensor(n, a) for n, a in initializers])
+
+
+class TestProtoCodec:
+    def test_tensor_roundtrip(self):
+        arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+        t = P.TensorProto.parse(P.encode_tensor("w", arr))
+        assert t.name == "w"
+        np.testing.assert_array_equal(t.array, arr)
+
+    def test_model_parse(self):
+        rng = np.random.RandomState(0)
+        w = rng.randn(4, 3).astype(np.float32)
+        blob = _model(
+            nodes=[P.encode_node("MatMul", ["x", "w"], ["y"])],
+            inputs=[("x", np.float32, [None, 4])],
+            outputs=[("y", np.float32, [None, 3])],
+            initializers=[("w", w)])
+        m = P.load_model(blob)
+        assert m.graph.nodes[0].op_type == "MatMul"
+        assert m.graph.inputs[0].shape == [None, 4]
+        np.testing.assert_array_equal(m.graph.initializers[0].array, w)
+
+
+class TestOnnxImport:
+    def _run(self, blob, feeds, out_names):
+        sd = importOnnxModel(blob)
+        return sd.output(feeds, out_names)
+
+    def test_gemm_relu_mlp(self):
+        rng = np.random.RandomState(0)
+        w1 = rng.randn(6, 8).astype(np.float32)
+        b1 = rng.randn(8).astype(np.float32)
+        w2 = rng.randn(8, 3).astype(np.float32)
+        blob = _model(
+            nodes=[
+                P.encode_node("Gemm", ["x", "w1", "b1"], ["h"], transB=0),
+                P.encode_node("Relu", ["h"], ["hr"]),
+                P.encode_node("MatMul", ["hr", "w2"], ["logits"]),
+                P.encode_node("Softmax", ["logits"], ["probs"], axis=-1),
+            ],
+            inputs=[("x", np.float32, [None, 6])],
+            outputs=[("probs", np.float32, [None, 3])],
+            initializers=[("w1", w1), ("b1", b1), ("w2", w2)])
+        x = rng.randn(4, 6).astype(np.float32)
+        got = np.asarray(self._run(blob, {"x": x}, ["probs"])["probs"])
+        h = np.maximum(x @ w1 + b1, 0)
+        logits = h @ w2
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        want = e / e.sum(-1, keepdims=True)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
+
+    def test_conv_pool_batchnorm(self):
+        rng = np.random.RandomState(1)
+        w = (rng.randn(4, 2, 3, 3) * 0.2).astype(np.float32)
+        g = (rng.rand(4) + 0.5).astype(np.float32)
+        be = rng.randn(4).astype(np.float32)
+        mean = rng.randn(4).astype(np.float32)
+        var = (rng.rand(4) + 0.5).astype(np.float32)
+        blob = _model(
+            nodes=[
+                P.encode_node("Conv", ["x", "w"], ["c"], pads=[1, 1, 1, 1],
+                              strides=[1, 1], kernel_shape=[3, 3]),
+                P.encode_node("BatchNormalization",
+                              ["c", "g", "be", "mean", "var"], ["bn"],
+                              epsilon=1e-5),
+                P.encode_node("Relu", ["bn"], ["r"]),
+                P.encode_node("MaxPool", ["r"], ["p"], kernel_shape=[2, 2],
+                              strides=[2, 2]),
+                P.encode_node("GlobalAveragePool", ["p"], ["gap"]),
+                P.encode_node("Flatten", ["gap"], ["y"], axis=1),
+            ],
+            inputs=[("x", np.float32, [2, 2, 8, 8])],
+            outputs=[("y", np.float32, [2, 4])],
+            initializers=[("w", w), ("g", g), ("be", be), ("mean", mean),
+                          ("var", var)])
+        x = rng.randn(2, 2, 8, 8).astype(np.float32)
+        got = np.asarray(self._run(blob, {"x": x}, ["y"])["y"])
+        # numpy golden
+        import jax
+        c = jax.lax.conv_general_dilated(x, w, (1, 1), [(1, 1), (1, 1)],
+                                         dimension_numbers=("NCHW", "OIHW",
+                                                            "NCHW"))
+        c = np.asarray(c)
+        bn = (c - mean.reshape(1, -1, 1, 1)) / np.sqrt(
+            var.reshape(1, -1, 1, 1) + 1e-5) * g.reshape(1, -1, 1, 1) \
+            + be.reshape(1, -1, 1, 1)
+        r = np.maximum(bn, 0)
+        p = r.reshape(2, 4, 4, 2, 4, 2).max(axis=(3, 5))
+        want = p.mean(axis=(2, 3))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_shape_ops_and_const_folding(self):
+        rng = np.random.RandomState(2)
+        blob = _model(
+            nodes=[
+                P.encode_node("Transpose", ["x"], ["t"], perm=[0, 2, 1]),
+                P.encode_node("Reshape", ["t", "shp"], ["r"]),
+                P.encode_node("Concat", ["r", "r"], ["cc"], axis=1),
+                P.encode_node("Slice", ["cc", "st", "en"], ["s"]),
+                P.encode_node("Unsqueeze", ["s", "ax"], ["u"]),
+                P.encode_node("Squeeze", ["u", "ax"], ["y"]),
+            ],
+            inputs=[("x", np.float32, [2, 3, 4])],
+            outputs=[("y", np.float32, None)],
+            initializers=[("shp", np.asarray([2, 12], np.int64)),
+                          ("st", np.asarray([0, 2], np.int64)),
+                          ("en", np.asarray([2, 10], np.int64)),
+                          ("ax", np.asarray([0], np.int64))])
+        x = rng.randn(2, 3, 4).astype(np.float32)
+        got = np.asarray(self._run(blob, {"x": x}, ["y"])["y"])
+        t = np.transpose(x, (0, 2, 1)).reshape(2, 12)
+        cc = np.concatenate([t, t], 1)
+        want = cc[0:2, 2:10]
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_reduce_and_elementwise(self):
+        rng = np.random.RandomState(3)
+        blob = _model(
+            nodes=[
+                P.encode_node("ReduceMean", ["x"], ["m"], axes=[1],
+                              keepdims=1),
+                P.encode_node("Sub", ["x", "m"], ["d"]),
+                P.encode_node("Mul", ["d", "d"], ["sq"]),
+                P.encode_node("ReduceSum", ["sq"], ["v"], axes=[1],
+                              keepdims=0),
+                P.encode_node("Sqrt", ["v"], ["y"]),
+            ],
+            inputs=[("x", np.float32, [3, 5])],
+            outputs=[("y", np.float32, [3])])
+        x = rng.randn(3, 5).astype(np.float32)
+        got = np.asarray(self._run(blob, {"x": x}, ["y"])["y"])
+        d = x - x.mean(1, keepdims=True)
+        want = np.sqrt((d * d).sum(1))
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_constant_node_and_clip_cast(self):
+        blob = _model(
+            nodes=[
+                P.encode_node("Constant", [], ["k"],
+                              value=np.asarray([2.0], np.float32)),
+                P.encode_node("Mul", ["x", "k"], ["m"]),
+                P.encode_node("Clip", ["m"], ["c"], min=0.0, max=3.0),
+                P.encode_node("Cast", ["c"], ["y"], to=P.DT_INT32),
+            ],
+            inputs=[("x", np.float32, [4])],
+            outputs=[("y", np.int32, [4])])
+        x = np.asarray([-1.0, 0.5, 1.0, 5.0], np.float32)
+        got = np.asarray(self._run(blob, {"x": x}, ["y"])["y"])
+        np.testing.assert_array_equal(got, [0, 1, 2, 3])
+        assert got.dtype == np.int32
+
+    def test_split_multi_output(self):
+        blob = _model(
+            nodes=[P.encode_node("Split", ["x"], ["a", "b"], axis=1)],
+            inputs=[("x", np.float32, [2, 6])],
+            outputs=[("a", np.float32, [2, 3]), ("b", np.float32, [2, 3])])
+        x = np.arange(12, dtype=np.float32).reshape(2, 6)
+        res = self._run(blob, {"x": x}, ["a", "b"])
+        np.testing.assert_array_equal(np.asarray(res["a"]), x[:, :3])
+        np.testing.assert_array_equal(np.asarray(res["b"]), x[:, 3:])
+
+    def test_save_load_roundtrip(self, tmp_path):
+        rng = np.random.RandomState(4)
+        w = rng.randn(5, 2).astype(np.float32)
+        blob = _model(
+            nodes=[
+                P.encode_node("Gemm", ["x", "w"], ["h"], transB=0, alpha=2.0),
+                P.encode_node("Tanh", ["h"], ["y"]),
+            ],
+            inputs=[("x", np.float32, [3, 5])],
+            outputs=[("y", np.float32, [3, 2])],
+            initializers=[("w", w)])
+        sd = importOnnxModel(blob)
+        x = rng.randn(3, 5).astype(np.float32)
+        want = np.asarray(sd.output({"x": x}, ["y"])["y"])
+        p = str(tmp_path / "onnx.sdz")
+        sd.save(p)
+        from deeplearning4j_tpu.autodiff.samediff import SameDiff
+        sd2 = SameDiff.load(p)
+        got = np.asarray(sd2.output({"x": x}, ["y"])["y"])
+        np.testing.assert_allclose(got, want, rtol=0, atol=0)
+        np.testing.assert_allclose(want, np.tanh(2.0 * (x @ w)), rtol=1e-5)
+
+    def test_unmapped_op_reported(self):
+        blob = _model(
+            nodes=[P.encode_node("NonMaxSuppression", ["x"], ["y"])],
+            inputs=[("x", np.float32, [4])],
+            outputs=[("y", np.float32, [4])])
+        with pytest.raises(OnnxImportError, match="NonMaxSuppression"):
+            importOnnxModel(blob)
+
+    def test_file_roundtrip(self, tmp_path):
+        blob = _model(
+            nodes=[P.encode_node("Relu", ["x"], ["y"])],
+            inputs=[("x", np.float32, [3])],
+            outputs=[("y", np.float32, [3])])
+        p = str(tmp_path / "m.onnx")
+        with open(p, "wb") as f:
+            f.write(blob)
+        sd = importOnnxModel(p)
+        got = np.asarray(sd.output(
+            {"x": np.asarray([-1.0, 0.0, 2.0], np.float32)}, ["y"])["y"])
+        np.testing.assert_array_equal(got, [0.0, 0.0, 2.0])
