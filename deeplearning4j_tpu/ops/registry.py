@@ -217,11 +217,12 @@ register("pad", lambda x, paddings, mode="constant", value=0.0:
          else jnp.pad(x, paddings, mode=mode))
 register("gather", lambda x, idx, axis=0: jnp.take(x, idx, axis=axis))
 register("gather_nd", lambda x, idx: x[tuple(jnp.moveaxis(idx, -1, 0))])
-register("scatter_update", lambda x, idx, upd: x.at[idx].set(upd))
-register("scatter_add", lambda x, idx, upd: x.at[idx].add(upd))
-register("scatter_sub", lambda x, idx, upd: x.at[idx].add(-upd))
-register("scatter_max", lambda x, idx, upd: x.at[idx].max(upd))
-register("scatter_min", lambda x, idx, upd: x.at[idx].min(upd))
+# jnp.asarray: eager numpy inputs have no .at property
+register("scatter_update", lambda x, idx, upd: jnp.asarray(x).at[idx].set(upd))
+register("scatter_add", lambda x, idx, upd: jnp.asarray(x).at[idx].add(upd))
+register("scatter_sub", lambda x, idx, upd: jnp.asarray(x).at[idx].add(-jnp.asarray(upd)))
+register("scatter_max", lambda x, idx, upd: jnp.asarray(x).at[idx].max(upd))
+register("scatter_min", lambda x, idx, upd: jnp.asarray(x).at[idx].min(upd))
 register("slice", lambda x, begin, size: lax.dynamic_slice(x, begin, size))
 register("strided_slice", lambda x, begin, end, strides: x[tuple(slice(b, e, s) for b, e, s in zip(begin, end, strides))])
 register("where", lambda cond, x=None, y=None: jnp.where(cond, x, y) if x is not None else jnp.argwhere(cond))
@@ -443,6 +444,8 @@ def _nms(boxes, scores, max_out, iou_threshold=0.5, score_threshold=-jnp.inf):
     """Greedy NMS over [N,4] boxes (y1,x1,y2,x2) — fixed-size output with
     -1 padding, jit-friendly (ref: libnd4j ``non_max_suppression``; YOLO
     postprocessing uses this)."""
+    boxes = jnp.asarray(boxes)   # numpy inputs would be indexed by tracers
+    scores = jnp.asarray(scores)
     n = boxes.shape[0]
     y1, x1, y2, x2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
     areas = jnp.maximum(y2 - y1, 0) * jnp.maximum(x2 - x1, 0)
@@ -472,6 +475,236 @@ def _nms(boxes, scores, max_out, iou_threshold=0.5, score_threshold=-jnp.inf):
     keep0 = jnp.full((max_out,), -1, jnp.int32)
     keep, _ = lax.fori_loop(0, max_out, body, (keep0, active))
     return keep
+
+
+# ---------------------------------------------------------------------------
+# Family: reduce3 (pairwise distance/similarity reductions)
+# ref: libnd4j reduce3 loops {cosinesimilarity, cosinedistance, euclidean,
+# manhattan, hamming, jaccard, dot} — SURVEY.md §2.1
+# ---------------------------------------------------------------------------
+
+def _flat2(x, y):
+    return jnp.ravel(x), jnp.ravel(y)
+
+
+@register("cosine_similarity")
+def _cosine_similarity(x, y, axis=None):
+    if axis is None:
+        x, y = _flat2(x, y)
+        axis = 0
+    num = jnp.sum(x * y, axis=axis)
+    den = jnp.linalg.norm(x, axis=axis) * jnp.linalg.norm(y, axis=axis)
+    return num / jnp.maximum(den, 1e-12)
+
+
+register("cosine_distance", lambda x, y, axis=None:
+         1.0 - _cosine_similarity(x, y, axis=axis))
+register("euclidean_distance", lambda x, y, axis=None:
+         jnp.sqrt(jnp.sum(jnp.square(x - y), axis=axis)))
+register("manhattan_distance", lambda x, y, axis=None:
+         jnp.sum(jnp.abs(x - y), axis=axis))
+register("hamming_distance", lambda x, y, axis=None:
+         jnp.sum((x != y).astype(jnp.float32), axis=axis))
+
+
+@register("jaccard_distance")
+def _jaccard_distance(x, y, axis=None):
+    mn = jnp.sum(jnp.minimum(x, y), axis=axis)
+    mx = jnp.sum(jnp.maximum(x, y), axis=axis)
+    # both-empty sets are identical: distance 0, not the 0/0 fallback
+    return jnp.where(mx == 0, 0.0, 1.0 - mn / jnp.maximum(mx, 1e-12))
+
+
+# ---------------------------------------------------------------------------
+# Family: segment reductions (ref: libnd4j segment_* / unsorted_segment_*)
+# ---------------------------------------------------------------------------
+
+def _segment(reducer):
+    def op(data, segment_ids, num_segments=None):
+        if num_segments is None:
+            # requires a concrete ids array: XLA needs a static segment
+            # count. Inside jit/SameDiff graphs pass num_segments.
+            if isinstance(segment_ids, jax.core.Tracer):
+                raise ValueError(
+                    "segment ops need num_segments under jit (static "
+                    "output shape); pass it explicitly")
+            num_segments = int(jnp.max(segment_ids)) + 1
+        return reducer(data, segment_ids.astype(jnp.int32),
+                       int(num_segments))
+    return op
+
+
+register("segment_sum", _segment(
+    lambda d, i, n: jax.ops.segment_sum(d, i, num_segments=n)))
+register("segment_prod", _segment(
+    lambda d, i, n: jax.ops.segment_prod(d, i, num_segments=n)))
+register("segment_max", _segment(
+    lambda d, i, n: jax.ops.segment_max(d, i, num_segments=n)))
+register("segment_min", _segment(
+    lambda d, i, n: jax.ops.segment_min(d, i, num_segments=n)))
+
+
+@register("segment_mean")
+def _segment_mean(data, segment_ids, num_segments=None):
+    i = segment_ids.astype(jnp.int32)
+    if num_segments is None:
+        if isinstance(i, jax.core.Tracer):
+            raise ValueError("segment_mean needs num_segments under jit")
+        num_segments = int(jnp.max(i)) + 1
+    n = int(num_segments)
+    s = jax.ops.segment_sum(data, i, num_segments=n)
+    c = jax.ops.segment_sum(jnp.ones_like(data, jnp.float32), i, num_segments=n)
+    return s / jnp.maximum(c, 1.0)
+
+
+for _nm in ("sum", "prod", "max", "min", "mean"):
+    register(f"unsorted_segment_{_nm}", _REGISTRY[f"segment_{_nm}"])
+
+
+# ---------------------------------------------------------------------------
+# Family: space/batch + band/diag utilities (ref: libnd4j parity_ops)
+# ---------------------------------------------------------------------------
+
+@register("matrix_band_part")
+def _matrix_band_part(x, num_lower, num_upper):
+    m, n = x.shape[-2], x.shape[-1]
+    i = jnp.arange(m)[:, None]
+    j = jnp.arange(n)[None, :]
+    keep = jnp.ones((m, n), bool)
+    if num_lower >= 0:
+        keep &= (i - j) <= num_lower
+    if num_upper >= 0:
+        keep &= (j - i) <= num_upper
+    return jnp.where(keep, x, jnp.zeros((), x.dtype))
+
+
+@register("space_to_batch")
+def _space_to_batch(x, block_size, paddings=((0, 0), (0, 0))):
+    """NHWC, uniform block (ref: space_to_batch); paddings per spatial dim."""
+    b = int(block_size)
+    x = jnp.pad(x, [(0, 0), tuple(paddings[0]), tuple(paddings[1]), (0, 0)])
+    n, h, w, c = x.shape
+    x = x.reshape(n, h // b, b, w // b, b, c)
+    x = jnp.transpose(x, (2, 4, 0, 1, 3, 5))
+    return x.reshape(n * b * b, h // b, w // b, c)
+
+
+@register("batch_to_space")
+def _batch_to_space(x, block_size, crops=((0, 0), (0, 0))):
+    b = int(block_size)
+    nb, h, w, c = x.shape
+    n = nb // (b * b)
+    x = x.reshape(b, b, n, h, w, c)
+    x = jnp.transpose(x, (2, 3, 0, 4, 1, 5)).reshape(n, h * b, w * b, c)
+    (ct, cb), (cl, cr) = crops
+    return x[:, ct:h * b - cb, cl:w * b - cr]
+
+
+@register("histogram")
+def _histogram(x, bins=10, range=None):
+    counts, edges = jnp.histogram(x, bins=int(bins), range=range)
+    return counts
+
+
+@register("histogram_fixed_width")
+def _histogram_fixed_width(x, lo, hi, bins=100):
+    counts, _ = jnp.histogram(x, bins=int(bins), range=(lo, hi))
+    return counts
+
+
+@register("bincount")
+def _bincount(x, weights=None, minlength=0, length=None):
+    """``length`` (static) is REQUIRED under jit; eagerly it defaults to
+    max(x)+1 (and to minlength for empty input)."""
+    xf = jnp.ravel(x).astype(jnp.int32)
+    if length is None:
+        if isinstance(xf, jax.core.Tracer):
+            raise ValueError("bincount needs a static `length` under jit")
+        mx = int(jnp.max(xf)) + 1 if xf.size else 0
+        length = max(mx, int(minlength), 1)
+    return jnp.bincount(xf,
+                        weights=None if weights is None else jnp.ravel(weights),
+                        minlength=int(minlength), length=int(length))
+
+
+@register("meshgrid")
+def _meshgrid(*xs, indexing="xy"):
+    return jnp.meshgrid(*xs, indexing=indexing)
+
+
+@register("nth_element")
+def _nth_element(x, n, reverse=False):
+    s = jnp.sort(x, axis=-1)
+    if reverse:
+        s = jnp.flip(s, axis=-1)
+    return s[..., n]
+
+
+@register("percentile")
+def _percentile(x, q, axis=None, interpolation="linear"):
+    return jnp.percentile(x, q, axis=axis, method=interpolation)
+
+
+register("median", lambda x, axis=None: jnp.median(x, axis=axis))
+
+
+@register("dynamic_partition")
+def _dynamic_partition(data, partitions, num_partitions):
+    """ref: dynamic_partition — returns dense per-partition arrays with a
+    validity count is NOT expressible under static shapes; returns masked
+    copies (rows not in partition k are zero) which is the XLA-legal form."""
+    return [jnp.where((partitions == k)[(...,) + (None,) * (data.ndim - 1)],
+                      data, jnp.zeros((), data.dtype))
+            for k in range(int(num_partitions))]
+
+
+@register("dynamic_stitch")
+def _dynamic_stitch(indices, data):
+    """ref: dynamic_stitch — output length = max(index)+1 (indices must be
+    concrete; TF's semantics require a data-dependent output shape)."""
+    n = max(int(jnp.max(jnp.ravel(i))) for i in indices) + 1
+    row_shape = data[0].shape[indices[0].ndim:]
+    out = jnp.zeros((n,) + tuple(row_shape), data[0].dtype)
+    for idx, d in zip(indices, data):
+        out = out.at[jnp.ravel(idx)].set(
+            d.reshape((-1,) + tuple(row_shape)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Family: math specials (ref: libnd4j transforms {lgamma, digamma, ...})
+# ---------------------------------------------------------------------------
+from jax.scipy import special as _sp  # noqa: E402
+
+register("lgamma", _sp.gammaln)
+register("digamma", _sp.digamma)
+register("igamma", _sp.gammainc)
+register("igammac", _sp.gammaincc)
+register("erfinv", _sp.erfinv)
+register("betainc", _sp.betainc)
+register("polygamma", lambda n, x: _sp.polygamma(n, x))
+register("zeta", _sp.zeta)
+register("log_sigmoid", lambda x: -jax.nn.softplus(-x))
+register("logsumexp", lambda x, axis=None, keepdims=False:
+         _sp.logsumexp(x, axis=axis, keepdims=keepdims))
+# single source of truth for clipping math: train/updaters.py (the
+# gradientNormalization path uses the same helpers)
+def _clip_ops():
+    from deeplearning4j_tpu.train import updaters as _upd
+    register("clip_by_value", lambda x, lo, hi: jnp.clip(x, lo, hi))
+    register("clip_by_norm",
+             lambda x, clip_norm: _upd.clip_by_norm(x, clip_norm))
+    register("clip_by_global_norm",
+             lambda xs, clip_norm: _upd.clip_by_global_norm(xs, clip_norm))
+
+
+_clip_ops()
+
+
+register("is_max", lambda x: (x == jnp.max(x)).astype(x.dtype))
+register("listdiff", lambda x, y: x[~jnp.isin(x, y)])  # host-shape op
+register("square_distance", lambda x, y, axis=None:
+         jnp.sum(jnp.square(x - y), axis=axis))
 
 
 # meta info
