@@ -4,7 +4,9 @@ from deeplearning4j_tpu.evaluation.evaluation import (  # noqa: F401
     ConfusionMatrix,
     Evaluation,
     EvaluationBinary,
+    EvaluationCalibration,
     RegressionEvaluation,
     ROC,
+    ROCBinary,
     ROCMultiClass,
 )

@@ -205,38 +205,225 @@ class EvaluationBinary:
 
 
 class ROC:
-    """Binary ROC/AUC by threshold steps (ref: ROC with thresholdSteps)."""
+    """Binary ROC/AUC (ref: ROC).
+
+    ``threshold_steps > 0``: histogram approximation at fixed thresholds
+    (constant memory — the reference's default 30 steps / our 100).
+    ``threshold_steps = 0``: EXACT mode — every (probability, label) pair
+    is retained and the AUC is computed over all distinct thresholds
+    (ref: "exact" ROC introduced in DL4J 0.9.1, thresholdSteps=0)."""
 
     def __init__(self, threshold_steps: int = 100):
         self.steps = threshold_steps
-        self.tp = np.zeros(threshold_steps + 1, np.int64)
-        self.fp = np.zeros(threshold_steps + 1, np.int64)
+        self.exact = threshold_steps == 0
+        self.tp = np.zeros(max(threshold_steps, 0) + 1, np.int64)
+        self.fp = np.zeros(max(threshold_steps, 0) + 1, np.int64)
         self.pos = 0
         self.neg = 0
+        self._probs: List[np.ndarray] = []
+        self._labels: List[np.ndarray] = []
 
     def eval(self, labels, predictions):
         labels = np.asarray(labels).reshape(-1)
         probs = np.asarray(predictions).reshape(-1)
-        thresholds = np.linspace(0.0, 1.0, self.steps + 1)
         pos = labels >= 0.5
         self.pos += int(pos.sum())
         self.neg += int((~pos).sum())
+        if self.exact:
+            self._probs.append(probs.astype(np.float64))
+            self._labels.append(pos)
+            return
+        thresholds = np.linspace(0.0, 1.0, self.steps + 1)
         for i, t in enumerate(thresholds):
             sel = probs >= t
             self.tp[i] += int((sel & pos).sum())
             self.fp[i] += int((sel & ~pos).sum())
 
-    def calculateAUC(self) -> float:
+    def _sorted_cumulative(self):
+        """(p desc, cumulative tp, cumulative fp) over all retained pairs —
+        shared by the exact ROC and PR curves."""
+        p = np.concatenate(self._probs) if self._probs else np.zeros(0)
+        y = np.concatenate(self._labels) if self._labels else np.zeros(0, bool)
+        order = np.argsort(-p, kind="mergesort")
+        y = y[order]
+        p = p[order]
+        return p, np.cumsum(y), np.cumsum(~y)
+
+    def _exact_curve(self):
+        p, tp, fp = self._sorted_cumulative()
+        # curve points only where the threshold actually changes
+        distinct = np.r_[np.where(np.diff(p))[0], p.size - 1] \
+            if p.size else np.zeros(0, np.intp)
+        tpr = np.r_[0.0, tp[distinct] / max(self.pos, 1)]
+        fpr = np.r_[0.0, fp[distinct] / max(self.neg, 1)]
+        return fpr, tpr
+
+    def getRocCurve(self):
+        """(fpr, tpr) arrays, exact or stepped."""
+        if self.exact:
+            return self._exact_curve()
         tpr = self.tp / max(self.pos, 1)
         fpr = self.fp / max(self.neg, 1)
         order = np.argsort(fpr)
-        return float(abs(np.trapezoid(tpr[order], fpr[order])))
+        return fpr[order], tpr[order]
+
+    def calculateAUC(self) -> float:
+        fpr, tpr = self.getRocCurve()
+        return float(abs(np.trapezoid(tpr, fpr)))
+
+    def calculateAUCPR(self) -> float:
+        """Area under the precision-recall curve (exact mode only gives the
+        exact value; stepped mode approximates)."""
+        if self.exact:
+            _, tp, fp = self._sorted_cumulative()
+            prec = tp / np.maximum(tp + fp, 1)
+            rec = tp / max(self.pos, 1)
+            if prec.size:   # anchor the curve at recall 0
+                prec = np.r_[prec[0], prec]
+                rec = np.r_[0.0, rec]
+            return float(abs(np.trapezoid(prec, rec)))
+        tpr = self.tp / max(self.pos, 1)
+        sel = self.tp + self.fp
+        # empty selection = precision 1 by convention (not 0 — the 0 anchor
+        # grossly underestimates AUCPR for separable data)
+        prec = np.where(sel > 0, self.tp / np.maximum(sel, 1), 1.0)
+        order = np.argsort(tpr)
+        return float(abs(np.trapezoid(prec[order], tpr[order])))
 
     def merge(self, other: "ROC"):
+        if self.exact != other.exact or self.steps != other.steps:
+            raise ValueError(
+                f"cannot merge ROC(threshold_steps={self.steps}) with "
+                f"ROC(threshold_steps={other.steps}): histograms are not "
+                f"convertible between modes")
         self.tp += other.tp
         self.fp += other.fp
         self.pos += other.pos
         self.neg += other.neg
+        self._probs.extend(other._probs)
+        self._labels.extend(other._labels)
+
+
+class ROCBinary:
+    """Per-output-column binary ROC for multi-label problems
+    (ref: org.nd4j.evaluation.classification.ROCBinary)."""
+
+    def __init__(self, threshold_steps: int = 0):
+        self.steps = threshold_steps
+        self._rocs: List[ROC] = []
+
+    def eval(self, labels, predictions):
+        labels = np.asarray(labels)
+        preds = np.asarray(predictions)
+        if labels.ndim == 1:
+            labels = labels[:, None]
+        if preds.ndim == 1:
+            preds = preds[:, None]
+        if labels.shape[1] != preds.shape[1]:
+            raise ValueError(
+                f"ROCBinary: {labels.shape[1]} label columns vs "
+                f"{preds.shape[1]} prediction columns (multi-label eval "
+                f"needs one probability per label output)")
+        if not self._rocs:
+            self._rocs = [ROC(self.steps) for _ in range(labels.shape[1])]
+        for c, roc in enumerate(self._rocs):
+            roc.eval(labels[:, c], preds[:, c])
+
+    def numLabels(self) -> int:
+        return len(self._rocs)
+
+    def calculateAUC(self, output: int) -> float:
+        return self._rocs[output].calculateAUC()
+
+    def calculateAverageAUC(self) -> float:
+        if not self._rocs:
+            return float("nan")
+        return float(np.mean([r.calculateAUC() for r in self._rocs]))
+
+    def merge(self, other: "ROCBinary"):
+        if not self._rocs:
+            # deep copy: aliasing the other accumulator's ROCs would let a
+            # later eval() on self corrupt other's counts
+            import copy
+            self._rocs = copy.deepcopy(other._rocs)
+        else:
+            for a, b in zip(self._rocs, other._rocs):
+                a.merge(b)
+
+
+class EvaluationCalibration:
+    """Probability-calibration diagnostics (ref:
+    org.nd4j.evaluation.classification.EvaluationCalibration): the
+    reliability diagram (mean predicted probability vs observed frequency
+    per bin), per-class prediction-probability histograms, and the
+    residual-|p - y| histogram."""
+
+    def __init__(self, reliability_bins: int = 10, histogram_bins: int = 10):
+        self.rel_bins = reliability_bins
+        self.hist_bins = histogram_bins
+        self._rel_counts = np.zeros(reliability_bins, np.int64)
+        self._rel_prob_sum = np.zeros(reliability_bins, np.float64)
+        self._rel_pos = np.zeros(reliability_bins, np.int64)
+        self._resid_counts = np.zeros(histogram_bins, np.int64)
+        self._prob_counts: Optional[np.ndarray] = None   # [C, bins]
+
+    def eval(self, labels, predictions):
+        y = np.asarray(labels, np.float64)
+        p = np.asarray(predictions, np.float64)
+        if y.ndim == 1:
+            y = y[:, None]
+            p = p[:, None]
+        C = y.shape[1]
+        if self._prob_counts is None:
+            self._prob_counts = np.zeros((C, self.hist_bins), np.int64)
+        # reliability over every (class, example) probability
+        flat_p = p.reshape(-1)
+        flat_y = y.reshape(-1)
+        bins = np.clip((flat_p * self.rel_bins).astype(int), 0,
+                       self.rel_bins - 1)
+        np.add.at(self._rel_counts, bins, 1)
+        np.add.at(self._rel_prob_sum, bins, flat_p)
+        np.add.at(self._rel_pos, bins, (flat_y >= 0.5).astype(np.int64))
+        # residual histogram |p - y|
+        resid = np.abs(flat_p - flat_y)
+        rbins = np.clip((resid * self.hist_bins).astype(int), 0,
+                        self.hist_bins - 1)
+        np.add.at(self._resid_counts, rbins, 1)
+        # per-class probability histograms
+        for c in range(C):
+            cb = np.clip((p[:, c] * self.hist_bins).astype(int), 0,
+                         self.hist_bins - 1)
+            np.add.at(self._prob_counts[c], cb, 1)
+
+    def getReliabilityInfo(self):
+        """(mean predicted prob, observed positive fraction, count) per bin
+        — the reliability diagram's x, y, and weights."""
+        cnt = np.maximum(self._rel_counts, 1)
+        return (self._rel_prob_sum / cnt,
+                self._rel_pos / cnt,
+                self._rel_counts.copy())
+
+    def expectedCalibrationError(self) -> float:
+        mean_p, frac_pos, counts = self.getReliabilityInfo()
+        total = max(counts.sum(), 1)
+        return float(np.sum(counts / total * np.abs(mean_p - frac_pos)))
+
+    def getResidualPlot(self):
+        return self._resid_counts.copy()
+
+    def getProbabilityHistogram(self, class_idx: int):
+        return self._prob_counts[class_idx].copy()
+
+    def merge(self, other: "EvaluationCalibration"):
+        self._rel_counts += other._rel_counts
+        self._rel_prob_sum += other._rel_prob_sum
+        self._rel_pos += other._rel_pos
+        self._resid_counts += other._resid_counts
+        if self._prob_counts is None:
+            self._prob_counts = None if other._prob_counts is None \
+                else other._prob_counts.copy()
+        elif other._prob_counts is not None:
+            self._prob_counts += other._prob_counts
 
 
 class ROCMultiClass:

@@ -163,8 +163,20 @@ class ParallelInference:
                     break
             try:
                 feats = np.concatenate([b[0] for b in batch], axis=0)
+                total = feats.shape[0]
+                # pad to the next power-of-two bucket (capped at
+                # batch_limit): ONE compiled program per bucket size
+                # instead of one per coalesced request count
+                bucket = 1
+                while bucket < total:
+                    bucket *= 2
+                bucket = min(max(bucket, 1), max(self.batch_limit, total))
+                if bucket > total:
+                    pad = np.zeros((bucket - total,) + feats.shape[1:],
+                                   feats.dtype)
+                    feats = np.concatenate([feats, pad], axis=0)
                 with self.mesh:
-                    out = np.asarray(self.model.output(feats))
+                    out = np.asarray(self.model.output(feats))[:total]
                 pos = 0
                 for (x, obs), n in zip(batch, sizes):
                     obs._complete(out[pos:pos + n])

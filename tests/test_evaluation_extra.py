@@ -1,0 +1,117 @@
+"""Exact ROC, ROCBinary, EvaluationCalibration tests (ref: the
+nd4j-evaluation classification suite — SURVEY.md §2.2 Evaluation row,
+VERDICT r2 item 9)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.evaluation import (EvaluationCalibration, ROC,
+                                           ROCBinary)
+
+
+def _auc_reference(y, p):
+    """Exact AUC via the rank statistic (independent formulation)."""
+    y = np.asarray(y, bool)
+    p = np.asarray(p, np.float64)
+    pos, neg = p[y], p[~y]
+    wins = 0.0
+    for v in pos:
+        wins += (v > neg).sum() + 0.5 * (v == neg).sum()
+    return wins / (len(pos) * len(neg))
+
+
+class TestExactROC:
+    def test_exact_auc_matches_rank_statistic(self):
+        rng = np.random.RandomState(0)
+        y = rng.rand(200) > 0.6
+        p = np.clip(y * 0.3 + rng.rand(200) * 0.7, 0, 1)
+        roc = ROC(threshold_steps=0)          # exact mode
+        roc.eval(y.astype(np.float32), p.astype(np.float32))
+        assert roc.calculateAUC() == pytest.approx(_auc_reference(y, p),
+                                                   abs=1e-9)
+
+    def test_exact_beats_stepped_on_clustered_scores(self):
+        """All scores inside one histogram bin: the stepped ROC collapses,
+        the exact ROC still separates them."""
+        y = np.asarray([0, 0, 1, 1], np.float32)
+        p = np.asarray([0.500, 0.501, 0.502, 0.503], np.float32)
+        exact = ROC(0)
+        exact.eval(y, p)
+        assert exact.calculateAUC() == pytest.approx(1.0)
+        stepped = ROC(100)
+        stepped.eval(y, p)
+        assert stepped.calculateAUC() < 1.0   # resolution-limited
+
+    def test_incremental_eval_merges(self):
+        rng = np.random.RandomState(1)
+        y = (rng.rand(300) > 0.5).astype(np.float32)
+        p = np.clip(y * 0.2 + rng.rand(300) * 0.8, 0, 1).astype(np.float32)
+        whole = ROC(0)
+        whole.eval(y, p)
+        a, b = ROC(0), ROC(0)
+        a.eval(y[:150], p[:150])
+        b.eval(y[150:], p[150:])
+        a.merge(b)
+        assert a.calculateAUC() == pytest.approx(whole.calculateAUC())
+
+    def test_aucpr_perfect_separation(self):
+        roc = ROC(0)
+        roc.eval(np.asarray([0, 0, 1, 1], np.float32),
+                 np.asarray([0.1, 0.2, 0.8, 0.9], np.float32))
+        assert roc.calculateAUCPR() == pytest.approx(1.0, abs=1e-6)
+
+
+class TestROCBinary:
+    def test_per_output_and_average(self):
+        rng = np.random.RandomState(2)
+        n = 200
+        y = (rng.rand(n, 3) > 0.5).astype(np.float32)
+        # col 0: perfect, col 1: random, col 2: anti-correlated
+        p = np.stack([y[:, 0] * 0.98 + 0.01,
+                      rng.rand(n),
+                      1.0 - (y[:, 2] * 0.98 + 0.01)], axis=1)
+        rb = ROCBinary(threshold_steps=0)
+        rb.eval(y, p.astype(np.float32))
+        assert rb.numLabels() == 3
+        assert rb.calculateAUC(0) == pytest.approx(1.0)
+        assert 0.35 < rb.calculateAUC(1) < 0.65
+        assert rb.calculateAUC(2) == pytest.approx(0.0)
+        want = np.mean([rb.calculateAUC(i) for i in range(3)])
+        assert rb.calculateAverageAUC() == pytest.approx(want)
+
+
+class TestEvaluationCalibration:
+    def test_perfectly_calibrated(self):
+        rng = np.random.RandomState(3)
+        p = rng.rand(20000)
+        y = (rng.rand(20000) < p).astype(np.float32)   # calibrated by design
+        ec = EvaluationCalibration(reliability_bins=10)
+        ec.eval(y, p.astype(np.float32))
+        mean_p, frac, counts = ec.getReliabilityInfo()
+        np.testing.assert_allclose(mean_p, frac, atol=0.05)
+        assert ec.expectedCalibrationError() < 0.05
+        assert counts.sum() == 20000
+
+    def test_overconfident_model_flagged(self):
+        rng = np.random.RandomState(4)
+        y = (rng.rand(5000) < 0.5).astype(np.float32)   # truth: coin flip
+        p = np.where(rng.rand(5000) < 0.5, 0.95, 0.05)  # claims certainty
+        ec = EvaluationCalibration()
+        ec.eval(y, p.astype(np.float32))
+        assert ec.expectedCalibrationError() > 0.3
+
+    def test_histograms_and_merge(self):
+        rng = np.random.RandomState(5)
+        y = np.eye(2, dtype=np.float32)[rng.randint(0, 2, 100)]
+        p = rng.rand(100, 2).astype(np.float32)
+        a, b = EvaluationCalibration(), EvaluationCalibration()
+        a.eval(y[:50], p[:50])
+        b.eval(y[50:], p[50:])
+        a.merge(b)
+        whole = EvaluationCalibration()
+        whole.eval(y, p)
+        np.testing.assert_array_equal(a.getResidualPlot(),
+                                      whole.getResidualPlot())
+        np.testing.assert_array_equal(a.getProbabilityHistogram(0),
+                                      whole.getProbabilityHistogram(0))
+        assert a.getProbabilityHistogram(1).sum() == 100
