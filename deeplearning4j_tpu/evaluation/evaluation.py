@@ -341,6 +341,11 @@ class ROCBinary:
         return float(np.mean([r.calculateAUC() for r in self._rocs]))
 
     def merge(self, other: "ROCBinary"):
+        if self._rocs and other._rocs and \
+                len(self._rocs) != len(other._rocs):
+            raise ValueError(
+                f"cannot merge ROCBinary with {len(self._rocs)} outputs "
+                f"into one with {len(other._rocs)}")
         if not self._rocs:
             # deep copy: aliasing the other accumulator's ROCs would let a
             # later eval() on self corrupt other's counts
@@ -372,7 +377,12 @@ class EvaluationCalibration:
         p = np.asarray(predictions, np.float64)
         if y.ndim == 1:
             y = y[:, None]
+        if p.ndim == 1:
             p = p[:, None]
+        if y.shape != p.shape:
+            raise ValueError(
+                f"EvaluationCalibration: labels {y.shape} vs predictions "
+                f"{p.shape} (one probability per label output required)")
         C = y.shape[1]
         if self._prob_counts is None:
             self._prob_counts = np.zeros((C, self.hist_bins), np.int64)

@@ -1,0 +1,17 @@
+"""NLP subsystem (ref: deeplearning4j-nlp-parent — SURVEY.md §2.2 "Aux
+NLP"): tokenization, Word2Vec/SequenceVectors/ParagraphVectors with
+device-side negative sampling, word2vec-text serialization."""
+
+from deeplearning4j_tpu.nlp.tokenization import (CommonPreprocessor,
+                                                 DefaultTokenizerFactory,
+                                                 LowCasePreProcessor,
+                                                 NGramTokenizerFactory,
+                                                 TokenizerFactory)
+from deeplearning4j_tpu.nlp.word2vec import (ParagraphVectors,
+                                             SequenceVectors, VocabCache,
+                                             Word2Vec, WordVectorSerializer)
+
+__all__ = ["Word2Vec", "SequenceVectors", "ParagraphVectors", "VocabCache",
+           "WordVectorSerializer", "TokenizerFactory",
+           "DefaultTokenizerFactory", "NGramTokenizerFactory",
+           "CommonPreprocessor", "LowCasePreProcessor"]

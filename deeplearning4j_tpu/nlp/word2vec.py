@@ -1,0 +1,416 @@
+"""Word2Vec / SequenceVectors / ParagraphVectors.
+
+Reference parity: ``org.deeplearning4j.models.word2vec.Word2Vec`` (+
+``SequenceVectors``, ``ParagraphVectors`` of ``deeplearning4j-nlp`` —
+SURVEY.md §2.2 "Aux NLP"): Builder API (minWordFrequency, layerSize,
+windowSize, negative sampling, CBOW/SkipGram), ``VocabCache``,
+``wordsNearest``/``similarity``, and ``WordVectorSerializer`` text format.
+
+TPU-native training: the reference trains with per-thread Hogwild updates
+over JVM arrays; here the whole epoch is (center, context) index batches
+driving ONE compiled XLA step — skip-gram (or CBOW) with negative
+sampling, negatives drawn ON DEVICE from the unigram^0.75 table via
+``jax.random.categorical``, gradients applied with ``segment_sum``
+scatter adds. Embedding tables can be sharded over the mesh's model axis
+via :class:`~deeplearning4j_tpu.parallel.mesh.ShardingRule`
+(``{"emb": ("model",)}`` on the vocab dim) for vocabularies beyond one
+chip's HBM.
+"""
+
+from __future__ import annotations
+
+import os
+from collections import Counter
+from functools import partial
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.tokenization import (CommonPreprocessor,
+                                                 DefaultTokenizerFactory,
+                                                 TokenizerFactory)
+
+
+class VocabCache:
+    """ref: org.deeplearning4j.models.word2vec.wordstore.VocabCache."""
+
+    def __init__(self):
+        self.word2idx: Dict[str, int] = {}
+        self.idx2word: List[str] = []
+        self.counts: List[int] = []
+
+    @staticmethod
+    def build(token_lists: Iterable[List[str]], min_word_frequency: int
+              ) -> "VocabCache":
+        counter: Counter = Counter()
+        for toks in token_lists:
+            counter.update(toks)
+        vc = VocabCache()
+        for w, c in counter.most_common():
+            if c >= min_word_frequency:
+                vc.word2idx[w] = len(vc.idx2word)
+                vc.idx2word.append(w)
+                vc.counts.append(c)
+        return vc
+
+    def numWords(self) -> int:
+        return len(self.idx2word)
+
+    def containsWord(self, w: str) -> bool:
+        return w in self.word2idx
+
+    def indexOf(self, w: str) -> int:
+        return self.word2idx.get(w, -1)
+
+    def wordAtIndex(self, i: int) -> str:
+        return self.idx2word[i]
+
+
+def _pairs_from_ids(ids: np.ndarray, window: int, rng: np.random.RandomState
+                    ) -> Tuple[np.ndarray, np.ndarray]:
+    """(center, context) pairs with the reference's random window shrink."""
+    centers, contexts = [], []
+    n = len(ids)
+    spans = rng.randint(1, window + 1, n)
+    for i in range(n):
+        b = spans[i]
+        for j in range(max(0, i - b), min(n, i + b + 1)):
+            if j != i:
+                centers.append(ids[i])
+                contexts.append(ids[j])
+    return (np.asarray(centers, np.int32), np.asarray(contexts, np.int32))
+
+
+class Word2Vec:
+    """ref: org.deeplearning4j.models.word2vec.Word2Vec."""
+
+    def __init__(self, layer_size=100, window_size=5, min_word_frequency=5,
+                 negative=5, learning_rate=0.025, min_learning_rate=1e-4,
+                 iterations=1, epochs=1, batch_size=512, seed=42,
+                 elements_algo="skipgram", tokenizer: TokenizerFactory = None,
+                 sentence_iter=None):
+        self.layer_size = layer_size
+        self.window = window_size
+        self.min_word_frequency = min_word_frequency
+        self.negative = negative
+        self.lr = learning_rate
+        self.min_lr = min_learning_rate
+        self.iterations = iterations
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.seed = seed
+        self.algo = elements_algo.lower()
+        self.tokenizer = tokenizer or DefaultTokenizerFactory()
+        self.sentences = sentence_iter
+        self.vocab: Optional[VocabCache] = None
+        self.syn0 = None          # input embeddings [V, D]
+        self.syn1 = None          # output embeddings [V, D]
+
+    # ---------------------------------------------------------- Builder API
+    class Builder:
+        def __init__(self):
+            self._kw = {}
+
+        def minWordFrequency(self, v): self._kw["min_word_frequency"] = v; return self
+        def layerSize(self, v): self._kw["layer_size"] = v; return self
+        def windowSize(self, v): self._kw["window_size"] = v; return self
+        def negativeSample(self, v): self._kw["negative"] = int(v); return self
+        def learningRate(self, v): self._kw["learning_rate"] = v; return self
+        def minLearningRate(self, v): self._kw["min_learning_rate"] = v; return self
+        def iterations(self, v): self._kw["iterations"] = v; return self
+        def epochs(self, v): self._kw["epochs"] = v; return self
+        def batchSize(self, v): self._kw["batch_size"] = v; return self
+        def seed(self, v): self._kw["seed"] = v; return self
+        def elementsLearningAlgorithm(self, name):
+            self._kw["elements_algo"] = ("cbow" if "cbow" in str(name).lower()
+                                         else "skipgram")
+            return self
+
+        def tokenizerFactory(self, tf): self._kw["tokenizer"] = tf; return self
+        def iterate(self, sentence_iter):
+            self._kw["sentence_iter"] = sentence_iter
+            return self
+
+        def build(self) -> "Word2Vec":
+            return Word2Vec(**self._kw)
+
+    # ------------------------------------------------------------- training
+    def _token_lists(self) -> List[List[str]]:
+        out = []
+        for sent in self.sentences:
+            out.append(self.tokenizer.create(sent).getTokens())
+        return out
+
+    def fit(self):
+        token_lists = self._token_lists()
+        self.vocab = VocabCache.build(token_lists, self.min_word_frequency)
+        V, D = self.vocab.numWords(), self.layer_size
+        if V == 0:
+            raise ValueError("empty vocabulary (min_word_frequency too high?)")
+        rng = np.random.RandomState(self.seed)
+        self.syn0 = jnp.asarray(
+            (rng.rand(V, D).astype(np.float32) - 0.5) / D)
+        self.syn1 = jnp.zeros((V, D), jnp.float32)
+
+        # unigram^0.75 negative-sampling distribution (reference's table)
+        freq = np.asarray(self.vocab.counts, np.float64) ** 0.75
+        neg_logits = jnp.asarray(np.log(freq / freq.sum()), jnp.float32)
+
+        ids_per_sent = [np.asarray([self.vocab.indexOf(t) for t in toks
+                                    if self.vocab.containsWord(t)], np.int32)
+                        for toks in token_lists]
+
+        step = self._make_step(neg_logits)
+        key = jax.random.PRNGKey(self.seed)
+        total_updates = 0
+        n_steps_est = max(1, self.epochs * self.iterations * sum(
+            max(len(s) - 1, 0) for s in ids_per_sent) * 2 * (
+                (self.window + 1) // 2) // self.batch_size)
+        for _ in range(self.epochs):
+            for _ in range(self.iterations):
+                centers, contexts = [], []
+                for ids in ids_per_sent:
+                    if len(ids) < 2:
+                        continue
+                    c, t = _pairs_from_ids(ids, self.window, rng)
+                    centers.append(c)
+                    contexts.append(t)
+                if not centers:
+                    raise ValueError(
+                        "no training pairs: every sentence has fewer than "
+                        "two in-vocabulary tokens (lower min_word_frequency "
+                        "or provide longer sentences)")
+                centers = np.concatenate(centers)
+                contexts = np.concatenate(contexts)
+                perm = rng.permutation(len(centers))
+                centers, contexts = centers[perm], contexts[perm]
+                bs = self.batch_size
+                for s in range(0, len(centers), bs):
+                    b_c = centers[s:s + bs]
+                    b_t = contexts[s:s + bs]
+                    if len(b_c) < bs:   # pad tail to the compiled batch size
+                        reps = bs - len(b_c)
+                        b_c = np.concatenate([b_c, b_c[:1].repeat(reps)])
+                        b_t = np.concatenate([b_t, b_t[:1].repeat(reps)])
+                    lr = max(self.min_lr,
+                             self.lr * (1 - total_updates / max(n_steps_est, 1)))
+                    key, sub = jax.random.split(key)
+                    self.syn0, self.syn1 = step(
+                        self.syn0, self.syn1, jnp.asarray(b_c),
+                        jnp.asarray(b_t), jnp.asarray(lr, jnp.float32), sub)
+                    total_updates += 1
+        return self
+
+    def _make_step(self, neg_logits):
+        negative = self.negative
+        cbow = self.algo == "cbow"
+
+        @jax.jit
+        def step(syn0, syn1, centers, contexts, lr, key):
+            # skip-gram: input = center, predict context.
+            # CBOW here trains pairwise (context -> center), the
+            # pair-sampled equivalent the reference's CBOW batches reduce to.
+            inp = contexts if cbow else centers
+            out = centers if cbow else contexts
+            neg = jax.random.categorical(
+                key, neg_logits, shape=(inp.shape[0], negative))
+
+            def loss_fn(tables):
+                s0, s1 = tables
+                v = s0[inp]                        # [B, D]
+                u_pos = s1[out]                    # [B, D]
+                u_neg = s1[neg]                    # [B, k, D]
+                pos = jax.nn.log_sigmoid(jnp.sum(v * u_pos, -1))
+                negs = jax.nn.log_sigmoid(
+                    -jnp.einsum("bd,bkd->bk", v, u_neg))
+                return -(pos.mean() + negs.sum(-1).mean())
+
+            grads = jax.grad(loss_fn)((syn0, syn1))
+            return syn0 - lr * grads[0], syn1 - lr * grads[1]
+        return step
+
+    # ------------------------------------------------------------- querying
+    def getWordVectorMatrix(self):
+        return self.syn0
+
+    def getWordVector(self, word: str) -> np.ndarray:
+        i = self.vocab.indexOf(word)
+        if i < 0:
+            raise KeyError(word)
+        return np.asarray(self.syn0[i])
+
+    def hasWord(self, word: str) -> bool:
+        return self.vocab is not None and self.vocab.containsWord(word)
+
+    def similarity(self, a: str, b: str) -> float:
+        va, vb = self.getWordVector(a), self.getWordVector(b)
+        return float(np.dot(va, vb)
+                     / max(np.linalg.norm(va) * np.linalg.norm(vb), 1e-12))
+
+    def wordsNearest(self, word: str, n: int = 10) -> List[str]:
+        i = self.vocab.indexOf(word)
+        if i < 0:
+            raise KeyError(word)
+        V = self.vocab.numWords()   # ignore sharding's zero-padding rows
+        m = np.asarray(self.syn0)[:V]
+        norms = np.linalg.norm(m, axis=1) + 1e-12
+        sims = (m @ m[i]) / (norms * norms[i])
+        order = np.argsort(-sims)
+        return [self.vocab.wordAtIndex(j) for j in order
+                if j != i][:n]
+
+    def shard_over_mesh(self, mesh):
+        """Shard both embedding tables over the mesh's ``model`` axis along
+        the VOCAB dim (ref: the §2.3 'sharded parameter server' row — big
+        vocabularies exceed one chip's HBM; XLA inserts the gathers).
+        The vocab dim is zero-padded up to a multiple of the axis size
+        (padding rows are never indexed: ids < numWords)."""
+        from deeplearning4j_tpu.parallel.mesh import ShardingRule
+        axis = mesh.mesh.shape["model"]
+        V = int(self.syn0.shape[0])
+        padded = -(-V // axis) * axis
+        if padded != V:
+            pad = jnp.zeros((padded - V, self.syn0.shape[1]), self.syn0.dtype)
+            self.syn0 = jnp.concatenate([self.syn0, pad])
+            self.syn1 = jnp.concatenate([self.syn1, pad])
+        rule = ShardingRule({"syn": ("model", None)})
+        sharded = rule.shard_params(mesh, {"syn0": self.syn0,
+                                           "syn1": self.syn1})
+        self.syn0, self.syn1 = sharded["syn0"], sharded["syn1"]
+        return self
+
+
+class SequenceVectors(Word2Vec):
+    """ref: org.deeplearning4j.models.sequencevectors.SequenceVectors —
+    Word2Vec generalized to arbitrary symbol sequences: feed any iterable
+    of whitespace-joined element sequences."""
+
+
+class ParagraphVectors(Word2Vec):
+    """PV-DBOW (ref: org.deeplearning4j.models.paragraphvectors.
+    ParagraphVectors): document vectors trained to predict the document's
+    words with negative sampling; word vectors co-train as in skip-gram."""
+
+    def __init__(self, labels: Sequence[str] = None, **kw):
+        super().__init__(**kw)
+        self.labels = list(labels) if labels else None
+        self.doc_vectors = None
+
+    def fit(self):
+        token_lists = self._token_lists()
+        if self.labels is None:
+            self.labels = [f"DOC_{i}" for i in range(len(token_lists))]
+        if len(self.labels) != len(token_lists):
+            raise ValueError(
+                f"{len(self.labels)} labels for {len(token_lists)} "
+                f"documents (jax gathers would silently clamp the "
+                f"out-of-range doc ids)")
+        super().fit()
+        V, D = self.vocab.numWords(), self.layer_size
+        rng = np.random.RandomState(self.seed + 1)
+        docs = jnp.asarray((rng.rand(len(self.labels), D).astype(np.float32)
+                            - 0.5) / D)
+        freq = np.asarray(self.vocab.counts, np.float64) ** 0.75
+        neg_logits = jnp.asarray(np.log(freq / freq.sum()), jnp.float32)
+        negative = self.negative
+        # doc vectors train against the MEAN-CENTERED word table: the raw
+        # table carries a large shared direction (all similarities
+        # positive) that would dominate every doc's optimum and collapse
+        # the doc space; centering removes it so topical structure wins
+        table = self.syn0 - self.syn0.mean(0)
+
+        @jax.jit
+        def step(docs, doc_ids, word_ids, lr, key):
+            neg = jax.random.categorical(
+                key, neg_logits, shape=(doc_ids.shape[0], negative))
+
+            def loss_fn(dv):
+                v = dv[doc_ids]
+                pos = jax.nn.log_sigmoid(jnp.sum(v * table[word_ids], -1))
+                negs = jax.nn.log_sigmoid(
+                    -jnp.einsum("bd,bkd->bk", v, table[neg]))
+                return -(pos.mean() + negs.sum(-1).mean())
+
+            return docs - lr * jax.grad(loss_fn)(docs)
+
+        key = jax.random.PRNGKey(self.seed + 2)
+        rngp = np.random.RandomState(self.seed + 3)
+        pairs_d, pairs_w = [], []
+        for d, toks in enumerate(token_lists):
+            for t in toks:
+                i = self.vocab.indexOf(t)
+                if i >= 0:
+                    pairs_d.append(d)
+                    pairs_w.append(i)
+        pairs_d = np.asarray(pairs_d, np.int32)
+        pairs_w = np.asarray(pairs_w, np.int32)
+        bs = min(self.batch_size, max(len(pairs_d), 1))
+        for _ in range(self.epochs * 4):
+            perm = rngp.permutation(len(pairs_d))
+            for s in range(0, len(perm) - bs + 1, bs):
+                sel = perm[s:s + bs]
+                key, sub = jax.random.split(key)
+                docs = step(docs, jnp.asarray(pairs_d[sel]),
+                            jnp.asarray(pairs_w[sel]),
+                            jnp.asarray(self.lr, jnp.float32), sub)
+        self.doc_vectors = docs
+        return self
+
+    def getDocVector(self, label: str) -> np.ndarray:
+        return np.asarray(self.doc_vectors[self.labels.index(label)])
+
+    def similarityToLabel(self, text_label_a: str, text_label_b: str) -> float:
+        va = self.getDocVector(text_label_a)
+        vb = self.getDocVector(text_label_b)
+        return float(np.dot(va, vb)
+                     / max(np.linalg.norm(va) * np.linalg.norm(vb), 1e-12))
+
+
+class WordVectorSerializer:
+    """ref: org.deeplearning4j.models.embeddings.loader.WordVectorSerializer
+    — the word2vec TEXT format (one 'word v1 v2 ...' line, optional header)."""
+
+    @staticmethod
+    def writeWord2VecModel(model: Word2Vec, path: str):
+        m = np.asarray(model.syn0)
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        V = model.vocab.numWords()
+        with open(path, "w") as f:
+            f.write(f"{V} {m.shape[1]}\n")
+            for i in range(V):
+                w = model.vocab.wordAtIndex(i)
+                if " " in w:
+                    # the word2vec text format is space-delimited; n-gram
+                    # tokens use the conventional underscore join
+                    w = w.replace(" ", "_")
+                vec = " ".join(f"{v:.6f}" for v in m[i])
+                f.write(f"{w} {vec}\n")
+
+    @staticmethod
+    def readWord2VecModel(path: str) -> Word2Vec:
+        with open(path) as f:
+            first = f.readline().split()
+            has_header = len(first) == 2 and all(p.isdigit() for p in first)
+            rows: List[Tuple[str, np.ndarray]] = []
+            if not has_header:
+                rows.append((first[0],
+                             np.asarray([float(v) for v in first[1:]],
+                                        np.float32)))
+            for line in f:
+                parts = line.rstrip("\n").split(" ")
+                rows.append((parts[0],
+                             np.asarray([float(v) for v in parts[1:]],
+                                        np.float32)))
+        model = Word2Vec(layer_size=len(rows[0][1]))
+        model.vocab = VocabCache()
+        vecs = []
+        for w, v in rows:
+            model.vocab.word2idx[w] = len(model.vocab.idx2word)
+            model.vocab.idx2word.append(w)
+            model.vocab.counts.append(1)
+            vecs.append(v)
+        model.syn0 = jnp.asarray(np.stack(vecs))
+        model.syn1 = jnp.zeros_like(model.syn0)
+        return model

@@ -1,0 +1,135 @@
+"""NLP subsystem tests (ref: deeplearning4j-nlp test shapes: tokenizer
+unit tests, Word2Vec sanity on a structured corpus, serializer
+round-trip — SURVEY.md §2.2 "Aux NLP")."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (CommonPreprocessor,
+                                    DefaultTokenizerFactory,
+                                    NGramTokenizerFactory, ParagraphVectors,
+                                    Word2Vec, WordVectorSerializer)
+
+
+def _corpus(n_sent=300, seed=0):
+    """Two topic clusters with disjoint vocabularies: co-occurrence alone
+    must pull same-topic words together."""
+    rng = np.random.RandomState(seed)
+    animals = ["cat", "dog", "horse", "sheep", "cow"]
+    tech = ["cpu", "gpu", "tpu", "ram", "disk"]
+    sents = []
+    for _ in range(n_sent):
+        pool = animals if rng.rand() < 0.5 else tech
+        sents.append(" ".join(rng.choice(pool, 6)))
+    return sents, animals, tech
+
+
+class TestTokenization:
+    def test_default_tokenizer_with_preprocessor(self):
+        tf = DefaultTokenizerFactory()
+        tf.setTokenPreProcessor(CommonPreprocessor())
+        toks = tf.create("The QUICK, brown fox (2024)!").getTokens()
+        assert toks == ["the", "quick", "brown", "fox"]
+
+    def test_ngram_tokenizer(self):
+        tf = NGramTokenizerFactory(2)
+        toks = tf.create("a b c d").getTokens()
+        assert toks == ["a b", "b c", "c d"]
+
+
+class TestWord2Vec:
+    @pytest.fixture(scope="class")
+    def model(self):
+        sents, animals, tech = _corpus()
+        m = (Word2Vec.Builder()
+             .minWordFrequency(2).layerSize(24).windowSize(3)
+             .negativeSample(4).learningRate(0.3).epochs(25)
+             .batchSize(256).seed(7)
+             .iterate(sents)
+             .tokenizerFactory(DefaultTokenizerFactory())
+             .build())
+        m.fit()
+        return m, animals, tech
+
+    def test_vocab_built(self, model):
+        m, animals, tech = model
+        for w in animals + tech:
+            assert m.hasWord(w)
+        assert m.getWordVector("cat").shape == (24,)
+
+    def test_topic_clusters_separate(self, model):
+        """Same-topic similarity must dominate cross-topic similarity."""
+        m, animals, tech = model
+        same, cross = [], []
+        for a in animals:
+            for b in animals:
+                if a != b:
+                    same.append(m.similarity(a, b))
+            for t in tech:
+                cross.append(m.similarity(a, t))
+        assert np.mean(same) > np.mean(cross) + 0.2, \
+            (np.mean(same), np.mean(cross))
+
+    def test_words_nearest(self, model):
+        m, animals, tech = model
+        near = m.wordsNearest("cat", 4)
+        assert len(set(near) & set(animals)) >= 3, near
+
+    def test_serializer_roundtrip(self, model, tmp_path):
+        m, animals, _ = model
+        p = str(tmp_path / "vecs.txt")
+        WordVectorSerializer.writeWord2VecModel(m, p)
+        m2 = WordVectorSerializer.readWord2VecModel(p)
+        for w in animals:
+            np.testing.assert_allclose(m2.getWordVector(w),
+                                       m.getWordVector(w), atol=1e-5)
+        assert m2.similarity("cat", "dog") == pytest.approx(
+            m.similarity("cat", "dog"), abs=1e-4)
+
+    def test_cbow_variant_trains(self):
+        sents, animals, tech = _corpus(n_sent=120, seed=1)
+        m = (Word2Vec.Builder()
+             .minWordFrequency(2).layerSize(16).windowSize(3)
+             .elementsLearningAlgorithm("CBOW")
+             .epochs(2).batchSize(128).seed(3)
+             .iterate(sents).build())
+        m.fit()
+        assert np.isfinite(np.asarray(m.syn0)).all()
+
+    def test_sharded_embeddings_on_mesh(self, model):
+        import jax
+        from deeplearning4j_tpu.parallel.mesh import DeviceMesh
+        m, animals, _ = model
+        mesh = DeviceMesh.create(data=2, model=4)
+        m.shard_over_mesh(mesh)
+        # still queryable; vocab dim spread over the model axis
+        assert m.similarity("cat", "dog") == m.similarity("cat", "dog")
+        shards = {s.device for s in m.syn0.addressable_shards}
+        assert len(shards) == 8
+
+
+class TestParagraphVectors:
+    def test_doc_vectors_cluster_by_topic(self):
+        rng = np.random.RandomState(2)
+        animals = ["cat", "dog", "horse", "sheep", "cow"]
+        tech = ["cpu", "gpu", "tpu", "ram", "disk"]
+        sents, labels = [], []
+        for i in range(40):
+            pool = animals if i % 2 == 0 else tech
+            sents.append(" ".join(rng.choice(pool, 8)))
+            labels.append(f"DOC_{i}")
+        pv = ParagraphVectors(labels=labels, layer_size=16, window_size=3,
+                              min_word_frequency=1, negative=4,
+                              learning_rate=0.3, epochs=10, batch_size=64,
+                              seed=5, sentence_iter=sents)
+        pv.fit()
+        same, cross = [], []
+        for i in range(0, 40, 2):
+            for j in range(0, 40, 2):
+                if i != j:
+                    same.append(pv.similarityToLabel(f"DOC_{i}", f"DOC_{j}"))
+            for j in range(1, 40, 2):
+                cross.append(pv.similarityToLabel(f"DOC_{i}", f"DOC_{j}"))
+        assert np.mean(same) > np.mean(cross) + 0.15, \
+            (np.mean(same), np.mean(cross))
+        assert pv.getDocVector("DOC_3").shape == (16,)
