@@ -1,0 +1,20 @@
+"""Arbiter — hyperparameter optimization (ref: the ``arbiter`` module of
+the reference monorepo: ``ParameterSpace``, ``CandidateGenerator``
+{Random, GridSearch}, ``OptimizationConfiguration``, ``IOptimizationRunner``
+with score functions — SURVEY.md §2.2 "Aux RL4J + Arbiter")."""
+
+from deeplearning4j_tpu.arbiter.space import (CategoricalSpace,
+                                              ContinuousSpace, DiscreteSpace,
+                                              IntegerSpace, ParameterSpace)
+from deeplearning4j_tpu.arbiter.runner import (CandidateGenerator,
+                                               GridSearchCandidateGenerator,
+                                               OptimizationConfiguration,
+                                               OptimizationResult,
+                                               OptimizationRunner,
+                                               RandomSearchGenerator)
+
+__all__ = ["ParameterSpace", "ContinuousSpace", "IntegerSpace",
+           "DiscreteSpace", "CategoricalSpace", "CandidateGenerator",
+           "RandomSearchGenerator", "GridSearchCandidateGenerator",
+           "OptimizationConfiguration", "OptimizationResult",
+           "OptimizationRunner"]

@@ -1,0 +1,11 @@
+"""RL4J equivalent (ref: the reference's rl4j module — SURVEY.md §2.2
+"Aux RL4J"): MDP interface, built-in CartPole, DQN (QLearningDiscreteDense)
+with experience replay, double-DQN targets, and a compiled TD step."""
+
+from deeplearning4j_tpu.rl.mdp import (CartPole, DiscreteActionSpace, MDP,
+                                       ObservationSpace)
+from deeplearning4j_tpu.rl.dqn import (ExpReplay, QLearningConfiguration,
+                                       QLearningDiscreteDense)
+
+__all__ = ["MDP", "CartPole", "ObservationSpace", "DiscreteActionSpace",
+           "QLearningDiscreteDense", "QLearningConfiguration", "ExpReplay"]

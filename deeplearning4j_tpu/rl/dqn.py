@@ -1,0 +1,233 @@
+"""Deep Q-learning (ref: ``org.deeplearning4j.rl4j.learning.sync.qlearning.
+discrete.QLearningDiscreteDense`` + ``QLearningConfiguration`` +
+``ExpReplay`` — SURVEY.md §2.2 "Aux RL4J").
+
+TPU-native shape: the replay buffer and environment stepping live on the
+host; the TD update (online + target network, Bellman backup, Adam) is
+ONE compiled XLA step over a sampled minibatch. Double-DQN action
+selection; target network sync by period, like the reference's
+``targetDqnUpdateFreq``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.rl.mdp import MDP
+
+
+@dataclass
+class QLearningConfiguration:
+    """ref: QLearning.QLConfiguration."""
+    seed: int = 123
+    max_epoch_step: int = 200
+    max_step: int = 15000
+    exp_repeat: int = 1
+    batch_size: int = 64
+    target_dqn_update_freq: int = 200
+    update_start: int = 500
+    reward_factor: float = 1.0
+    gamma: float = 0.99
+    error_clamp: float = 1.0
+    min_epsilon: float = 0.05
+    epsilon_nb_step: int = 3000
+    exp_replay_size: int = 10000
+    learning_rate: float = 1e-3
+    double_dqn: bool = True
+
+
+class ExpReplay:
+    """Uniform ring-buffer replay (ref: org.deeplearning4j.rl4j.util
+    ExpReplay)."""
+
+    def __init__(self, capacity: int, obs_dim: int, seed: int):
+        self.capacity = capacity
+        self._rng = np.random.RandomState(seed)
+        self.obs = np.zeros((capacity, obs_dim), np.float32)
+        self.next_obs = np.zeros((capacity, obs_dim), np.float32)
+        self.actions = np.zeros(capacity, np.int32)
+        self.rewards = np.zeros(capacity, np.float32)
+        self.dones = np.zeros(capacity, np.float32)
+        self._n = 0
+        self._pos = 0
+
+    def store(self, s, a, r, s2, done):
+        i = self._pos
+        self.obs[i] = s
+        self.actions[i] = a
+        self.rewards[i] = r
+        self.next_obs[i] = s2
+        self.dones[i] = float(done)
+        self._pos = (i + 1) % self.capacity
+        self._n = min(self._n + 1, self.capacity)
+
+    def __len__(self):
+        return self._n
+
+    def getBatch(self, size: int):
+        idx = self._rng.randint(0, self._n, size)
+        return (self.obs[idx], self.actions[idx], self.rewards[idx],
+                self.next_obs[idx], self.dones[idx])
+
+
+def _mlp_init(rng: np.random.RandomState, sizes: List[int]) -> Dict:
+    params = {}
+    for i in range(len(sizes) - 1):
+        lim = np.sqrt(6.0 / (sizes[i] + sizes[i + 1]))
+        params[f"W{i}"] = jnp.asarray(
+            rng.uniform(-lim, lim, (sizes[i], sizes[i + 1])).astype(np.float32))
+        params[f"b{i}"] = jnp.zeros(sizes[i + 1], jnp.float32)
+    return params
+
+
+def _mlp_apply(params: Dict, x, n_layers: int):
+    for i in range(n_layers):
+        x = x @ params[f"W{i}"] + params[f"b{i}"]
+        if i < n_layers - 1:
+            x = jax.nn.relu(x)
+    return x
+
+
+class QLearningDiscreteDense:
+    """ref: QLearningDiscreteDense — DQN over a dense MLP Q-network."""
+
+    def __init__(self, mdp: MDP, conf: QLearningConfiguration = None,
+                 hidden: Tuple[int, ...] = (64, 64)):
+        self.mdp = mdp
+        self.conf = conf or QLearningConfiguration()
+        self.obs_dim = int(np.prod(mdp.getObservationSpace().shape))
+        self.n_actions = mdp.getActionSpace().n
+        rng = np.random.RandomState(self.conf.seed)
+        sizes = [self.obs_dim, *hidden, self.n_actions]
+        self._n_layers = len(sizes) - 1
+        self.params = _mlp_init(rng, sizes)
+        self.target_params = jax.tree_util.tree_map(lambda a: a, self.params)
+        self.opt_state = jax.tree_util.tree_map(
+            lambda p: (jnp.zeros_like(p), jnp.zeros_like(p)), self.params)
+        self.replay = ExpReplay(self.conf.exp_replay_size, self.obs_dim,
+                                self.conf.seed + 1)
+        self._rng = np.random.RandomState(self.conf.seed + 2)
+        self._step_fn = self._make_td_step()
+        self._q_fn = jax.jit(lambda p, x: _mlp_apply(p, x, self._n_layers))
+        self.episode_rewards: List[float] = []
+
+    # ------------------------------------------------------------- td step
+    def _make_td_step(self):
+        gamma = self.conf.gamma
+        clamp = self.conf.error_clamp
+        lr = self.conf.learning_rate
+        nl = self._n_layers
+        double = self.conf.double_dqn
+        b1, b2, eps = 0.9, 0.999, 1e-8
+
+        @jax.jit
+        def step(params, target_params, opt_state, t, s, a, r, s2, done):
+            q_next_t = _mlp_apply(target_params, s2, nl)
+            if double:
+                a_star = jnp.argmax(_mlp_apply(params, s2, nl), axis=1)
+                q_next = jnp.take_along_axis(q_next_t, a_star[:, None],
+                                             1)[:, 0]
+            else:
+                q_next = jnp.max(q_next_t, axis=1)
+            y = r + gamma * (1.0 - done) * q_next
+
+            def loss_fn(p):
+                q = _mlp_apply(p, s, nl)
+                q_sa = jnp.take_along_axis(q, a[:, None], 1)[:, 0]
+                err = jnp.clip(q_sa - y, -clamp, clamp)   # ref: errorClamp
+                return jnp.mean(err * (q_sa - y))
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+
+            def adam(p, g, st):
+                m, v = st
+                m = b1 * m + (1 - b1) * g
+                v = b2 * v + (1 - b2) * g * g
+                mh = m / (1 - b1 ** t)
+                vh = v / (1 - b2 ** t)
+                return p - lr * mh / (jnp.sqrt(vh) + eps), (m, v)
+
+            new_p, new_s = {}, {}
+            for k in params:
+                new_p[k], new_s[k] = adam(params[k], grads[k], opt_state[k])
+            return new_p, new_s, loss
+
+        return step
+
+    # ------------------------------------------------------------ epsilon
+    def _epsilon(self, step: int) -> float:
+        c = self.conf
+        frac = min(1.0, step / max(c.epsilon_nb_step, 1))
+        return 1.0 + frac * (c.min_epsilon - 1.0)
+
+    def _act(self, obs, step: int) -> int:
+        if self._rng.rand() < self._epsilon(step):
+            return self.mdp.getActionSpace().randomAction(self._rng)
+        q = np.asarray(self._q_fn(self.params,
+                                  jnp.asarray(np.ravel(obs)[None])))
+        return int(q[0].argmax())
+
+    # ------------------------------------------------------------- training
+    def train(self) -> "QLearningDiscreteDense":
+        c = self.conf
+        total = 0
+        updates = 0
+        while total < c.max_step:
+            obs = self.mdp.reset()
+            ep_reward = 0.0
+            for _ in range(c.max_epoch_step):
+                a = self._act(obs, total)
+                nxt, r, done = self.mdp.step(a)
+                self.replay.store(np.ravel(obs), a, r * c.reward_factor,
+                                  np.ravel(nxt), done)
+                obs = nxt
+                ep_reward += r
+                total += 1
+                if total >= c.update_start and len(self.replay) >= c.batch_size:
+                    s, aa, rr, s2, dd = self.replay.getBatch(c.batch_size)
+                    updates += 1
+                    self.params, self.opt_state, _ = self._step_fn(
+                        self.params, self.target_params, self.opt_state,
+                        jnp.asarray(updates, jnp.float32), jnp.asarray(s),
+                        jnp.asarray(aa), jnp.asarray(rr), jnp.asarray(s2),
+                        jnp.asarray(dd))
+                    if updates % c.target_dqn_update_freq == 0:
+                        self.target_params = jax.tree_util.tree_map(
+                            lambda a_: a_, self.params)
+                if done or total >= c.max_step:
+                    break
+            self.episode_rewards.append(ep_reward)
+        return self
+
+    # ------------------------------------------------------------- policy
+    def getPolicy(self):
+        """Greedy policy over the trained Q-network (ref: DQNPolicy)."""
+        def policy(obs) -> int:
+            q = np.asarray(self._q_fn(self.params,
+                                      jnp.asarray(np.ravel(obs)[None])))
+            return int(q[0].argmax())
+        return policy
+
+    def evaluate(self, episodes: int = 10,
+                 max_steps: Optional[int] = None) -> float:
+        """Average greedy-policy return; episodes are CAPPED (an MDP with
+        no internal terminal guarantee must not hang the evaluator)."""
+        cap = max_steps if max_steps is not None \
+            else 10 * self.conf.max_epoch_step
+        policy = self.getPolicy()
+        totals = []
+        for _ in range(episodes):
+            obs = self.mdp.reset()
+            tot = 0.0
+            for _ in range(cap):
+                obs, r, done = self.mdp.step(policy(obs))
+                tot += r
+                if done:
+                    break
+            totals.append(tot)
+        return float(np.mean(totals))

@@ -1,0 +1,156 @@
+"""RL (DQN/CartPole) + Arbiter (hyperparameter search) tests
+(ref: rl4j's QLearningDiscreteDense cartpole smoke + arbiter's
+LocalOptimizationRunner tests — SURVEY.md §2.2 "Aux RL4J + Arbiter")."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.arbiter import (ContinuousSpace, DiscreteSpace,
+                                        GridSearchCandidateGenerator,
+                                        IntegerSpace,
+                                        OptimizationConfiguration,
+                                        OptimizationRunner,
+                                        RandomSearchGenerator)
+from deeplearning4j_tpu.rl import (CartPole, ExpReplay,
+                                   QLearningConfiguration,
+                                   QLearningDiscreteDense)
+
+
+class TestCartPole:
+    def test_dynamics_and_termination(self):
+        env = CartPole(seed=0)
+        obs = env.reset()
+        assert obs.shape == (4,)
+        assert env.getActionSpace().n == 2
+        total, steps = 0.0, 0
+        done = False
+        while not done:
+            obs, r, done = env.step(1)   # constant push falls over quickly
+            total += r
+            steps += 1
+        assert steps < CartPole.MAX_STEPS   # constant action must fail early
+        assert total == steps               # +1 per step
+
+    def test_episode_caps_at_max_steps(self):
+        env = CartPole(seed=1)
+        env.reset()
+        # alternating push keeps it up a while but MAX_STEPS caps any run
+        done, steps = False, 0
+        while not done and steps < 500:
+            _, _, done = env.step(steps % 2)
+            steps += 1
+        assert steps <= CartPole.MAX_STEPS
+
+
+class TestExpReplay:
+    def test_ring_buffer_and_sampling(self):
+        rep = ExpReplay(capacity=8, obs_dim=3, seed=0)
+        for i in range(12):          # wraps past capacity
+            rep.store(np.full(3, i, np.float32), i % 2, float(i),
+                      np.full(3, i + 1, np.float32), i % 3 == 0)
+        assert len(rep) == 8
+        s, a, r, s2, d = rep.getBatch(16)
+        assert s.shape == (16, 3) and a.shape == (16,)
+        assert r.min() >= 4.0        # oldest entries overwritten
+
+
+class TestDQN:
+    def test_learns_cartpole(self):
+        mdp = CartPole(seed=0)
+        conf = QLearningConfiguration(
+            seed=1, max_step=6000, epsilon_nb_step=2500, update_start=300,
+            target_dqn_update_freq=250, learning_rate=1e-3, batch_size=64)
+        dqn = QLearningDiscreteDense(mdp, conf, hidden=(48, 48)).train()
+        avg = dqn.evaluate(10)
+        # random policy averages ~20 steps; learned policy must do far better
+        assert avg > 80.0, avg
+
+    def test_policy_is_greedy_and_deterministic(self):
+        mdp = CartPole(seed=3)
+        conf = QLearningConfiguration(seed=2, max_step=400, update_start=100,
+                                      batch_size=32)
+        dqn = QLearningDiscreteDense(mdp, conf, hidden=(16,)).train()
+        policy = dqn.getPolicy()
+        obs = mdp.reset()
+        assert policy(obs) == policy(obs)
+        assert policy(obs) in (0, 1)
+
+
+class TestArbiter:
+    def test_grid_search_covers_product(self):
+        gen = GridSearchCandidateGenerator(
+            {"lr": ContinuousSpace(0.1, 0.3), "units": DiscreteSpace([8, 16])},
+            discretization_count=3)
+        cands = list(gen)
+        assert len(cands) == 6
+        assert {c["units"] for c in cands} == {8, 16}
+
+    def test_random_search_respects_spaces(self):
+        gen = RandomSearchGenerator(
+            {"lr": ContinuousSpace(1e-4, 1e-1, log=True),
+             "n": IntegerSpace(2, 5)}, seed=0)
+        it = iter(gen)
+        for _ in range(20):
+            c = next(it)
+            assert 1e-4 <= c["lr"] <= 1e-1
+            assert 2 <= c["n"] <= 5
+
+    def test_runner_finds_known_optimum(self):
+        # score surface with a known minimum at lr=0.2, units=16
+        def score(cand):
+            return (cand["lr"] - 0.2) ** 2 + (0.1 if cand["units"] != 16 else 0)
+
+        runner = OptimizationRunner(OptimizationConfiguration(
+            candidate_generator=GridSearchCandidateGenerator(
+                {"lr": ContinuousSpace(0.0, 0.4),
+                 "units": DiscreteSpace([8, 16])}, discretization_count=5),
+            score_function=score, max_candidates=10, minimize=True))
+        best = runner.execute()
+        assert best.candidate["lr"] == pytest.approx(0.2)
+        assert best.candidate["units"] == 16
+        assert runner.numCandidatesCompleted() == 10
+
+    def test_runner_trains_real_networks(self):
+        """End-to-end: search learning rates for a real MultiLayerNetwork
+        on a toy problem (the reference's MLPHyperparameterOptimization
+        example shape)."""
+        from deeplearning4j_tpu.data.dataset import DataSet
+        from deeplearning4j_tpu.nn.config import (InputType,
+                                                  NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.train import updaters
+
+        rng = np.random.RandomState(0)
+        x = rng.randn(64, 4).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[(x.sum(1) > 0).astype(int)]
+        ds = DataSet(x, y)
+
+        def score(cand):
+            conf = (NeuralNetConfiguration.Builder().seed(7)
+                    .updater(updaters.Adam(cand["lr"])).list()
+                    .layer(DenseLayer(nOut=8, activation="relu"))
+                    .layer(OutputLayer(nOut=2, lossFunction="mcxent",
+                                       activation="softmax"))
+                    .setInputType(InputType.feedForward(4)).build())
+            net = MultiLayerNetwork(conf).init()
+            for _ in range(15):
+                net.fit(ds)
+            return float(net.score()), net
+
+        runner = OptimizationRunner(OptimizationConfiguration(
+            candidate_generator=DiscreteSearch({"lr": [1e-5, 3e-2]}),
+            score_function=score, max_candidates=2, minimize=True,
+            keep_models=True))
+        best = runner.execute()
+        assert best.candidate["lr"] == pytest.approx(3e-2)  # 1e-5 barely moves
+        assert best.model is not None
+
+
+def DiscreteSearch(space_values):
+    """Tiny helper: exhaustive generator over explicit value lists."""
+    from deeplearning4j_tpu.arbiter import (DiscreteSpace,
+                                            GridSearchCandidateGenerator)
+    return GridSearchCandidateGenerator(
+        {k: DiscreteSpace(v) for k, v in space_values.items()},
+        discretization_count=max(len(v) for v in space_values.values()))
