@@ -14,11 +14,14 @@ The ``detail`` field carries the full BASELINE.md metric set:
 - ``gemm``: large square bf16 matmul, TFLOP/s and % of MXU peak
 - ``resnet50``: fwd+bwd img/s/chip through the ComputationGraph train
   step + MFU on the 3 x 4.1 GFLOP/img basis (BASELINE.md)
+- ``vgg16`` / ``tiny_yolo``: same protocol over the other BASELINE CNN
+  rows (15.5 / 3.5 GFLOP-fwd bases)
 - ``dp_scaling``: measured only when >1 real device is attached (a
   virtual CPU mesh on one host measures host contention, not scaling)
 
 Run: ``python bench.py`` (``--quick`` = small configs for CI;
-``--skip-resnet`` / ``--skip-gemm`` / ``--skip-scaling`` to bisect).
+``--skip-resnet`` / ``--skip-gemm`` / ``--skip-extra-cnn`` /
+``--skip-scaling`` to bisect).
 """
 
 import json
@@ -126,7 +129,6 @@ def bench_bert(quick: bool = False):
 def bench_resnet50(quick: bool = False):
     """ResNet-50 fwd+bwd through the ComputationGraph compiled train step
     (BASELINE.md north-star row; img/s/chip + MFU on 3 x 4.1 GFLOP/img)."""
-    from deeplearning4j_tpu.data.dataset import DataSet
     from deeplearning4j_tpu.models import zoo
 
     if quick:
@@ -137,25 +139,59 @@ def bench_resnet50(quick: bool = False):
     # assumes MXU-native precision; BN stats/loss/updater stay fp32)
     net = zoo.ResNet50(num_classes=1000, input_shape=(3, hw, hw),
                        dtype="bfloat16").init()
+    # 4.1 GFLOP fwd per 224^2 image; scale by resolution for --quick
+    return _bench_cnn_train(net, batch, hw, steps,
+                            4.1e9 * (hw / 224.0) ** 2)
+
+
+def _bench_cnn_train(net, batch, hw, steps, fwd_flops_per_img, n_classes=1000,
+                     label_grid=None):
+    """Shared fwd+bwd timing loop for CNN zoo models."""
+    from deeplearning4j_tpu.data.dataset import DataSet
     rng = np.random.RandomState(0)
-    # stage the batch on-device once: the bench measures the train step, not
-    # host->device transfer through the tunneled backend
     x = jnp.asarray(rng.randn(batch, 3, hw, hw).astype(np.float32))
-    y = jnp.asarray(np.eye(1000, dtype=np.float32)[rng.randint(0, 1000, batch)])
+    if label_grid is not None:
+        # empty-object YOLO label grid: numerically safe, same FLOPs
+        y = jnp.zeros((batch,) + tuple(label_grid), jnp.float32)
+    else:
+        y = jnp.asarray(np.eye(n_classes, dtype=np.float32)[
+            rng.randint(0, n_classes, batch)])
     ds = DataSet(x, y)
-    net.fit(ds)  # compile + warmup
+    net.fit(ds)
     float(net.score())
     t0 = time.perf_counter()
     for _ in range(steps):
         net.fit(ds)
-    float(net.score())  # sync: score depends on the whole step chain
+    float(net.score())
     dt = time.perf_counter() - t0
     img_per_sec = steps * batch / dt
-    # 4.1 GFLOP fwd per 224^2 image; scale by resolution for --quick
-    fwd_flops = 4.1e9 * (hw / 224.0) ** 2
-    mfu = img_per_sec * 3.0 * fwd_flops / PEAK_TFLOPS
+    mfu = img_per_sec * 3.0 * fwd_flops_per_img / PEAK_TFLOPS
     return {"img_per_sec": round(img_per_sec, 2), "mfu": round(mfu, 4),
             "batch": batch, "hw": hw, "steps": steps}
+
+
+def bench_vgg16(quick: bool = False):
+    """VGG16 train img/s (the BASELINE 'not yet benchmarked' row).
+    ~15.5 GFLOP fwd per 224^2 image."""
+    from deeplearning4j_tpu.models import zoo
+    batch, hw, steps = (4, 64, 2) if quick else (64, 224, 4)
+    net = zoo.VGG16(num_classes=1000, input_shape=(3, hw, hw),
+                    dtype="bfloat16").init()
+    return _bench_cnn_train(net, batch, hw, steps,
+                            15.5e9 * (hw / 224.0) ** 2)
+
+
+def bench_tinyyolo(quick: bool = False):
+    """TinyYOLO train img/s (the BASELINE 'not yet benchmarked' row).
+    ~3.5 GFLOP fwd per 416^2 image (darknet-tiny backbone)."""
+    from deeplearning4j_tpu.models import zoo
+    batch, hw, steps = (4, 64, 2) if quick else (32, 416, 4)
+    net = zoo.TinyYOLO(num_classes=20, input_shape=(3, hw, hw),
+                       dtype="bfloat16").init()
+    grid = hw // 32
+    return _bench_cnn_train(net, batch, hw, steps,
+                            3.5e9 * (hw / 416.0) ** 2,
+                            label_grid=(24, grid, grid))
 
 
 def bench_dp_scaling(bert_1chip_samples_per_sec, quick: bool = False):
@@ -210,6 +246,9 @@ def main(argv):
     detail["bert"] = bert
     if "--skip-resnet" not in argv:
         detail["resnet50"] = bench_resnet50(quick)
+    if "--skip-extra-cnn" not in argv:
+        detail["vgg16"] = bench_vgg16(quick)
+        detail["tiny_yolo"] = bench_tinyyolo(quick)
     if "--skip-scaling" not in argv:
         detail["dp_scaling"] = bench_dp_scaling(bert["samples_per_sec"], quick)
 

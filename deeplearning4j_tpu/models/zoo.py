@@ -705,3 +705,194 @@ class YOLO2(ZooModel):
                    "conv_out")
         g.setOutputs("yolo")
         return ComputationGraph(g.build())
+
+
+class InceptionResNetV1(ZooModel):
+    """ref: zoo.model.InceptionResNetV1 (the FaceNet backbone) — stem +
+    residual inception blocks A/B/C with residual scaling via ScaleVertex,
+    reduction blocks between stages (block counts shortened 5/10/5 ->
+    2/3/2 for practicality; identical structure)."""
+
+    def default_input_shape(self):
+        return (3, 160, 160)
+
+    def _scaled_residual(self, g, pref, inp, branches, n_out, scale):
+        from deeplearning4j_tpu.nn.graph import ScaleVertex
+        outs = []
+        for bi, branch in enumerate(branches):
+            cur = inp
+            for li, (k, n, s, p) in enumerate(branch):
+                g.addLayer(f"{pref}_b{bi}_c{li}",
+                           ConvolutionLayer(kernelSize=(k, k), stride=(s, s),
+                                            padding=(p, p), nOut=n,
+                                            activation="relu"), cur)
+                cur = f"{pref}_b{bi}_c{li}"
+            outs.append(cur)
+        if len(outs) > 1:
+            g.addVertex(f"{pref}_cat", MergeVertex(), *outs)
+            cat = f"{pref}_cat"
+        else:
+            cat = outs[0]
+        g.addLayer(f"{pref}_up", ConvolutionLayer(kernelSize=(1, 1),
+                                                  nOut=n_out,
+                                                  activation="identity"), cat)
+        g.addVertex(f"{pref}_scale", ScaleVertex(scale), f"{pref}_up")
+        g.addVertex(f"{pref}_add", ElementWiseVertex("Add"), inp,
+                    f"{pref}_scale")
+        g.addLayer(f"{pref}_out", ActivationLayer("relu"), f"{pref}_add")
+        return f"{pref}_out"
+
+    def conf_builder(self) -> ComputationGraph:
+        c, h, w = self.input_shape
+        g = (NeuralNetConfiguration.Builder()
+             .seed(self.seed).updater(self.updater).weightInit("relu")
+             .graphBuilder()
+             .addInputs("input")
+             .setInputTypes(InputType.convolutional(h, w, c)))
+        # stem (ref: 3x conv -> maxpool -> 2x conv -> conv stride 2)
+        g.addLayer("s1", ConvolutionLayer(kernelSize=(3, 3), stride=(2, 2),
+                                          nOut=32, activation="relu"), "input")
+        g.addLayer("s2", ConvolutionLayer(kernelSize=(3, 3), nOut=32,
+                                          activation="relu"), "s1")
+        g.addLayer("s3", ConvolutionLayer(kernelSize=(3, 3), padding=(1, 1),
+                                          nOut=64, activation="relu"), "s2")
+        g.addLayer("s_pool", SubsamplingLayer(poolingType="max",
+                                              kernelSize=(3, 3), stride=(2, 2)),
+                   "s3")
+        g.addLayer("s4", ConvolutionLayer(kernelSize=(1, 1), nOut=80,
+                                          activation="relu"), "s_pool")
+        g.addLayer("s5", ConvolutionLayer(kernelSize=(3, 3), nOut=192,
+                                          activation="relu"), "s4")
+        g.addLayer("s6", ConvolutionLayer(kernelSize=(3, 3), stride=(2, 2),
+                                          nOut=256, activation="relu"), "s5")
+        last = "s6"
+        # inception-resnet-A x2 (scale 0.17)
+        for i in range(2):
+            last = self._scaled_residual(
+                g, f"irA{i}", last,
+                branches=[[(1, 32, 1, 0)],
+                          [(1, 32, 1, 0), (3, 32, 1, 1)],
+                          [(1, 32, 1, 0), (3, 32, 1, 1), (3, 32, 1, 1)]],
+                n_out=256, scale=0.17)
+        # reduction-A
+        g.addLayer("redA_c", ConvolutionLayer(kernelSize=(3, 3), stride=(2, 2),
+                                              nOut=384, activation="relu"),
+                   last)
+        g.addLayer("redA_p", SubsamplingLayer(poolingType="max",
+                                              kernelSize=(3, 3),
+                                              stride=(2, 2)), last)
+        g.addVertex("redA", MergeVertex(), "redA_c", "redA_p")
+        last = "redA"
+        # inception-resnet-B x3 (scale 0.10), input channels 640
+        for i in range(3):
+            last = self._scaled_residual(
+                g, f"irB{i}", last,
+                branches=[[(1, 128, 1, 0)],
+                          [(1, 128, 1, 0), (7, 128, 1, 3)]],
+                n_out=640, scale=0.10)
+        # reduction-B
+        g.addLayer("redB_c", ConvolutionLayer(kernelSize=(3, 3), stride=(2, 2),
+                                              nOut=256, activation="relu"),
+                   last)
+        g.addLayer("redB_p", SubsamplingLayer(poolingType="max",
+                                              kernelSize=(3, 3),
+                                              stride=(2, 2)), last)
+        g.addVertex("redB", MergeVertex(), "redB_c", "redB_p")
+        last = "redB"
+        # inception-resnet-C x2 (scale 0.20), input channels 896
+        for i in range(2):
+            last = self._scaled_residual(
+                g, f"irC{i}", last,
+                branches=[[(1, 192, 1, 0)],
+                          [(1, 192, 1, 0), (3, 192, 1, 1)]],
+                n_out=896, scale=0.20)
+        g.addLayer("gap", GlobalPoolingLayer("avg"), last)
+        g.addLayer("bottleneck", DenseLayer(nOut=128, activation="identity"),
+                   "gap")   # the FaceNet embedding layer
+        from deeplearning4j_tpu.nn.graph import L2NormalizeVertex
+        g.addVertex("embeddings", L2NormalizeVertex(), "bottleneck")
+        g.addLayer("out", OutputLayer(nOut=self.num_classes,
+                                      lossFunction="mcxent",
+                                      activation="softmax"), "embeddings")
+        g.setOutputs("out")
+        return ComputationGraph(g.build())
+
+
+class NASNet(ZooModel):
+    """ref: zoo.model.NASNet (NASNet-A mobile) — separable-conv normal
+    cells with residual adds and reduction cells between stages (the
+    learned 5-op cell simplified to its dominant separable-conv pair
+    structure; 4/4/4 -> 2/2/2 cells for practicality)."""
+
+    PENULTIMATE = 1056
+
+    def default_input_shape(self):
+        return (3, 224, 224)
+
+    def _normal_cell(self, g, pref, inp, filters):
+        # two stacked sep-convs per branch + residual add (the repeated
+        # motif of the learned NASNet-A normal cell)
+        g.addLayer(f"{pref}_adj", ConvolutionLayer(kernelSize=(1, 1),
+                                                   nOut=filters,
+                                                   activation="relu"), inp)
+        a = f"{pref}_adj"
+        g.addLayer(f"{pref}_s1a", SeparableConvolution2D(
+            kernelSize=(5, 5), padding=(2, 2), nOut=filters,
+            activation="relu"), a)
+        g.addLayer(f"{pref}_s1b", SeparableConvolution2D(
+            kernelSize=(3, 3), padding=(1, 1), nOut=filters,
+            activation="identity"), f"{pref}_s1a")
+        g.addVertex(f"{pref}_add1", ElementWiseVertex("Add"), f"{pref}_s1b", a)
+        g.addLayer(f"{pref}_s2a", SeparableConvolution2D(
+            kernelSize=(3, 3), padding=(1, 1), nOut=filters,
+            activation="relu"), f"{pref}_add1")
+        g.addVertex(f"{pref}_add2", ElementWiseVertex("Add"),
+                    f"{pref}_s2a", f"{pref}_add1")
+        g.addLayer(f"{pref}_out", ActivationLayer("relu"), f"{pref}_add2")
+        return f"{pref}_out"
+
+    def _reduction_cell(self, g, pref, inp, filters):
+        g.addLayer(f"{pref}_s5", SeparableConvolution2D(
+            kernelSize=(5, 5), stride=(2, 2), padding=(2, 2), nOut=filters,
+            activation="relu"), inp)
+        g.addLayer(f"{pref}_s7", SeparableConvolution2D(
+            kernelSize=(7, 7), stride=(2, 2), padding=(3, 3), nOut=filters,
+            activation="relu"), inp)
+        g.addLayer(f"{pref}_mp", SubsamplingLayer(
+            poolingType="max", kernelSize=(3, 3), stride=(2, 2),
+            padding=(1, 1)), inp)
+        g.addLayer(f"{pref}_mpc", ConvolutionLayer(
+            kernelSize=(1, 1), nOut=filters, activation="relu"), f"{pref}_mp")
+        g.addVertex(f"{pref}_add", ElementWiseVertex("Add"),
+                    f"{pref}_s5", f"{pref}_s7")
+        g.addVertex(f"{pref}_cat", MergeVertex(), f"{pref}_add", f"{pref}_mpc")
+        return f"{pref}_cat"
+
+    def conf_builder(self) -> ComputationGraph:
+        c, h, w = self.input_shape
+        g = (NeuralNetConfiguration.Builder()
+             .seed(self.seed).updater(self.updater).weightInit("relu")
+             .graphBuilder()
+             .addInputs("input")
+             .setInputTypes(InputType.convolutional(h, w, c)))
+        g.addLayer("stem", ConvolutionLayer(kernelSize=(3, 3), stride=(2, 2),
+                                            nOut=32, activation="relu"),
+                   "input")
+        g.addLayer("stem_bn", BatchNormalization(), "stem")
+        last = "stem_bn"
+        filters = 44                     # NASNet-A mobile penultimate path
+        for stage in range(3):
+            for i in range(2):
+                last = self._normal_cell(g, f"n{stage}_{i}", last, filters)
+            if stage < 2:
+                last = self._reduction_cell(g, f"r{stage}", last, filters * 2)
+                filters *= 2
+        g.addLayer("head", ConvolutionLayer(kernelSize=(1, 1),
+                                            nOut=self.PENULTIMATE,
+                                            activation="relu"), last)
+        g.addLayer("gap", GlobalPoolingLayer("avg"), "head")
+        g.addLayer("out", OutputLayer(nOut=self.num_classes,
+                                      lossFunction="mcxent",
+                                      activation="softmax"), "gap")
+        g.setOutputs("out")
+        return ComputationGraph(g.build())

@@ -148,6 +148,8 @@ class TestZoo:
         (zoo.ResNet50, {"num_classes": 7, "input_shape": (3, 64, 64)}),
         (zoo.SqueezeNet, {"num_classes": 7, "input_shape": (3, 64, 64)}),
         (zoo.FaceNetNN4Small2, {"num_classes": 7, "input_shape": (3, 64, 64)}),
+        (zoo.InceptionResNetV1, {"num_classes": 7, "input_shape": (3, 96, 96)}),
+        (zoo.NASNet, {"num_classes": 7, "input_shape": (3, 64, 64)}),
     ])
     def test_graph_models_forward(self, model_cls, kwargs):
         net = model_cls(seed=42, **kwargs).init()
