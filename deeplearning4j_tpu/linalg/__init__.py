@@ -1,3 +1,9 @@
 from deeplearning4j_tpu.linalg.dtypes import DataType  # noqa: F401
 from deeplearning4j_tpu.linalg.ndarray import NDArray  # noqa: F401
 from deeplearning4j_tpu.linalg import factory as nd  # noqa: F401
+from deeplearning4j_tpu.linalg.conditions import (  # noqa: F401
+    BooleanIndexing,
+    Condition,
+    Conditions,
+)
+from deeplearning4j_tpu.linalg import transforms as Transforms  # noqa: F401

@@ -1,0 +1,109 @@
+"""Conditions + BooleanIndexing (ref: ``org.nd4j.linalg.indexing.
+conditions.Conditions`` and ``BooleanIndexing`` — SURVEY.md §2.2 L1).
+
+A Condition is a predicate producing a boolean mask over an array;
+BooleanIndexing applies them (replaceWhere / countOccurrences / and/or)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class Condition:
+    def __init__(self, fn):
+        self._fn = fn
+
+    def mask(self, value) -> jnp.ndarray:
+        return self._fn(value)
+
+    def __and__(self, other: "Condition") -> "Condition":
+        return Condition(lambda v: jnp.logical_and(self.mask(v),
+                                                   other.mask(v)))
+
+    def __or__(self, other: "Condition") -> "Condition":
+        return Condition(lambda v: jnp.logical_or(self.mask(v),
+                                                  other.mask(v)))
+
+    def __invert__(self) -> "Condition":
+        return Condition(lambda v: jnp.logical_not(self.mask(v)))
+
+
+class Conditions:
+    """ref: Conditions.{greaterThan, lessThan, ...} static factories."""
+
+    @staticmethod
+    def greaterThan(x): return Condition(lambda v: v > x)
+
+    @staticmethod
+    def greaterThanOrEqual(x): return Condition(lambda v: v >= x)
+
+    @staticmethod
+    def lessThan(x): return Condition(lambda v: v < x)
+
+    @staticmethod
+    def lessThanOrEqual(x): return Condition(lambda v: v <= x)
+
+    @staticmethod
+    def equals(x): return Condition(lambda v: v == x)
+
+    @staticmethod
+    def notEquals(x): return Condition(lambda v: v != x)
+
+    @staticmethod
+    def epsEquals(x, eps: float = 1e-5):
+        return Condition(lambda v: jnp.abs(v - x) <= eps)
+
+    @staticmethod
+    def epsNotEquals(x, eps: float = 1e-5):
+        return Condition(lambda v: jnp.abs(v - x) > eps)
+
+    @staticmethod
+    def isNan(): return Condition(jnp.isnan)
+
+    @staticmethod
+    def isInfinite(): return Condition(jnp.isinf)
+
+    @staticmethod
+    def isFinite(): return Condition(jnp.isfinite)
+
+    @staticmethod
+    def notFinite(): return Condition(lambda v: ~jnp.isfinite(v))
+
+    @staticmethod
+    def absGreaterThan(x): return Condition(lambda v: jnp.abs(v) > x)
+
+    @staticmethod
+    def absLessThan(x): return Condition(lambda v: jnp.abs(v) < x)
+
+
+class BooleanIndexing:
+    """ref: org.nd4j.linalg.indexing.BooleanIndexing statics."""
+
+    @staticmethod
+    def replaceWhere(arr, replacement, condition: Condition):
+        return arr.replaceWhere(replacement, condition)
+
+    @staticmethod
+    def countOccurrences(arr, condition: Condition) -> int:
+        return int(jnp.sum(condition.mask(arr.jax())))
+
+    @staticmethod
+    def and_(arr, condition: Condition) -> bool:
+        return bool(jnp.all(condition.mask(arr.jax())))
+
+    @staticmethod
+    def or_(arr, condition: Condition) -> bool:
+        return bool(jnp.any(condition.mask(arr.jax())))
+
+    @staticmethod
+    def firstIndex(arr, condition: Condition) -> int:
+        m = np.asarray(condition.mask(arr.jax())).reshape(-1)
+        idx = np.nonzero(m)[0]
+        return int(idx[0]) if idx.size else -1
+
+    @staticmethod
+    def lastIndex(arr, condition: Condition) -> int:
+        m = np.asarray(condition.mask(arr.jax())).reshape(-1)
+        idx = np.nonzero(m)[0]
+        return int(idx[-1]) if idx.size else -1

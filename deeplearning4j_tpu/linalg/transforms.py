@@ -1,0 +1,162 @@
+"""Transforms — the static math-helper surface
+(ref: ``org.nd4j.linalg.ops.transforms.Transforms`` — SURVEY.md §2.2 L1:
+the utility entry point user code calls for out-of-place math over
+INDArrays). Thin delegating layer over the op registry / jnp; every
+function accepts NDArray or anything array-like and returns NDArray."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.linalg.ndarray import NDArray
+from deeplearning4j_tpu.linalg.ndarray import _unwrap as _unwrap_nd
+
+
+def _unwrap(x):
+    return jnp.asarray(_unwrap_nd(x))
+
+
+def _wrap1(fn):
+    def f(x, dup: bool = True):
+        res = fn(_unwrap(x))
+        if not dup:
+            # reference semantics: dup=False mutates the input in place
+            if not isinstance(x, NDArray):
+                raise TypeError("dup=False needs an NDArray input to mutate")
+            return x._set_value(res)
+        return NDArray(res)
+    return f
+
+
+sigmoid = _wrap1(jax.nn.sigmoid)
+tanh = _wrap1(jnp.tanh)
+relu = _wrap1(jax.nn.relu)
+relu6 = _wrap1(lambda x: jnp.clip(x, 0, 6))
+elu = _wrap1(jax.nn.elu)
+selu = _wrap1(jax.nn.selu)
+gelu = _wrap1(lambda x: jax.nn.gelu(x, approximate=True))
+softPlus = _wrap1(jax.nn.softplus)
+softsign = _wrap1(jax.nn.soft_sign)
+sign = _wrap1(jnp.sign)
+abs = _wrap1(jnp.abs)          # noqa: A001 (reference name)
+exp = _wrap1(jnp.exp)
+expm1 = _wrap1(jnp.expm1)
+log = _wrap1(jnp.log)
+log1p = _wrap1(jnp.log1p)
+sqrt = _wrap1(jnp.sqrt)
+sin = _wrap1(jnp.sin)
+cos = _wrap1(jnp.cos)
+atan = _wrap1(jnp.arctan)
+asin = _wrap1(jnp.arcsin)
+acos = _wrap1(jnp.arccos)
+floor = _wrap1(jnp.floor)
+ceil = _wrap1(jnp.ceil)
+round = _wrap1(jnp.round)      # noqa: A001
+neg = _wrap1(jnp.negative)
+hardTanh = _wrap1(lambda x: jnp.clip(x, -1, 1))
+hardSigmoid = _wrap1(lambda x: jnp.clip(0.2 * x + 0.5, 0, 1))
+identity = _wrap1(lambda x: x)
+stabilize = _wrap1(lambda x: jnp.clip(x, -1e6, 1e6))
+
+
+def leakyRelu(x, alpha: float = 0.01):
+    v = _unwrap(x)
+    return NDArray(jnp.where(v >= 0, v, alpha * v))
+
+
+def softmax(x, axis: int = -1):
+    return NDArray(jax.nn.softmax(_unwrap(x), axis=axis))
+
+
+def logSoftmax(x, axis: int = -1):
+    return NDArray(jax.nn.log_softmax(_unwrap(x), axis=axis))
+
+
+def pow(x, p):                  # noqa: A001
+    return NDArray(jnp.power(_unwrap(x), _unwrap(p)))
+
+
+def max(x, y):                  # noqa: A001
+    return NDArray(jnp.maximum(_unwrap(x), _unwrap(y)))
+
+
+def min(x, y):                  # noqa: A001
+    return NDArray(jnp.minimum(_unwrap(x), _unwrap(y)))
+
+
+def unitVec(x):
+    v = _unwrap(x)
+    return NDArray(v / jnp.maximum(jnp.linalg.norm(v), 1e-12))
+
+
+def normalizeZeroMeanAndUnitVariance(x):
+    v = _unwrap(x)
+    return NDArray((v - jnp.mean(v)) / jnp.maximum(jnp.std(v), 1e-12))
+
+
+def cosineSim(a, b) -> float:
+    va, vb = jnp.ravel(_unwrap(a)), jnp.ravel(_unwrap(b))
+    return float(jnp.dot(va, vb)
+                 / jnp.maximum(jnp.linalg.norm(va) * jnp.linalg.norm(vb),
+                               1e-12))
+
+
+def cosineDistance(a, b) -> float:
+    return 1.0 - cosineSim(a, b)
+
+
+def euclideanDistance(a, b) -> float:
+    return float(jnp.linalg.norm(jnp.ravel(_unwrap(a))
+                                 - jnp.ravel(_unwrap(b))))
+
+
+def manhattanDistance(a, b) -> float:
+    return float(jnp.sum(jnp.abs(jnp.ravel(_unwrap(a))
+                                 - jnp.ravel(_unwrap(b)))))
+
+
+def hammingDistance(a, b) -> float:
+    return float(jnp.sum(jnp.ravel(_unwrap(a)) != jnp.ravel(_unwrap(b))))
+
+
+def jaccardDistance(a, b) -> float:
+    va, vb = jnp.ravel(_unwrap(a)), jnp.ravel(_unwrap(b))
+    mx = jnp.sum(jnp.maximum(va, vb))
+    mn = jnp.sum(jnp.minimum(va, vb))
+    return float(jnp.where(mx == 0, 0.0, 1.0 - mn / jnp.maximum(mx, 1e-12)))
+
+
+def allEuclideanDistances(x, y, dim: int = 1):
+    """Pairwise distances between rows/cols of x and y (ref:
+    Transforms.allEuclideanDistances)."""
+    vx, vy = _unwrap(x), _unwrap(y)
+    if dim == 0:
+        vx, vy = vx.T, vy.T
+    d = vx[:, None, :] - vy[None, :, :]
+    return NDArray(jnp.sqrt(jnp.sum(d * d, axis=-1)))
+
+
+def allCosineSimilarities(x, y, dim: int = 1):
+    vx, vy = _unwrap(x), _unwrap(y)
+    if dim == 0:
+        vx, vy = vx.T, vy.T
+    nx = vx / jnp.maximum(jnp.linalg.norm(vx, axis=1, keepdims=True), 1e-12)
+    ny = vy / jnp.maximum(jnp.linalg.norm(vy, axis=1, keepdims=True), 1e-12)
+    return NDArray(nx @ ny.T)
+
+
+def dot(a, b) -> float:
+    return float(jnp.dot(jnp.ravel(_unwrap(a)), jnp.ravel(_unwrap(b))))
+
+
+class Transforms:
+    """Class-style access (``Transforms.sigmoid(x)``) for reference-shaped
+    call sites; the module-level functions are the same objects."""
+
+
+for _name, _obj in list(globals().items()):
+    if callable(_obj) and not _name.startswith("_") and \
+            _name not in ("NDArray", "Transforms"):
+        setattr(Transforms, _name, staticmethod(_obj))
