@@ -434,14 +434,22 @@ def _add_methods():
                                               fn(self._value, _rowvec(o))))
         return m
 
+    def _need2d(self):
+        if self._value.ndim < 2:
+            raise ValueError(
+                "column-vector ops need a matrix self (a 1-D array against "
+                "a column vector would outer-broadcast)")
+
     def colop(fn):
         def m(self, o):
+            _need2d(self)
             return NDArray(_like_self(self._value,
                                       fn(self._value, _colvec(o))))
         return m
 
     def colopi(fn):
         def m(self, o):
+            _need2d(self)
             return self._set_value(_like_self(self._value,
                                               fn(self._value, _colvec(o))))
         return m
@@ -633,9 +641,13 @@ def sort(self, dim: int = -1, ascending: bool = True):
 @_extend(NDArray)
 def put(self, idx, value):
     """General indexed write (ref: INDArray.put)."""
+    if isinstance(idx, tuple):
+        idx = tuple(_unwrap(i) for i in idx)
+    else:
+        idx = _unwrap(idx)
     return self._set_value(
-        self._value.at[_unwrap(idx)].set(jnp.asarray(_unwrap(value),
-                                                     self._value.dtype)))
+        self._value.at[idx].set(jnp.asarray(_unwrap(value),
+                                            self._value.dtype)))
 
 
 @_extend(NDArray)

@@ -30,15 +30,30 @@ def _wrap1(fn):
     return f
 
 
-sigmoid = _wrap1(jax.nn.sigmoid)
-tanh = _wrap1(jnp.tanh)
-relu = _wrap1(jax.nn.relu)
-relu6 = _wrap1(lambda x: jnp.clip(x, 0, 6))
-elu = _wrap1(jax.nn.elu)
-selu = _wrap1(jax.nn.selu)
-gelu = _wrap1(lambda x: jax.nn.gelu(x, approximate=True))
-softPlus = _wrap1(jax.nn.softplus)
-softsign = _wrap1(jax.nn.soft_sign)
+def _wrap_op(name):
+    """Delegate through the registry so platform (Pallas) overrides apply
+    and the activation surface keeps ONE source of truth."""
+    from deeplearning4j_tpu.ops import registry as _registry
+
+    def f(x, dup: bool = True):
+        res = _registry.get(name)(_unwrap(x))
+        if not dup:
+            if not isinstance(x, NDArray):
+                raise TypeError("dup=False needs an NDArray input to mutate")
+            return x._set_value(res)
+        return NDArray(res)
+    return f
+
+
+sigmoid = _wrap_op("sigmoid")
+tanh = _wrap_op("tanh")
+relu = _wrap_op("relu")
+relu6 = _wrap_op("relu6")
+elu = _wrap_op("elu")
+selu = _wrap_op("selu")
+gelu = _wrap_op("gelu")
+softPlus = _wrap_op("softplus")
+softsign = _wrap_op("softsign")
 sign = _wrap1(jnp.sign)
 abs = _wrap1(jnp.abs)          # noqa: A001 (reference name)
 exp = _wrap1(jnp.exp)
@@ -55,8 +70,8 @@ floor = _wrap1(jnp.floor)
 ceil = _wrap1(jnp.ceil)
 round = _wrap1(jnp.round)      # noqa: A001
 neg = _wrap1(jnp.negative)
-hardTanh = _wrap1(lambda x: jnp.clip(x, -1, 1))
-hardSigmoid = _wrap1(lambda x: jnp.clip(0.2 * x + 0.5, 0, 1))
+hardTanh = _wrap_op("hardtanh")
+hardSigmoid = _wrap_op("hardsigmoid")
 identity = _wrap1(lambda x: x)
 stabilize = _wrap1(lambda x: jnp.clip(x, -1e6, 1e6))
 
@@ -67,11 +82,13 @@ def leakyRelu(x, alpha: float = 0.01):
 
 
 def softmax(x, axis: int = -1):
-    return NDArray(jax.nn.softmax(_unwrap(x), axis=axis))
+    from deeplearning4j_tpu.ops import registry as _registry
+    return NDArray(_registry.get("softmax")(_unwrap(x), axis=axis))
 
 
 def logSoftmax(x, axis: int = -1):
-    return NDArray(jax.nn.log_softmax(_unwrap(x), axis=axis))
+    from deeplearning4j_tpu.ops import registry as _registry
+    return NDArray(_registry.get("log_softmax")(_unwrap(x), axis=axis))
 
 
 def pow(x, p):                  # noqa: A001

@@ -1,0 +1,195 @@
+"""Pallas TPU kernels — platform overrides for memory-bound hot ops.
+
+Reference parity: libnd4j's ``platform/{mkldnn,cudnn}`` PlatformHelpers —
+vendor-optimized implementations that SHADOW the generic op at dispatch
+time (SURVEY.md §2.1). The TPU equivalent is a Pallas kernel registered
+through :func:`ops.registry.register_platform_override`.
+
+The wins here are memory-bound fusions XLA cannot always do in one VMEM
+round-trip: row-wise layer_norm and softmax read the activation ONCE,
+keep the row statistics in registers, and write the result once.
+
+Kernels are written against the (sublane, lane) = (8, 128) fp32 tiling;
+:func:`supported` gates dispatch — unsupported shapes/dtypes fall back to
+the generic lowering (the PlatformHelper contract). ``interpret=True``
+runs the same kernels on CPU for tests.
+
+Gradients: the overrides carry ``jax.custom_vjp`` with composed-jnp
+backward passes, so SameDiff graphs and eager ``jax.grad`` work through
+the kernel unchanged.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_ROW_BLOCK = 256
+
+
+def supported(x, axis: int = -1) -> bool:
+    """Shapes this kernel family accepts: 2-D fp32/bf16, normalized axis
+    last, lane dim a multiple of 128, rows a multiple of 8."""
+    if x.ndim != 2 or axis not in (-1, 1, x.ndim - 1):
+        return False
+    n, d = x.shape
+    if d % 128 != 0 or n % 8 != 0:
+        return False
+    if d > 4096:        # row block must fit VMEM (in + out buffers)
+        return False
+    return x.dtype in (jnp.float32, jnp.bfloat16)
+
+
+# ------------------------------------------------------------- layer_norm
+
+def _layer_norm_kernel(x_ref, g_ref, b_ref, o_ref, *, eps: float):
+    x = x_ref[:].astype(jnp.float32)
+    m = jnp.mean(x, axis=1, keepdims=True)
+    v = jnp.mean(jnp.square(x - m), axis=1, keepdims=True)
+    y = (x - m) * jax.lax.rsqrt(v + eps)
+    o_ref[:] = (y * g_ref[:].astype(jnp.float32)
+                + b_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+def _layer_norm_fwd_pallas(x, gain, bias, eps: float, interpret: bool):
+    n, d = x.shape
+    block = min(_ROW_BLOCK, n)
+    while n % block:
+        block //= 2
+    block = max(block, 8)
+    return pl.pallas_call(
+        functools.partial(_layer_norm_kernel, eps=eps),
+        out_shape=jax.ShapeDtypeStruct((n, d), x.dtype),
+        grid=(n // block,),
+        in_specs=[
+            pl.BlockSpec((block, d), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, d), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, d), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((block, d), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(x, gain.reshape(1, d), bias.reshape(1, d))
+
+
+def make_layer_norm_override(interpret: bool = False):
+    """Build the layer_norm platform override (signature-compatible with
+    ops.normalization.layer_norm for axis=-1 2-D inputs; other calls fall
+    back to the generic op)."""
+    from deeplearning4j_tpu.ops import normalization as norm_ops
+
+    @jax.custom_vjp
+    def _ln(x, gain, bias, eps):
+        return _layer_norm_fwd_pallas(x, gain, bias, eps, interpret)
+
+    def _fwd(x, gain, bias, eps):
+        return _ln(x, gain, bias, eps), (x, gain, eps)
+
+    def _bwd(res, ct):
+        x, gain, eps = res
+        x32 = x.astype(jnp.float32)
+        g32 = ct.astype(jnp.float32)
+        m = jnp.mean(x32, axis=1, keepdims=True)
+        v = jnp.mean(jnp.square(x32 - m), axis=1, keepdims=True)
+        inv = jax.lax.rsqrt(v + eps)
+        xhat = (x32 - m) * inv
+        gy = g32 * gain.astype(jnp.float32)
+        dx = inv * (gy - jnp.mean(gy, axis=1, keepdims=True)
+                    - xhat * jnp.mean(gy * xhat, axis=1, keepdims=True))
+        dgain = jnp.sum(g32 * xhat, axis=0)
+        dbias = jnp.sum(g32, axis=0)
+        return (dx.astype(x.dtype), dgain.astype(gain.dtype),
+                dbias.astype(gain.dtype), None)
+
+    _ln.defvjp(_fwd, _bwd)
+
+    def layer_norm(x, gain, bias=None, *, axis=-1, eps: float = 1e-5):
+        if gain is None or bias is None or \
+                not supported(jnp.asarray(x),
+                              axis if isinstance(axis, int) else -2):
+            return norm_ops.layer_norm(x, gain, bias, axis=axis, eps=eps)
+        return _ln(jnp.asarray(x), jnp.asarray(gain), jnp.asarray(bias), eps)
+
+    return layer_norm
+
+
+# ---------------------------------------------------------------- softmax
+
+def _softmax_kernel(x_ref, o_ref):
+    x = x_ref[:].astype(jnp.float32)
+    m = jnp.max(x, axis=1, keepdims=True)
+    e = jnp.exp(x - m)
+    o_ref[:] = (e / jnp.sum(e, axis=1, keepdims=True)).astype(o_ref.dtype)
+
+
+def _softmax_fwd_pallas(x, interpret: bool):
+    n, d = x.shape
+    block = min(_ROW_BLOCK, n)
+    while n % block:
+        block //= 2
+    block = max(block, 8)
+    return pl.pallas_call(
+        _softmax_kernel,
+        out_shape=jax.ShapeDtypeStruct((n, d), x.dtype),
+        grid=(n // block,),
+        in_specs=[pl.BlockSpec((block, d), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((block, d), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(x)
+
+
+def make_softmax_override(interpret: bool = False):
+    @jax.custom_vjp
+    def _sm(x):
+        return _softmax_fwd_pallas(x, interpret)
+
+    def _fwd(x):
+        y = _sm(x)
+        return y, y
+
+    def _bwd(y, ct):
+        y32 = y.astype(jnp.float32)
+        g = ct.astype(jnp.float32)
+        dx = y32 * (g - jnp.sum(g * y32, axis=1, keepdims=True))
+        return (dx.astype(y.dtype),)
+
+    _sm.defvjp(_fwd, _bwd)
+
+    def softmax(x, axis: int = -1):
+        xa = jnp.asarray(x)
+        if not supported(xa, axis):
+            return jax.nn.softmax(xa, axis=axis)
+        return _sm(xa)
+
+    return softmax
+
+
+# ------------------------------------------------------------ installation
+
+def install_platform_overrides(interpret: Optional[bool] = None):
+    """Register the Pallas kernels over their generic ops (ref: the
+    PlatformHelper loader). ``interpret=None`` auto-selects: compiled on
+    TPU, interpreter elsewhere (tests)."""
+    from deeplearning4j_tpu.ops import registry
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    registry.register_platform_override(
+        "layer_norm", make_layer_norm_override(interpret))
+    registry.register_platform_override(
+        "softmax", make_softmax_override(interpret))
+
+
+def uninstall_platform_overrides():
+    from deeplearning4j_tpu.ops import registry
+    registry.clear_platform_override("layer_norm")
+    registry.clear_platform_override("softmax")
