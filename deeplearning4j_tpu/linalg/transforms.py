@@ -34,15 +34,7 @@ def _wrap_op(name):
     """Delegate through the registry so platform (Pallas) overrides apply
     and the activation surface keeps ONE source of truth."""
     from deeplearning4j_tpu.ops import registry as _registry
-
-    def f(x, dup: bool = True):
-        res = _registry.get(name)(_unwrap(x))
-        if not dup:
-            if not isinstance(x, NDArray):
-                raise TypeError("dup=False needs an NDArray input to mutate")
-            return x._set_value(res)
-        return NDArray(res)
-    return f
+    return _wrap1(lambda v: _registry.get(name)(v))
 
 
 sigmoid = _wrap_op("sigmoid")

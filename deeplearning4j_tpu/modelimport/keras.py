@@ -438,6 +438,79 @@ def _map_global_pool(cfg, pooling: str) -> _Imported:
     return _Imported(L.GlobalPoolingLayer(pooling), cfg["name"])
 
 
+def _map_pool1d(cfg, pooling: str) -> _Imported:
+    p = str(cfg.get("padding", "valid")).lower()
+    mode = "same" if p == "same" else "truncate"
+    size = _first(cfg.get("pool_size", 2))
+    strides = cfg.get("strides")
+    lay = L.Subsampling1DLayer(
+        poolingType=pooling, kernelSize=size,
+        stride=_first(strides) if strides is not None else size,
+        convolutionMode=mode)
+    return _Imported(lay, cfg["name"])
+
+
+def _map_layernorm(cfg) -> _Imported:
+    axis = cfg.get("axis", -1)
+    if isinstance(axis, (list, tuple)):
+        if len(axis) != 1:
+            raise KerasImportError(
+                f"multi-axis LayerNormalization {axis} unsupported")
+        axis = axis[0]
+    # only the feature axis maps onto the NCW/ff convention: -1, or the
+    # explicit channels axis 2 of a keras [N, T, C] input
+    if int(axis) not in (-1, 2):
+        raise KerasImportError(
+            f"LayerNormalization axis {axis} unsupported (last/channel "
+            f"axis only)")
+    lay = L.LayerNorm(eps=float(cfg.get("epsilon", 1e-3)))
+
+    def fill(kw, pre_it):
+        n = kw["gamma"].shape[0] if "gamma" in kw else kw["beta"].shape[0]
+        return {"gamma": jnp.asarray(kw.get("gamma", np.ones(n, np.float32))),
+                "beta": jnp.asarray(kw.get("beta", np.zeros(n, np.float32)))
+                }, None
+    return _Imported(lay, cfg["name"], fill)
+
+
+def _map_prelu(cfg) -> _Imported:
+    shared = cfg.get("shared_axes")
+    if shared:
+        raise KerasImportError("PReLU shared_axes import not supported")
+    lay = L.PReLULayer()
+
+    def fill(kw, pre_it):
+        alpha = np.asarray(kw["alpha"])
+        if alpha.ndim != 1:
+            # 2-D/3-D keras alphas are laid out (T,C)/(H,W,C); our PReLU
+            # broadcast is (C,H,W)-flat — refusing beats silent mis-order
+            raise KerasImportError(
+                f"PReLU over non-dense input (alpha shape "
+                f"{alpha.shape}) is not supported; only 1-D feature "
+                f"alphas import")
+        return {"alpha": jnp.asarray(alpha)}, None
+    return _Imported(lay, cfg["name"], fill)
+
+
+def _map_elu_layer(cfg) -> _Imported:
+    if abs(float(cfg.get("alpha", 1.0)) - 1.0) > 1e-9:
+        raise KerasImportError("ELU layer with alpha != 1.0 unsupported")
+    return _Imported(L.ActivationLayer("elu"), cfg["name"])
+
+
+def _map_permute(cfg) -> _Imported:
+    # keras dims are 1-based over [T, C]; our layout is [C, T] — the only
+    # meaningful permutation either layout supports is the (2, 1) swap
+    dims = tuple(cfg.get("dims", (2, 1)))
+    if dims != (2, 1):
+        raise KerasImportError(f"Permute dims {dims} unsupported")
+    return _Imported(L.Permute((2, 1)), cfg["name"])
+
+
+def _map_repeat_vector(cfg) -> _Imported:
+    return _Imported(L.RepeatVector(int(cfg["n"])), cfg["name"])
+
+
 _SKIP = {"InputLayer", "Flatten", "Reshape"}  # handled by preprocessors
 
 _MAPPERS = {
@@ -446,6 +519,8 @@ _MAPPERS = {
     "Conv2D": _map_conv2d,
     "DepthwiseConv2D": _map_depthwise_conv2d,
     "SeparableConv2D": _map_separable_conv2d,
+    "MaxPooling1D": lambda c: _map_pool1d(c, "max"),
+    "AveragePooling1D": lambda c: _map_pool1d(c, "avg"),
     "MaxPooling2D": lambda c: _map_pool2d(c, "max"),
     "AveragePooling2D": lambda c: _map_pool2d(c, "avg"),
     "GlobalMaxPooling2D": lambda c: _map_global_pool(c, "max"),
@@ -463,6 +538,11 @@ _MAPPERS = {
     "Bidirectional": _map_bidirectional,
     "Activation": _map_activation,
     "LeakyReLU": _map_leaky_relu,
+    "LayerNormalization": _map_layernorm,
+    "PReLU": _map_prelu,
+    "ELU": _map_elu_layer,
+    "Permute": _map_permute,
+    "RepeatVector": _map_repeat_vector,
     "Dropout": _map_dropout,
     "SpatialDropout2D": _map_dropout,
 }

@@ -109,7 +109,8 @@ class Layer:
             if k == "@class":
                 continue
             if isinstance(v, list) and k in ("kernel", "stride", "padding",
-                                             "dilation", "scale", "crop"):
+                                             "dilation", "scale", "crop",
+                                             "dims"):
                 v = tuple(v)
             setattr(obj, k, v)
         return obj
@@ -1036,9 +1037,131 @@ class PReLULayer(Layer):
         return it
 
 
+class Subsampling1DLayer(Layer):
+    """ref: layers.subsampling.Subsampling1DLayer — [N, C, T] pooling.
+
+    LIMITATION: sequence masks are not downsampled through the pool (the
+    reference downsamples the mask alongside); a masked fit() with a
+    strided pool before a mask-aware layer fails loudly on the length
+    mismatch rather than silently mis-pooling padding."""
+
+    input_kind = "rnn"
+    has_params = False
+
+    def __init__(self, poolingType: str = "max", kernelSize: int = 2,
+                 stride: int = None, padding: int = 0,
+                 convolutionMode: str = "truncate", **kw):
+        super().__init__(**kw)
+        self.pooling = poolingType.lower()
+        self.kernel = int(kernelSize if not isinstance(kernelSize, (tuple, list))
+                          else kernelSize[0])
+        self.stride = int(stride if stride is not None else self.kernel)
+        self.padding = int(padding)
+        self.mode = convolutionMode
+
+    def infer_nin(self, it):
+        self.nIn = self.nOut = it.size
+
+    def apply(self, params, state, x, train, key, mask=None):
+        fn = conv_ops.maxpool1d if self.pooling == "max" else conv_ops.avgpool1d
+        return fn(x, kernel=self.kernel, stride=self.stride,
+                  pad=self.padding, mode=self.mode), state
+
+    def output_type(self, it: InputType) -> InputType:
+        t = it.dims.get("timesteps", -1)
+        if t and t > 0:
+            t = conv_ops.conv_output_size(t, self.kernel, self.stride,
+                                          self.padding, 1, self.mode)
+        return InputType.recurrent(it.size, t)
+
+
+class LayerNorm(Layer):
+    """ref: layers.LayerNorm (a.k.a. Keras LayerNormalization) — per-sample
+    normalization over the feature axis with learned gain/bias. Feature
+    axis: -1 for [N, D], the CHANNEL axis (1) for [N, C, T]."""
+
+    input_kind = None
+    has_params = True
+
+    def __init__(self, eps: float = 1e-5, **kw):
+        super().__init__(**kw)
+        self.eps = eps
+
+    def infer_nin(self, it: InputType):
+        if it.kind == "cnn":
+            raise ValueError(
+                "LayerNorm supports dense [N, D] and recurrent [N, C, T] "
+                "inputs; 4-D CNN feature maps are not supported")
+        self.nIn = self.nOut = it.size if it.kind == "rnn" \
+            else it.arrayElementsPerExample()
+
+    def initialize(self, key):
+        return {"gamma": jnp.ones((self.nIn,), jnp.float32),
+                "beta": jnp.zeros((self.nIn,), jnp.float32)}, {}
+
+    def _ln(self, x, params):
+        # resolve through the registry so Pallas platform overrides apply
+        from deeplearning4j_tpu.ops import registry as _registry
+        return _registry.get("layer_norm")(x, params["gamma"],
+                                           params["beta"], eps=self.eps)
+
+    def apply(self, params, state, x, train, key, mask=None):
+        if x.ndim == 3:   # [N, C, T]: normalize the channel axis
+            xt = jnp.swapaxes(x, 1, 2)         # [N, T, C]
+            return jnp.swapaxes(self._ln(xt, params), 1, 2), state
+        return self._ln(x, params), state
+
+    def output_type(self, it: InputType) -> InputType:
+        return it
+
+
+class Permute(Layer):
+    """ref: Keras Permute — reorder NON-batch axes (1-based dims)."""
+
+    input_kind = None
+    has_params = False
+
+    def __init__(self, dims=(2, 1), **kw):
+        super().__init__(**kw)
+        self.dims = tuple(int(d) for d in dims)
+
+    def infer_nin(self, it):
+        self.nIn = self.nOut = None
+
+    def apply(self, params, state, x, train, key):
+        perm = (0,) + self.dims
+        return jnp.transpose(x, perm), state
+
+    def output_type(self, it: InputType) -> InputType:
+        if it.kind == "rnn" and self.dims == (2, 1):
+            return InputType.recurrent(it.dims.get("timesteps", -1), it.size)
+        return it
+
+
+class RepeatVector(Layer):
+    """ref: Keras RepeatVector — [N, D] -> [N, D, n] (NCW layout)."""
+
+    input_kind = "ff"
+    has_params = False
+
+    def __init__(self, n: int = 2, **kw):
+        super().__init__(**kw)
+        self.n = int(n)
+
+    def infer_nin(self, it):
+        self.nIn = self.nOut = it.arrayElementsPerExample()
+
+    def apply(self, params, state, x, train, key):
+        return jnp.repeat(x[:, :, None], self.n, axis=2), state
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.recurrent(self.nOut, self.n)
+
+
 _LAYER_CLASSES = {}
 for _cls in [DenseLayer, EmbeddingLayer, EmbeddingSequenceLayer, ConvolutionLayer,
-             Convolution1D, Deconvolution2D, DepthwiseConvolution2D, SeparableConvolution2D,
+             Convolution1D, Subsampling1DLayer, LayerNorm, Permute,
+             RepeatVector, Deconvolution2D, DepthwiseConvolution2D, SeparableConvolution2D,
              SubsamplingLayer, BatchNormalization, LocalResponseNormalization,
              ActivationLayer, DropoutLayer, ZeroPaddingLayer, Upsampling2D,
              Cropping2D, GlobalPoolingLayer, LSTM, GravesLSTM, GRU, SimpleRnn,

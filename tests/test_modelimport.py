@@ -165,6 +165,36 @@ class TestSequentialImport:
                                    m.predict(x, verbose=0),
                                    rtol=1e-4, atol=1e-5)
 
+    def test_pool1d_layernorm_parity(self, tmp_path):
+        m = keras.Sequential([
+            keras.Input(shape=(12, 6)),
+            KL.Conv1D(8, 3, padding="same", activation="relu", name="c"),
+            KL.MaxPooling1D(2, name="mp"),
+            KL.LayerNormalization(name="ln"),
+            KL.AveragePooling1D(2, name="ap"),
+            KL.GlobalAveragePooling1D(name="gp"),
+        ])
+        x = np.random.RandomState(6).randn(2, 12, 6).astype(np.float32)
+        want = m.predict(x, verbose=0)
+        net = importKerasSequentialModelAndWeights(_save(tmp_path, m))
+        got = np.asarray(net.output(np.transpose(x, (0, 2, 1))))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_prelu_elu_repeat_parity(self, tmp_path):
+        m = keras.Sequential([
+            keras.Input(shape=(5,)),
+            KL.Dense(6, name="d"),
+            KL.PReLU(name="pr"),
+            KL.ELU(name="el"),
+            KL.RepeatVector(3, name="rv"),
+            KL.GRU(4, name="g"),
+        ])
+        x = np.random.RandomState(7).randn(3, 5).astype(np.float32)
+        want = m.predict(x, verbose=0)
+        net = importKerasSequentialModelAndWeights(_save(tmp_path, m))
+        got = np.asarray(net.output(x))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
     def test_unsupported_layer_reported(self, tmp_path):
         m = keras.Sequential([
             keras.Input(shape=(4,)),
