@@ -707,6 +707,194 @@ register("square_distance", lambda x, y, axis=None:
          jnp.sum(jnp.square(x - y), axis=axis))
 
 
+# ---------------------------------------------------------------------------
+# Family: linalg decompositions (ref: libnd4j lup/eig/... parity_ops)
+# ---------------------------------------------------------------------------
+register("eigh", lambda x: jnp.linalg.eigh(x))
+register("lu", lambda x: jax.scipy.linalg.lu(x))
+register("pinv", lambda x: jnp.linalg.pinv(x))
+register("matrix_rank", lambda x, tol=None: jnp.linalg.matrix_rank(x, tol=tol))
+register("kron", jnp.kron)
+register("slogdet", lambda x: jnp.linalg.slogdet(x))
+register("expm", lambda x: jax.scipy.linalg.expm(x))
+register("l2_normalize", lambda x, axis=None, eps=1e-12:
+         x / jnp.maximum(jnp.sqrt(jnp.sum(jnp.square(x), axis=axis,
+                                          keepdims=axis is not None)), eps))
+@register("unsorted_segment_sqrt_n")
+def _unsorted_segment_sqrt_n(data, segment_ids, num_segments=None):
+    """sum / sqrt(count) per segment; same num_segments contract as the
+    other segment ops (explicit under jit)."""
+    i = jnp.asarray(segment_ids).astype(jnp.int32)
+    if num_segments is None:
+        if isinstance(i, jax.core.Tracer):
+            raise ValueError(
+                "segment ops need num_segments under jit (static output "
+                "shape); pass it explicitly")
+        num_segments = int(jnp.max(i)) + 1
+    n = int(num_segments)
+    s_ = jax.ops.segment_sum(data, i, num_segments=n)
+    c = jax.ops.segment_sum(jnp.ones(data.shape[:1], jnp.float32), i,
+                            num_segments=n)
+    return s_ / jnp.sqrt(jnp.maximum(c, 1.0))[
+        (...,) + (None,) * (data.ndim - 1)]
+
+
+# ---------------------------------------------------------------------------
+# Family: image ops (ref: libnd4j image parity_ops {adjust_contrast,
+# adjust_hue, adjust_saturation, rgb_to_hsv, ...}; channels-LAST [..., 3])
+# ---------------------------------------------------------------------------
+
+@register("adjust_contrast")
+def _adjust_contrast(x, factor):
+    m = jnp.mean(x, axis=(-3, -2), keepdims=True)
+    return (x - m) * factor + m
+
+
+register("adjust_brightness", lambda x, delta: x + delta)
+register("adjust_gamma", lambda x, gamma, gain=1.0:
+         gain * jnp.power(x, gamma))
+
+
+@register("rgb_to_grayscale")
+def _rgb_to_grayscale(x):
+    w = jnp.asarray([0.2989, 0.587, 0.114], x.dtype)
+    return jnp.sum(x * w, axis=-1, keepdims=True)
+
+
+@register("rgb_to_yuv")
+def _rgb_to_yuv(x):
+    m = jnp.asarray([[0.299, -0.14714119, 0.61497538],
+                     [0.587, -0.28886916, -0.51496512],
+                     [0.114, 0.43601035, -0.10001026]], x.dtype)
+    return x @ m
+
+
+@register("yuv_to_rgb")
+def _yuv_to_rgb(x):
+    m = jnp.asarray([[1.0, 1.0, 1.0],
+                     [0.0, -0.394642334, 2.03206185],
+                     [1.13988303, -0.58062185, 0.0]], x.dtype)
+    return x @ m
+
+
+@register("rgb_to_hsv")
+def _rgb_to_hsv(x):
+    r, g, b = x[..., 0], x[..., 1], x[..., 2]
+    mx = jnp.max(x, axis=-1)
+    mn = jnp.min(x, axis=-1)
+    d = mx - mn
+    safe = jnp.where(d == 0, 1.0, d)
+    h = jnp.where(
+        mx == r, jnp.mod((g - b) / safe, 6.0),
+        jnp.where(mx == g, (b - r) / safe + 2.0, (r - g) / safe + 4.0)) / 6.0
+    h = jnp.where(d == 0, 0.0, h)
+    s_ = jnp.where(mx == 0, 0.0, d / jnp.where(mx == 0, 1.0, mx))
+    return jnp.stack([h, s_, mx], axis=-1)
+
+
+@register("hsv_to_rgb")
+def _hsv_to_rgb(x):
+    h, s_, v = x[..., 0], x[..., 1], x[..., 2]
+    i = jnp.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1 - s_)
+    q = v * (1 - f * s_)
+    t = v * (1 - (1 - f) * s_)
+    i = jnp.mod(i, 6).astype(jnp.int32)
+    r = jnp.select([i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+                   [v, q, p, p, t, v])
+    g = jnp.select([i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+                   [t, v, v, q, p, p])
+    b = jnp.select([i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+                   [p, p, t, v, v, q])
+    return jnp.stack([r, g, b], axis=-1)
+
+
+@register("extract_image_patches")
+def _extract_image_patches(x, ksize, stride=1, rate=1):
+    """NHWC -> [N, oH, oW, kH*kW*C] (ref: extract_image_patches)."""
+    kh, kw = (ksize, ksize) if isinstance(ksize, int) else ksize
+    sh, sw = (stride, stride) if isinstance(stride, int) else stride
+    n, h, w, c = x.shape
+    oh = (h - (kh - 1) * rate - 1) // sh + 1
+    ow = (w - (kw - 1) * rate - 1) // sw + 1
+    patches = []
+    for di in range(kh):
+        for dj in range(kw):
+            patches.append(x[:, di * rate:di * rate + oh * sh:sh,
+                             dj * rate:dj * rate + ow * sw:sw, :])
+    return jnp.concatenate(patches, axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# Family: quantization (ref: libnd4j fake_quant_with_min_max_vars etc.)
+# ---------------------------------------------------------------------------
+
+@register("fake_quant_with_min_max")
+def _fake_quant(x, min_v, max_v, num_bits: int = 8):
+    """ref: fake_quant_with_min_max_vars — includes the reference's range
+    NUDGE so zero is exactly representable on the quantization grid."""
+    levels = (1 << num_bits) - 1
+    scale = (max_v - min_v) / levels
+    zero_point = jnp.clip(jnp.round(-min_v / scale), 0, levels)
+    nudged_min = -zero_point * scale
+    nudged_max = (levels - zero_point) * scale
+    q = jnp.round((jnp.clip(x, nudged_min, nudged_max) - nudged_min) / scale)
+    return q * scale + nudged_min
+
+
+@register("quantize")
+def _quantize(x, scale, zero_point=0, dtype=jnp.int8):
+    info = jnp.iinfo(dtype)
+    return jnp.clip(jnp.round(x / scale) + zero_point, info.min,
+                    info.max).astype(dtype)
+
+
+register("dequantize", lambda q, scale, zero_point=0:
+         (q.astype(jnp.float32) - zero_point) * scale)
+
+
+# ---------------------------------------------------------------------------
+# Family: extra losses (ref: weighted_cross_entropy_with_logits etc.)
+# ---------------------------------------------------------------------------
+
+@register("weighted_cross_entropy_with_logits")
+def _weighted_ce(targets, logits, pos_weight):
+    log_w = (1 + (pos_weight - 1) * targets)
+    return ((1 - targets) * logits
+            + log_w * (jnp.log1p(jnp.exp(-jnp.abs(logits)))
+                       + jnp.maximum(-logits, 0)))
+
+
+@register("log_poisson_loss")
+def _log_poisson(targets, log_input, compute_full_loss: bool = False):
+    loss = jnp.exp(log_input) - log_input * targets
+    if compute_full_loss:
+        stirling = (targets * jnp.log(jnp.maximum(targets, 1.0)) - targets
+                    + 0.5 * jnp.log(2 * jnp.pi * jnp.maximum(targets, 1.0)))
+        loss = loss + jnp.where(targets > 1, stirling, 0.0)
+    return loss
+
+
+@register("batch_gather")
+def _batch_gather(params, indices):
+    """ref: batch_gather — indices [batch, m] of rank params.ndim-1 select
+    along axis 1; trailing dims broadcast."""
+    idx = jnp.asarray(indices).astype(jnp.int32)
+    while idx.ndim < jnp.ndim(params):
+        idx = idx[..., None]
+    idx = jnp.broadcast_to(idx, idx.shape[:2] + jnp.shape(params)[2:])
+    return jnp.take_along_axis(params, idx, axis=1)
+
+
+@register("mirror_pad")
+def _mirror_pad(x, paddings, mode: str = "REFLECT"):
+    widths = [tuple(p) for p in paddings]
+    return jnp.pad(x, widths,
+                   mode="reflect" if str(mode).upper() == "REFLECT"
+                   else "symmetric")
+
+
 # meta info
 def summary() -> str:
     return f"{len(_REGISTRY)} ops registered, {len(_PLATFORM_OVERRIDES)} platform overrides"

@@ -95,6 +95,27 @@ def _bools2(*shape):
 # case table, bucket by bucket
 # --------------------------------------------------------------------------
 
+def sp_linalg_expm(a):
+    import scipy.linalg
+    return scipy.linalg.expm(a.astype(np.float64)).astype(np.float32)
+
+
+def _np_rgb_to_hsv(x):
+    import matplotlib.colors as mc
+    return mc.rgb_to_hsv(x)
+
+
+def _np_roundtrip_check(x, fwd: str, inv: str):
+    """Golden for invertible-pair ops: the expected value of fwd(x) is
+    whatever value satisfies inv(fwd(x)) == x; we compute fwd(x) with the
+    op itself and ASSERT the inverse recovers x, then return it."""
+    y = np.asarray(R.get(fwd)(x))
+    back = np.asarray(R.get(inv)(y))
+    np.testing.assert_allclose(back, x, rtol=1e-3, atol=1e-3,
+                               err_msg=f"{inv}({fwd}(x)) != x")
+    return y
+
+
 def _np_scatter(x, idx, upd, mode):
     out = x.copy()
     for j, i in enumerate(idx):
@@ -516,7 +537,107 @@ def _build_cases() -> List[OpCase]:
         golden=lambda a, b: np.linalg.lstsq(a, b, rcond=None)[0], rtol=1e-2)
     add("l2_loss", _r(3, 4), golden=lambda x: 0.5 * np.sum(x * x), grad=True)
 
+    # ---- r3 additions: decompositions, image, quantization, losses ----
+    add("eigh", spd, note="eigenpairs checked structurally (finite)")
+    add("lu", _r(4, 4), note="P@L@U reconstruction is structural")
+    add("pinv", _r(4, 3), golden=np.linalg.pinv, rtol=1e-3, atol=1e-4)
+    add("matrix_rank", lambda rng: (np.eye(4, dtype=np.float32) * 2.0,),
+        golden=lambda a: np.linalg.matrix_rank(a))
+    add("kron", lambda rng: (rng.randn(2, 2).astype(np.float32),
+                             rng.randn(3, 3).astype(np.float32)),
+        golden=np.kron)
+    add("slogdet", spd, golden=lambda a: tuple(np.linalg.slogdet(a)),
+        rtol=1e-3)
+    add("expm", lambda rng: (rng.randn(3, 3).astype(np.float32) * 0.1,),
+        golden=lambda a: sp_linalg_expm(a), rtol=1e-3, atol=1e-4)
+    add("l2_normalize", _r(3, 4), kwargs={"axis": 1},
+        golden=lambda x, axis=1: x / np.maximum(
+            np.sqrt((x * x).sum(axis, keepdims=True)), 1e-12), grad=True)
+    add("unsorted_segment_sqrt_n",
+        lambda rng: (rng.randn(6, 2).astype(np.float32),
+                     np.asarray([0, 0, 1, 1, 2, 2], np.int32)),
+        kwargs={"num_segments": 3},
+        golden=lambda d, i, num_segments=3: np.stack(
+            [d[i == k].sum(0) / np.sqrt((i == k).sum())
+             for k in range(num_segments)]))
+    img = lambda rng: (rng.rand(2, 4, 4, 3).astype(np.float32),)
+    add("adjust_contrast", img, kwargs={"factor": 2.0},
+        golden=lambda x, factor=2.0:
+        (x - x.mean((-3, -2), keepdims=True)) * factor
+        + x.mean((-3, -2), keepdims=True))
+    add("adjust_brightness", img, kwargs={"delta": 0.1},
+        golden=lambda x, delta=0.1: x + delta, grad=True)
+    add("adjust_gamma", img, kwargs={"gamma": 2.0},
+        golden=lambda x, gamma=2.0, gain=1.0: gain * x ** gamma)
+    add("rgb_to_grayscale", img,
+        golden=lambda x: (x * np.asarray([0.2989, 0.587, 0.114])).sum(
+            -1, keepdims=True), rtol=1e-5)
+    add("rgb_to_yuv", img,
+        golden=lambda x: _np_roundtrip_check(x, "rgb_to_yuv", "yuv_to_rgb"))
+    add("yuv_to_rgb", img,
+        golden=lambda x: _np_roundtrip_check(x, "yuv_to_rgb", "rgb_to_yuv"))
+    add("rgb_to_hsv", img, golden=lambda x: _np_rgb_to_hsv(x), rtol=1e-4,
+        atol=1e-5)
+    add("hsv_to_rgb", lambda rng: (np.stack([
+        rng.rand(2, 3, 3), rng.rand(2, 3, 3), rng.rand(2, 3, 3)],
+        axis=-1).astype(np.float32),),
+        golden=lambda x: _np_roundtrip_check(x, "hsv_to_rgb", "rgb_to_hsv"))
+    add("extract_image_patches", lambda rng:
+        (rng.randn(1, 4, 4, 2).astype(np.float32),),
+        kwargs={"ksize": 2, "stride": 2},
+        golden=lambda x, ksize=2, stride=2: np.concatenate(
+            [x[:, di:di + 2 * 2:2, dj:dj + 2 * 2:2, :]
+             for di in range(2) for dj in range(2)], axis=-1))
+    def _np_fake_quant(x, min_v=-1.0, max_v=1.0, num_bits=8):
+        levels = (1 << num_bits) - 1
+        scale = (max_v - min_v) / levels
+        zp = np.clip(np.round(-min_v / scale), 0, levels)
+        nmin, nmax = -zp * scale, (levels - zp) * scale
+        return (np.round((np.clip(x, nmin, nmax) - nmin) / scale) * scale
+                + nmin)
+    add("fake_quant_with_min_max", _r(4, 4),
+        kwargs={"min_v": -1.0, "max_v": 1.0, "num_bits": 8},
+        golden=_np_fake_quant)
+    add("fake_quant_with_min_max", _rpos(4, 4),
+        kwargs={"min_v": 0.1, "max_v": 1.1, "num_bits": 8},
+        golden=_np_fake_quant, note="asymmetric range exercises the nudge")
+    add("quantize", _r(8,), kwargs={"scale": 0.1},
+        golden=lambda x, scale=0.1: np.clip(np.round(x / scale), -128,
+                                            127).astype(np.int8))
+    add("dequantize",
+        lambda rng: (rng.randint(-128, 127, (8,)).astype(np.int8),),
+        kwargs={"scale": 0.1},
+        golden=lambda q, scale=0.1: q.astype(np.float32) * scale)
+    add("weighted_cross_entropy_with_logits",
+        lambda rng: ((rng.rand(4, 3) > 0.5).astype(np.float32),
+                     rng.randn(4, 3).astype(np.float32), 2.0),
+        golden=lambda t, lg, w: (1 - t) * lg + (1 + (w - 1) * t)
+        * (np.log1p(np.exp(-np.abs(lg))) + np.maximum(-lg, 0)),
+        grad=True, grad_arg_idx=(1,))
+    add("log_poisson_loss",
+        lambda rng: (rng.randint(0, 5, (4,)).astype(np.float32),
+                     rng.randn(4).astype(np.float32)),
+        golden=lambda t, li: np.exp(li) - li * t, grad=True,
+        grad_arg_idx=(1,))
+    add("log_poisson_loss",
+        lambda rng: (np.asarray([0.0, 1.0, 3.0], np.float32),
+                     rng.randn(3).astype(np.float32)),
+        kwargs={"compute_full_loss": True},
+        golden=lambda t, li, compute_full_loss=True:
+        np.exp(li) - li * t + np.where(
+            t > 1, t * np.log(np.maximum(t, 1.0)) - t
+            + 0.5 * np.log(2 * np.pi * np.maximum(t, 1.0)), 0.0))
+    add("batch_gather",
+        lambda rng: (rng.randn(2, 5, 3).astype(np.float32),
+                     np.asarray([[0, 2], [1, 4]], np.int32)),
+        golden=lambda p, i: np.take_along_axis(
+            p, np.broadcast_to(i[:, :, None], i.shape + (3,)), axis=1))
+    add("mirror_pad", _r(3, 4), kwargs={"paddings": ((1, 1), (2, 2))},
+        golden=lambda x, paddings=((1, 1), (2, 2)):
+        np.pad(x, paddings, mode="reflect"))
+
     return C
+
 
 
 _EXTRA_BUILDERS: Dict[str, Callable[[], List[OpCase]]] = {}
