@@ -24,3 +24,12 @@ from deeplearning4j_tpu.data.image import (  # noqa: F401
     PipelineImageTransform,
 )
 from deeplearning4j_tpu.data.iterators import Cifar10DataSetIterator  # noqa: F401
+from deeplearning4j_tpu.data.audio import (  # noqa: F401
+    AudioDataSetIterator,
+    WavFileRecordReader,
+    mel_spectrogram,
+    mfcc,
+    read_wav,
+    spectrogram,
+    write_wav,
+)

@@ -243,13 +243,17 @@ class PathLabelGenerator(ParentPathLabelGenerator):
     pass
 
 
-def _list_images(root: str) -> List[str]:
+def _list_files(root: str, exts) -> List[str]:
     out = []
     for dirpath, _, files in os.walk(root):
         for f in sorted(files):
-            if f.lower().endswith(_IMG_EXTS):
+            if f.lower().endswith(tuple(exts)):
                 out.append(os.path.join(dirpath, f))
     return sorted(out)
+
+
+def _list_images(root: str) -> List[str]:
+    return _list_files(root, _IMG_EXTS)
 
 
 # ----------------------------------------------------------- record readers

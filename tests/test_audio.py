@@ -1,0 +1,121 @@
+"""DataVec audio pipeline tests (ref: datavec-data-audio —
+SURVEY.md §2.2 "DataVec image/audio"): WAV round-trip, STFT/mel/MFCC
+feature sanity, reader + iterator feeding a Conv1D classifier."""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.audio import (AudioDataSetIterator,
+                                           WavFileRecordReader, mel_filterbank,
+                                           mel_spectrogram, mfcc, read_wav,
+                                           spectrogram, write_wav)
+
+
+def _tone(freq, rate=8000, dur=0.25, amp=0.5):
+    t = np.arange(int(rate * dur)) / rate
+    return (amp * np.sin(2 * np.pi * freq * t)).astype(np.float32)
+
+
+class TestWavIO:
+    def test_roundtrip_16bit(self, tmp_path):
+        p = str(tmp_path / "t.wav")
+        x = _tone(440)
+        write_wav(p, x, 8000)
+        y, rate = read_wav(p)
+        assert rate == 8000
+        np.testing.assert_allclose(y, x, atol=1e-3)
+
+    def test_stereo(self, tmp_path):
+        p = str(tmp_path / "s.wav")
+        x = np.stack([_tone(300), _tone(600)], axis=1)
+        write_wav(p, x, 8000)
+        y, _ = read_wav(p)
+        assert y.shape == x.shape
+
+
+class TestFeatures:
+    def test_spectrogram_peak_tracks_frequency(self):
+        rate, n_fft = 8000, 256
+        for freq in (500.0, 1500.0):
+            s = np.asarray(spectrogram(_tone(freq, rate), n_fft, 128))
+            peak_bin = int(s.mean(0).argmax())
+            want_bin = round(freq * n_fft / rate)
+            assert abs(peak_bin - want_bin) <= 1, (freq, peak_bin, want_bin)
+
+    def test_mel_filterbank_partitions_spectrum(self):
+        fb = np.asarray(mel_filterbank(20, 256, 8000))
+        assert fb.shape == (20, 129)
+        assert (fb >= 0).all() and fb.max() <= 1.0
+        # every filter has some support
+        assert (fb.sum(1) > 0).all()
+
+    def test_mfcc_shape_and_finite(self):
+        m = np.asarray(mfcc(_tone(700), 8000, n_mfcc=13))
+        assert m.shape[1] == 13
+        assert np.isfinite(m).all()
+
+    def test_mel_distinguishes_tones(self):
+        lo = np.asarray(mel_spectrogram(_tone(300), 8000)).mean(0)
+        hi = np.asarray(mel_spectrogram(_tone(3000), 8000)).mean(0)
+        assert lo.argmax() < hi.argmax()
+
+
+class TestReaderAndTraining:
+    def _make_tree(self, root):
+        rng = np.random.RandomState(0)
+        for cls, freq in (("low", 400), ("high", 2500)):
+            for i in range(6):
+                x = _tone(freq + rng.uniform(-50, 50), dur=0.3)
+                x += rng.randn(len(x)).astype(np.float32) * 0.02
+                write_wav(os.path.join(root, cls, f"{i}.wav"), x, 8000)
+
+    def test_reader_labels_and_shapes(self, tmp_path):
+        self._make_tree(str(tmp_path))
+        rr = WavFileRecordReader(feature="mfcc", n_frames=16).initialize(
+            str(tmp_path))
+        assert rr.labels == ["high", "low"]
+        f, l = rr.next()
+        assert f.value.shape == (16, 13)
+        assert l.value in (0, 1)
+
+    def test_conv1d_classifier_trains_from_wavs(self, tmp_path):
+        """End-to-end: on-disk WAVs -> MFCC NCW batches -> Conv1D net."""
+        from deeplearning4j_tpu.nn.config import (InputType,
+                                                  NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.layers import (Convolution1D,
+                                                  GlobalPoolingLayer,
+                                                  OutputLayer)
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.train import updaters
+
+        from deeplearning4j_tpu.data.dataset import NormalizerStandardize
+        self._make_tree(str(tmp_path))
+        rr = WavFileRecordReader(feature="mfcc", n_frames=16).initialize(
+            str(tmp_path))
+        it = AudioDataSetIterator(rr, batch_size=12)
+        # the canonical normalization flow: raw MFCCs span +/-50 and would
+        # saturate the softmax
+        norm = NormalizerStandardize()
+        norm.fit(it.next())
+        it.reset()
+        it.setPreProcessor(norm)
+        conf = (NeuralNetConfiguration.Builder().seed(3)
+                .updater(updaters.Adam(3e-3)).list()
+                .layer(Convolution1D(kernelSize=3, nOut=8, activation="relu",
+                                     convolutionMode="same"))
+                .layer(GlobalPoolingLayer("avg"))
+                .layer(OutputLayer(nOut=2, lossFunction="mcxent",
+                                   activation="softmax"))
+                .setInputType(InputType.recurrent(13, 16))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        first = None
+        for _ in range(20):
+            it.reset()
+            net.fit(it)
+            if first is None:
+                first = net.score()
+        assert np.isfinite(net.score())
+        assert net.score() < first * 0.7, (first, net.score())
