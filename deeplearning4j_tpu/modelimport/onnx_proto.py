@@ -144,7 +144,13 @@ class TensorProto:
         elif float_data:
             t.array = np.asarray(float_data, dt).reshape(shape)
         elif int_data:
-            t.array = np.asarray(int_data, dt).reshape(shape)
+            if dt.name in ("float16", "bfloat16"):
+                # ONNX stores fp16/bf16 raw bit patterns in int32_data —
+                # reinterpret the bits, never value-cast
+                t.array = (np.asarray(int_data, np.uint16)
+                           .view(dt).reshape(shape))
+            else:
+                t.array = np.asarray(int_data, dt).reshape(shape)
         else:
             t.array = np.zeros(shape, dt)
         return t

@@ -27,6 +27,11 @@ import jax
 import numpy as np
 from jax.sharding import NamedSharding
 
+# One deadline governs BOTH rank 0's sub-manifest merge and every reader's
+# wait for the merged manifest — a shorter reader wait can race a
+# legitimately slow merge (ADVICE r3).
+MANIFEST_TIMEOUT_S = 60.0
+
 
 def _flatten(tree):
     leaves, treedef = jax.tree_util.tree_flatten_with_path(tree)
@@ -93,7 +98,8 @@ def _atomic_json(path: str, payload):
     os.replace(tmp, path)
 
 
-def _merge_manifests(directory: str, step: int, timeout_s: float = 60.0):
+def _merge_manifests(directory: str, step: int,
+                     timeout_s: float = MANIFEST_TIMEOUT_S):
     import glob as _glob
     import time
     if jax.process_index() != 0:
@@ -135,10 +141,17 @@ def load_sharded(directory: str, target_tree, mesh=None, specs=None):
 
     Returns (tree, step)."""
     import time
+    if not os.path.isdir(directory):
+        raise FileNotFoundError(
+            f"load_sharded: checkpoint directory {directory!r} does not exist")
     man_path = os.path.join(directory, "manifest.json")
-    for _ in range(600):          # rank-0 merge may still be in flight
-        if os.path.exists(man_path):
-            break
+    deadline = time.monotonic() + MANIFEST_TIMEOUT_S
+    while not os.path.exists(man_path):
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"load_sharded: {man_path} did not appear within "
+                f"{MANIFEST_TIMEOUT_S}s — rank 0's manifest merge may have "
+                f"failed or the directory is not a completed checkpoint")
         time.sleep(0.05)
     with open(man_path) as f:
         manifest = json.load(f)
@@ -163,7 +176,20 @@ def load_sharded(directory: str, target_tree, mesh=None, specs=None):
         elif isinstance(leaf, jax.Array) and hasattr(leaf, "sharding"):
             sharding = leaf.sharding
         else:
-            data = shard_data(name, next(iter(entry["shards"])))
+            # host-tree leaf (numpy/scalar): assemble the FULL global array
+            # from every shard — a checkpoint saved under a sharded layout
+            # must not silently restore as one shard's slice
+            if len(entry["shards"]) == 1:
+                data = shard_data(name, next(iter(entry["shards"])))
+            else:
+                full = np.empty(tuple(entry["shape"]),
+                                dtype=np.dtype(entry["dtype"]))
+                for key in entry["shards"]:
+                    idx = tuple(slice(int(a), int(b))
+                                for a, b in
+                                (part.split(":") for part in key.split(";")))
+                    full[idx] = shard_data(name, key)
+                data = full
             pytype = entry.get("pytype")
             if pytype in ("int", "float", "bool"):
                 out_leaves.append(

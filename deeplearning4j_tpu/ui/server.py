@@ -48,6 +48,8 @@ _PAGE = """<!DOCTYPE html>
 <div class="card"><h2>Latest layer stats</h2><div id="layers"></div></div>
 <div class="card"><h2>Session</h2><div id="static" class="meta"></div></div>
 <script>
+function esc(s){return String(s).replace(/[&<>"']/g,
+  c=>({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;","'":"&#39;"}[c]));}
 function line(svg, series, names){
   const W=900,H=170,P=30; svg.innerHTML=""; svg.setAttribute("viewBox",
     "0 0 "+W+" "+H);
@@ -74,10 +76,10 @@ function line(svg, series, names){
 }
 async function refresh(){
   const sess=document.getElementById("sess").value; if(!sess)return;
-  const ov=await (await fetch("/api/overview?session="+sess)).json();
+  const ov=await (await fetch("/api/overview?session="+encodeURIComponent(sess))).json();
   line(document.getElementById("score"),
        [{x:ov.iterations,y:ov.scores}]);
-  const mo=await (await fetch("/api/model?session="+sess)).json();
+  const mo=await (await fetch("/api/model?session="+encodeURIComponent(sess))).json();
   const rsvg=document.getElementById("ratio");
   const rser=Object.entries(mo.ratio_series).slice(0,8).map(([k,v])=>(
       {x:mo.iterations,y:v.map(r=>Math.log10(r+1e-12))}));
@@ -85,13 +87,13 @@ async function refresh(){
   let rows="<table><tr><th>layer/param</th><th>mean</th><th>std</th>"+
       "<th>norm</th><th>upd norm</th><th>upd ratio</th></tr>";
   for(const [k,v] of Object.entries(mo.latest))
-    rows+=`<tr><td>${k}</td><td>${v.param_mean.toExponential(2)}</td>`+
+    rows+=`<tr><td>${esc(k)}</td><td>${v.param_mean.toExponential(2)}</td>`+
       `<td>${v.param_std.toExponential(2)}</td>`+
       `<td>${v.param_norm.toExponential(2)}</td>`+
       `<td>${v.update_norm.toExponential(2)}</td>`+
       `<td>${v.update_ratio.toExponential(2)}</td></tr>`;
   document.getElementById("layers").innerHTML=rows+"</table>";
-  const st=await (await fetch("/api/static?session="+sess)).json();
+  const st=await (await fetch("/api/static?session="+encodeURIComponent(sess))).json();
   document.getElementById("static").textContent=JSON.stringify(st);
 }
 async function syncSessions(){
@@ -99,7 +101,7 @@ async function syncSessions(){
   const sel=document.getElementById("sess");
   const cur=sel.value;
   if(ss.length !== sel.options.length){
-    sel.innerHTML=ss.map(s=>`<option>${s}</option>`).join("");
+    sel.innerHTML=ss.map(s=>`<option>${esc(s)}</option>`).join("");
     if(ss.includes(cur)) sel.value=cur;
   }
 }

@@ -224,3 +224,31 @@ class TestOnnxImport:
         got = np.asarray(sd.output(
             {"x": np.asarray([-1.0, 0.0, 2.0], np.float32)}, ["y"])["y"])
         np.testing.assert_array_equal(got, [0.0, 0.0, 2.0])
+
+class TestHalfPrecisionIntData:
+    """ADVICE r3 (low): fp16/bf16 tensors serialized via int32_data hold raw
+    bit patterns — decode must reinterpret bits, not value-cast."""
+
+    def test_fp16_int_data_bit_pattern(self):
+        vals = np.array([1.5, -2.25, 0.0078125], np.float16)
+        buf = bytearray()
+        P._w_int(buf, 1, 3)                 # dims
+        P._w_int(buf, 2, P.DT_FLOAT16)      # data_type
+        for bits in vals.view(np.uint16):   # int32_data as varints
+            P._w_int(buf, 5, int(bits))
+        t = P.TensorProto.parse(bytes(buf))
+        assert t.array.dtype == np.float16
+        np.testing.assert_array_equal(t.array, vals)
+
+    def test_bf16_int_data_bit_pattern(self):
+        import ml_dtypes
+        vals = np.array([1.0, -3.5, 0.125], ml_dtypes.bfloat16)
+        buf = bytearray()
+        P._w_int(buf, 1, 3)
+        P._w_int(buf, 2, P.DT_BFLOAT16)
+        for bits in vals.view(np.uint16):
+            P._w_int(buf, 5, int(bits))
+        t = P.TensorProto.parse(bytes(buf))
+        assert t.array.dtype == ml_dtypes.bfloat16
+        np.testing.assert_array_equal(t.array.astype(np.float32),
+                                      vals.astype(np.float32))
