@@ -727,7 +727,12 @@ class SameDiff:
         updater = cfg.updater
         total = self._total_loss_fn()
 
-        def step(variables, constants, opt_state, t, placeholders, rng_key):
+        def step(variables, constants, opt_state, t_dev, placeholders):
+            # t_dev: DONATED int32 device counter; rng derived on device from
+            # it (no per-step host uploads — they serialize the dispatch
+            # pipeline on relayed TPU backends)
+            rng_key = jax.random.fold_in(jax.random.PRNGKey(0), t_dev)
+            t = t_dev.astype(jnp.float32)
             loss, grads = jax.value_and_grad(total)(variables, constants,
                                                     placeholders, rng_key, True)
             if cfg.l1 or cfg.l2:
@@ -750,8 +755,8 @@ class SameDiff:
                     u = u + updater.weight_decay_update(variables[k], lr)
                 new_vars[k] = variables[k] - u
                 new_state[k] = s
-            return new_vars, new_state, loss
-        return jax.jit(step)
+            return new_vars, new_state, t_dev + 1, loss
+        return jax.jit(step, donate_argnums=(0, 2, 3))
 
     def fit(self, data=None, epochs: int = 1, batch_size: int = None,
             iterator=None) -> History:
@@ -794,13 +799,17 @@ class SameDiff:
                             out[name] = arr
                         yield out
 
+        # the compiled step DONATES the variable buffers; copy once per fit
+        # so arrays the caller passed to var(...) (or grabbed via getArr()
+        # before fit) survive — only framework-owned buffers get donated
+        self._variables = {k: jnp.copy(v) for k, v in self._variables.items()}
+        t_dev = jnp.asarray(self._step, jnp.int32)
         for epoch in range(epochs):
             for batch in batches():
                 phs = {k: jnp.asarray(v) for k, v in batch.items()}
-                rng = jax.random.PRNGKey(self._step)
-                self._variables, self._updater_state, loss = train_step(
+                self._variables, self._updater_state, t_dev, loss = train_step(
                     self._variables, self._constants, self._updater_state,
-                    jnp.asarray(self._step, jnp.float32), phs, rng)
+                    t_dev, phs)
                 # keep losses on-device during the epoch; convert in bulk at
                 # the end (per-step float() blocks the pipeline on every step)
                 hist.loss_curve.append(loss)

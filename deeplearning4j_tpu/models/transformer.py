@@ -241,22 +241,26 @@ def make_train_step(cfg: TransformerConfig, updater,
     """One compiled step: fwd + bwd + updater, shard-annotated."""
 
     def step(params, opt_state, t, tokens, targets, target_mask):
+        """``t`` is a DONATED int32 device scalar, incremented in-program and
+        returned — per-step host scalar uploads serialize the dispatch
+        pipeline on relayed TPU backends (see nn.multilayer._ensure_clock)."""
         loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets, cfg,
                                                   mesh, target_mask)
-        lr = updater.lr_at(t)
+        tf = t.astype(jnp.float32)
+        lr = updater.lr_at(tf)
         leaves, treedef = jax.tree_util.tree_flatten(params)
         g_leaves = treedef.flatten_up_to(grads)
         s_leaves = treedef.flatten_up_to(opt_state)
         new_p, new_s = [], []
         for pv, gv, sv in zip(leaves, g_leaves, s_leaves):
             # optimizer math in fp32 even for bf16 params
-            u, s2 = updater.apply(gv.astype(jnp.float32), sv, lr, t)
+            u, s2 = updater.apply(gv.astype(jnp.float32), sv, lr, tf)
             new_p.append((pv.astype(jnp.float32) - u).astype(pv.dtype))
             new_s.append(s2)
         return (jax.tree_util.tree_unflatten(treedef, new_p),
-                jax.tree_util.tree_unflatten(treedef, new_s), loss)
+                jax.tree_util.tree_unflatten(treedef, new_s), t + 1, loss)
 
-    return jax.jit(step, donate_argnums=(0, 1))
+    return jax.jit(step, donate_argnums=(0, 1, 2))
 
 
 def init_opt_state(params, updater):

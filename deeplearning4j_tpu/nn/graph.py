@@ -341,6 +341,7 @@ class ComputationGraph:
         self._states: Dict[str, Dict] = {}
         self._opt_state = None
         self._iteration = 0
+        self._t_dev = None  # device-resident iteration counter (see _ensure_clock)
         self._epoch = 0
         self._score = float("nan")
         self._listeners: List[Any] = []
@@ -488,17 +489,25 @@ class ComputationGraph:
         base = self.conf.base
         updater = base.updater
 
-        def step(params, states, opt_state, t, ins, labels, lmasks, key):
+        seed = base.seed
+
+        def step(params, states, opt_state, t, ins, labels, lmasks):
+            # per-step RNG from the donated device counter (see
+            # MultiLayerNetwork._make_train_step: avoids a host->device
+            # upload per iteration, stays resume-deterministic)
+            key = jax.random.fold_in(jax.random.PRNGKey(seed), t)
+
             def loss_fn(p):
                 return self._loss_and_reg(p, states, ins, labels, True, key,
                                           None, lmasks if with_lmasks else None)
             (loss, new_states), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
             new_params, new_opt = _process_and_apply_grads(
-                base, updater, params, grads, opt_state, t)
-            return new_params, new_states, new_opt, loss
-        # donate params/states/opt_state: the step consumes and replaces
-        # them, halving peak HBM for the update
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+                base, updater, params, grads, opt_state, t.astype(jnp.float32))
+            return new_params, new_states, new_opt, t + 1, loss
+        # donate params/states/opt_state/t: the step consumes and replaces
+        # them, halving peak HBM for the update and letting dependent
+        # dispatches pipeline on relayed TPU backends
+        return jax.jit(step, donate_argnums=(0, 1, 2, 3))
 
     def _ensure_opt_state(self):
         if self._opt_state is None:
@@ -506,6 +515,14 @@ class ComputationGraph:
             self._opt_state = jax.tree_util.tree_map(
                 lambda p: updater.init_state(p), self._params,
                 is_leaf=lambda x: isinstance(x, jax.Array))
+
+    def _ensure_clock(self):
+        """Device-resident iteration counter (int32 scalar), donated and
+        incremented inside the compiled step — see
+        MultiLayerNetwork._ensure_clock."""
+        if self._t_dev is None:
+            self._t_dev = jnp.asarray(self._iteration, jnp.int32)
+        return self._t_dev
 
     def fit(self, data, labels=None, epochs: int = 1):
         """Accepts a DataSetIterator, DataSet, MultiDataSet, or arrays."""
@@ -551,17 +568,15 @@ class ComputationGraph:
         if sig not in self._train_step_cache:
             self._train_step_cache[sig] = self._make_train_step(sig)
         step = self._train_step_cache[sig]
-        key = jax.random.PRNGKey(self.conf.base.seed + self._iteration + 1)
         dummy = [jnp.zeros((1,))] * len(labels)
         for lst in self._listeners:
             if hasattr(lst, "onIterationStart"):
                 # 1-based, matching iterationDone: hook pair refers to the
                 # same step number
                 lst.onIterationStart(self, self._iteration + 1)
-        self._params, self._states, self._opt_state, loss = step(
-            self._params, self._states, self._opt_state,
-            jnp.asarray(self._iteration, jnp.float32), ins, labels,
-            lmasks if lmasks is not None else dummy, key)
+        self._params, self._states, self._opt_state, self._t_dev, loss = step(
+            self._params, self._states, self._opt_state, self._ensure_clock(),
+            ins, labels, lmasks if lmasks is not None else dummy)
         # on-device; score() converts lazily (per-step host sync is ~20x the
         # step cost through a high-latency device link)
         self._score = loss

@@ -86,6 +86,7 @@ class MultiLayerNetwork:
         self._states: List[Dict] = []
         self._opt_state = None
         self._iteration = 0
+        self._t_dev = None  # device-resident iteration counter (see _ensure_clock)
         self._epoch = 0
         self._listeners: List[Any] = []
         self._train_step_cache = {}
@@ -191,23 +192,34 @@ class MultiLayerNetwork:
         # params/opt-state; handled inside the jit so buffer donation and
         # XLA DCE of the unused updates both apply
         frozen = getattr(self, "_frozen_layers", None) or set()
+        seed = base.seed
 
-        def step(params, states, opt_state, t, x, y, fmask, lmask, key):
+        def step(params, states, opt_state, t, x, y, fmask, lmask):
+            # per-step RNG derived ON DEVICE from the (donated) iteration
+            # counter: a fresh host-built PRNGKey per step costs a full
+            # host->device round trip through high-latency links, and
+            # fold_in(base, t) keeps dropout deterministic per iteration
+            # (and therefore exact-resume stable)
+            key = jax.random.fold_in(jax.random.PRNGKey(seed), t)
+            tf = t.astype(jnp.float32)
+
             def loss_fn(p):
                 return self._loss_and_reg(p, states, x, y, True, key,
                                           fmask if with_fmask else None,
                                           lmask if with_lmask else None)
             (loss, new_states), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
             new_params, new_opt = _process_and_apply_grads(
-                base, updater, params, grads, opt_state, t)
+                base, updater, params, grads, opt_state, tf)
             if frozen:
                 new_params = [params[i] if i in frozen else new_params[i]
                               for i in range(len(params))]
                 new_opt = [opt_state[i] if i in frozen else new_opt[i]
                            for i in range(len(opt_state))]
-            return new_params, new_states, new_opt, loss
-        # donate params/states/opt_state: consumed and replaced each step
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+            return new_params, new_states, new_opt, t + 1, loss
+        # donate params/states/opt_state/t: consumed and replaced each step;
+        # donation also lets dependent dispatches pipeline instead of
+        # round-tripping per step on relayed TPU backends
+        return jax.jit(step, donate_argnums=(0, 1, 2, 3))
 
     def _ensure_opt_state(self):
         if self._opt_state is None:
@@ -215,6 +227,15 @@ class MultiLayerNetwork:
             self._opt_state = jax.tree_util.tree_map(
                 lambda p: updater.init_state(p), self._params,
                 is_leaf=lambda x: isinstance(x, jax.Array))
+
+    def _ensure_clock(self):
+        """Device-resident iteration counter (int32 scalar). The compiled
+        step donates it and returns t+1, so steady-state training uploads
+        NOTHING per step — uploading a fresh host scalar each iteration
+        serializes the dispatch pipeline on high-latency device links."""
+        if self._t_dev is None:
+            self._t_dev = jnp.asarray(self._iteration, jnp.int32)
+        return self._t_dev
 
     def fit(self, data, labels=None, epochs: int = 1):
         """ref: MultiLayerNetwork.fit(DataSetIterator) — accepts an
@@ -257,18 +278,17 @@ class MultiLayerNetwork:
         if sig not in self._train_step_cache:
             self._train_step_cache[sig] = self._make_train_step(*sig)
         step = self._train_step_cache[sig]
-        key = jax.random.PRNGKey(self.conf.base.seed + self._iteration + 1)
         dummy = jnp.zeros((1,))
         for lst in self._listeners:
             if hasattr(lst, "onIterationStart"):
                 # 1-based, matching iterationDone: hook pair refers to the
                 # same step number
                 lst.onIterationStart(self, self._iteration + 1)
-        self._params, self._states, self._opt_state, loss = step(
-            self._params, self._states, self._opt_state,
-            jnp.asarray(self._iteration, jnp.float32), x, y,
+        self._params, self._states, self._opt_state, self._t_dev, loss = step(
+            self._params, self._states, self._opt_state, self._ensure_clock(),
+            x, y,
             fmask if fmask is not None else dummy,
-            lmask if lmask is not None else dummy, key)
+            lmask if lmask is not None else dummy)
         # keep the loss on-device: a float() here would block on the whole
         # step through the (high-latency) host<->device link every iteration;
         # score() converts lazily when someone actually asks
@@ -443,11 +463,12 @@ class MultiLayerNetwork:
         once after the first segment materializes RNN states)."""
         base = self.conf.base
         updater = base.updater
+        seed = base.seed
 
         def step(params, states, opt_state, t, x, y, lmask, seg_states):
             def loss_fn(p):
                 cur = x
-                key = jax.random.PRNGKey(0)
+                key = jax.random.fold_in(jax.random.PRNGKey(seed), t)
                 new_seg = []
                 for i, layer in enumerate(self.layers):
                     if i in self.conf.preprocessors:
@@ -472,9 +493,12 @@ class MultiLayerNetwork:
 
             (loss, new_seg), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
             new_params, new_opt = _process_and_apply_grads(
-                base, updater, params, grads, opt_state, t)
-            return new_params, new_opt, loss, new_seg
-        return jax.jit(step)
+                base, updater, params, grads, opt_state, t.astype(jnp.float32))
+            return new_params, new_opt, t + 1, loss, new_seg
+        # params/opt_state/t are consumed and replaced (states is read-only
+        # here — the segment threads seg_states instead, which retrace-safely
+        # starts as a list of None)
+        return jax.jit(step, donate_argnums=(0, 2, 3))
 
     def _fit_one_tbptt(self, ds: DataSet, seg_states):
         """One TBPTT segment: like _fit_one but threading initial RNN state
@@ -490,9 +514,9 @@ class MultiLayerNetwork:
         for lst in self._listeners:
             if hasattr(lst, "onIterationStart"):
                 lst.onIterationStart(self, self._iteration + 1)
-        self._params, self._opt_state, loss, new_seg = step(
+        self._params, self._opt_state, self._t_dev, loss, new_seg = step(
             self._params, self._states, self._opt_state,
-            jnp.asarray(self._iteration, jnp.float32), x, y,
+            self._ensure_clock(), x, y,
             lmask if lmask is not None else jnp.zeros((1,)), seg_states)
         self._score = loss  # on-device; score() converts lazily
         _environment.panic_check(
@@ -503,6 +527,9 @@ class MultiLayerNetwork:
     def clone(self) -> "MultiLayerNetwork":
         net = MultiLayerNetwork(self.conf)
         net.init()
-        net._params = jax.tree_util.tree_map(lambda x: x, self._params)
-        net._states = jax.tree_util.tree_map(lambda x: x, self._states)
+        # deep-copy buffers: the compiled train steps DONATE params/states,
+        # so an aliasing clone would have its arrays deleted by the donor's
+        # next fit() (and vice versa)
+        net._params = jax.tree_util.tree_map(jnp.copy, self._params)
+        net._states = jax.tree_util.tree_map(jnp.copy, self._states)
         return net

@@ -17,6 +17,7 @@ import copy
 from typing import List, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.data.dataset import DataSet
@@ -129,12 +130,14 @@ class TransferLearning:
                     continue
                 if i + 1 in self._nout_replaced or (i - 1) in self._nout_replaced:
                     pass  # neighbours of a replaced layer keep shapes unless nIn changed
+                # jnp.copy: the new net's fit() donates its buffers — an
+                # aliasing copy would delete the SOURCE net's params
                 for name, arr in src._params[i].items():
                     if name in net._params[i] and net._params[i][name].shape == arr.shape:
-                        net._params[i][name] = arr
+                        net._params[i][name] = jnp.copy(arr)
                 for name, arr in src._states[i].items():
                     if name in net._states[i] and net._states[i][name].shape == arr.shape:
-                        net._states[i][name] = arr
+                        net._states[i][name] = jnp.copy(arr)
             if self._freeze_until is not None:
                 net._frozen_layers = set(range(self._freeze_until + 1))
             return net
@@ -173,8 +176,12 @@ class TransferLearningHelper:
         new_conf.preprocessors = {}
         new_conf.layer_input_types = []
         net = MultiLayerNetwork(new_conf)
-        net._params = self.net._params[self.frozen_until + 1:]
-        net._states = self.net._states[self.frozen_until + 1:]
+        # copies, not aliases: head.fit() donates its buffers, and the
+        # trained params flow back explicitly in fitFeaturized
+        net._params = [{k: jnp.copy(v) for k, v in d.items()}
+                       for d in self.net._params[self.frozen_until + 1:]]
+        net._states = [{k: jnp.copy(v) for k, v in d.items()}
+                       for d in self.net._states[self.frozen_until + 1:]]
         net._initialized = True
         return net
 

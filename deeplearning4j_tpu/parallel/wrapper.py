@@ -51,6 +51,11 @@ class ParallelWrapper:
             model._params = self.mesh.replicate(model._params)
             model._states = self.mesh.replicate(model._states)
             model._opt_state = self.mesh.replicate(model._opt_state)
+            # reset the device-resident clock: a _t_dev committed to a single
+            # device by a previous non-mesh fit() would make the jitted step
+            # see incompatible devices; _ensure_clock rebuilds it (fresh,
+            # uncommitted) from _iteration on the first sharded step
+            model._t_dev = None
             for _ in range(epochs):
                 iterator.reset()
                 while iterator.hasNext():

@@ -356,8 +356,8 @@ class TestBertImport:
         tgt = jnp.asarray(np.roll(ids, 1, axis=1), jnp.int32)
         m = jnp.ones(ids.shape, jnp.float32)
         losses = []
+        t_dev = jnp.asarray(0, jnp.int32)
         for i in range(8):
-            params, opt, loss = step(params, opt, jnp.asarray(float(i)),
-                                     tok, tgt, m)
+            params, opt, t_dev, loss = step(params, opt, t_dev, tok, tgt, m)
             losses.append(float(loss))
         assert losses[-1] < losses[0]

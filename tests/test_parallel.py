@@ -198,9 +198,10 @@ class TestShardedTransformer:
             targets = jnp.asarray(rng.randint(0, cfg.vocab_size, (4, 32)), jnp.int32)
             mask = jnp.ones((4, 32), jnp.float32)
             losses = []
+            t_dev = jnp.asarray(0, jnp.int32)
             for t in range(3):
-                params, opt, loss = step(params, opt, jnp.asarray(t, jnp.float32),
-                                         tokens, targets, mask)
+                params, opt, t_dev, loss = step(params, opt, t_dev,
+                                                tokens, targets, mask)
                 losses.append(float(loss))
         assert all(np.isfinite(losses))
         assert losses[-1] < losses[0]
