@@ -575,6 +575,80 @@ class SameDiff:
         attrs = {"__rng__": True, **params}
         return self._record_fn(op, node_fn, input_names, name=name, attrs=attrs)
 
+    # -------------------------------------------------------- shape report
+    def infer_shapes(self, batch_size: int = 1) -> Dict[str, tuple]:
+        """Static shape of every graph variable WITHOUT executing anything
+        (ref: each DeclarableOp's shape fn feeding SameDiff.summary()).
+
+        Abstract interpretation via jax.eval_shape per node — zero FLOPs,
+        no device, no compilation. Placeholder ``None`` dims use
+        ``batch_size``; those entries are reported with the substitution
+        applied.
+        """
+        env: Dict[str, jax.ShapeDtypeStruct] = {}
+        for k, v in self._variables.items():
+            env[k] = jax.ShapeDtypeStruct(v.shape, v.dtype)
+        for k, v in self._constants.items():
+            a = jnp.asarray(v)
+            env[k] = jax.ShapeDtypeStruct(a.shape, a.dtype)
+        for k, (shape, dtype) in self._placeholders.items():
+            if shape is None:
+                # declared rank-free: shapes of everything downstream are
+                # unknown (reported as None, like the reference's -1 dims)
+                env[k] = None
+                continue
+            shape = tuple(batch_size if d in (None, -1) else int(d)
+                          for d in shape)
+            env[k] = jax.ShapeDtypeStruct(shape, dtype)
+        key_spec = jax.ShapeDtypeStruct((2,), jnp.uint32)
+        shapes = {k: (tuple(s.shape) if s is not None else None)
+                  for k, s in env.items()}
+        for node in self._nodes:
+            args = [env.get(n) for n in node.inputs]
+            if any(a is None for a in args):
+                for name in node.outputs:
+                    env[name] = None
+                    shapes[name] = None
+                continue
+            if node.attrs.get("__rng__"):
+                out = jax.eval_shape(
+                    lambda *a: node.fn(*a[:-1], a[-1], False),
+                    *args, key_spec)
+            else:
+                out = jax.eval_shape(lambda *a: node.fn(*a, **node.attrs),
+                                     *args)
+            outs = (out,) if len(node.outputs) == 1 else tuple(out)
+            for name, o in zip(node.outputs, outs):
+                leaf = jax.tree_util.tree_leaves(o)[0]
+                env[name] = jax.ShapeDtypeStruct(leaf.shape, leaf.dtype)
+                shapes[name] = tuple(leaf.shape)
+        return shapes
+
+    def summary(self, batch_size: int = 1) -> str:
+        """Printable graph summary with per-variable shapes — computed by
+        the shape functions / abstract interp, not by running the graph
+        (ref: SameDiff.summary())."""
+        shapes = self.infer_shapes(batch_size)
+        lines = [f"SameDiff: {len(self._variables)} variables, "
+                 f"{len(self._placeholders)} placeholders, "
+                 f"{len(self._nodes)} ops",
+                 f"{'name':<28} {'kind':<12} {'op':<28} shape",
+                 "-" * 80]
+        for k in self._placeholders:
+            lines.append(f"{k:<28} {'PLACEHOLDER':<12} {'':<28} "
+                         f"{shapes.get(k)}")
+        for k in self._variables:
+            lines.append(f"{k:<28} {'VARIABLE':<12} {'':<28} {shapes.get(k)}")
+        for k in self._constants:
+            if k in self._producers:
+                continue  # folded node outputs appear as ops below
+            lines.append(f"{k:<28} {'CONSTANT':<12} {'':<28} {shapes.get(k)}")
+        for node in self._nodes:
+            for o in node.outputs:
+                lines.append(f"{o:<28} {'ARRAY':<12} {node.op:<28} "
+                             f"{shapes.get(o)}")
+        return "\n".join(lines)
+
     def _rename(self, old: str, new: str):
         for d in (self._variables, self._constants, self._placeholders, self._vars):
             if old in d:
@@ -852,14 +926,6 @@ class SameDiff:
 
     def hasVariable(self, name: str) -> bool:
         return name in self._vars
-
-    def summary(self) -> str:
-        lines = [f"SameDiff: {len(self._variables)} variables, "
-                 f"{len(self._placeholders)} placeholders, {len(self._nodes)} ops"]
-        for node in self._nodes:
-            lines.append(f"  {node.op}({', '.join(node.inputs)}) -> "
-                         f"{', '.join(node.outputs)}")
-        return "\n".join(lines)
 
     # ------------------------------------------------------- save / load
     def save(self, path: str, save_updater_state: bool = True):

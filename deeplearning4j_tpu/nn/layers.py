@@ -24,6 +24,7 @@ import numpy as np
 from deeplearning4j_tpu.autodiff.samediff import _initialize
 from deeplearning4j_tpu.nn.config import InputType
 from deeplearning4j_tpu.ops import activations as act
+from deeplearning4j_tpu.ops import attention as attention_ops
 from deeplearning4j_tpu.ops import convolution as conv_ops
 from deeplearning4j_tpu.ops import losses as loss_ops
 from deeplearning4j_tpu.ops import normalization as norm_ops
@@ -1215,3 +1216,233 @@ def policy_cast(layer, params, x, compute_dt):
             lambda a: a.astype(compute_dt)
             if getattr(a, "dtype", None) == jnp.float32 else a, params)
     return params, x
+
+
+class SelfAttentionLayer(Layer):
+    """ref: layers.samediff.SelfAttentionLayer — multi-head dot-product
+    self-attention over a time series [N, nIn, T] -> [N, nOut, T].
+
+    ``projectInput=True`` (required when nHeads > 1 or nOut != nIn) learns
+    Wq/Wk/Wv: [nIn, nHeads*headSize] and Wo: [nHeads*headSize, nOut];
+    without projection it is plain scaled dot-product attention and
+    nOut == nIn. Masking: padded timesteps neither attend nor are
+    attended to (reference semantics)."""
+
+    input_kind = "rnn"
+
+    def __init__(self, nOut=None, nHeads: int = 1, headSize: int = None,
+                 projectInput: bool = True, **kw):
+        super().__init__(nOut=nOut, **kw)
+        self.n_heads = nHeads
+        self.head_size = headSize
+        self.project = projectInput
+
+    def infer_nin(self, it: InputType):
+        super().infer_nin(it)
+        if self.nOut is None:
+            self.nOut = self.nIn
+        if self.head_size is None:
+            self.head_size = self.nOut // self.n_heads
+        if not self.project:
+            if self.n_heads != 1 or self.nOut != self.nIn:
+                raise ValueError(
+                    "SelfAttentionLayer: projectInput=False requires "
+                    f"nHeads=1 and nOut==nIn (got nHeads={self.n_heads}, "
+                    f"nIn={self.nIn}, nOut={self.nOut})")
+
+    def initialize(self, key):
+        if not self.project:
+            return {}, {}
+        E = self.n_heads * self.head_size
+        ks = jax.random.split(key, 4)
+        return {"Wq": _initialize((self.nIn, E), self.weight_init, ks[0]),
+                "Wk": _initialize((self.nIn, E), self.weight_init, ks[1]),
+                "Wv": _initialize((self.nIn, E), self.weight_init, ks[2]),
+                "Wo": _initialize((E, self.nOut), self.weight_init, ks[3])}, {}
+
+    def _project_attend(self, params, q_btc, kv_btc, m):
+        """Projected multi-head attention with nIn != nHeads*headSize
+        allowed (the mha registry op assumes square E x E projections)."""
+        B, Tq = q_btc.shape[0], q_btc.shape[1]
+        H, hs = self.n_heads, self.head_size
+
+        def proj(x, w):
+            return (x @ w).reshape(x.shape[0], x.shape[1], H, hs)
+        ctx = attention_ops.dot_product_attention(
+            proj(q_btc, params["Wq"]), proj(kv_btc, params["Wk"]),
+            proj(kv_btc, params["Wv"]), mask=m)
+        return ctx.reshape(B, Tq, H * hs) @ params["Wo"]
+
+    def _attend(self, params, x, mask):
+        x_btc = jnp.transpose(x, (0, 2, 1))            # [N, T, C]
+        m = None
+        if mask is not None:
+            # block attention TO padded keys; padded queries zeroed after
+            m = mask[:, None, None, :]                 # [N, 1, 1, Tk]
+        if self.project:
+            y = self._project_attend(params, x_btc, x_btc, m)
+        else:
+            q = x_btc[:, :, None, :]
+            y = attention_ops.dot_product_attention(q, q, q, mask=m)[:, :, 0]
+        if mask is not None:
+            y = y * mask[:, :, None]
+        return jnp.transpose(y, (0, 2, 1))             # [N, nOut, T]
+
+    def apply(self, params, state, x, train, key, mask=None):
+        return self._attend(params, x, mask), state
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.recurrent(self.nOut, it.dims.get("timesteps", -1))
+
+
+class LearnedSelfAttentionLayer(SelfAttentionLayer):
+    """ref: layers.samediff.LearnedSelfAttentionLayer — attention with
+    nQueries LEARNED query vectors instead of per-timestep queries:
+    [N, nIn, T] -> [N, nOut, nQueries] (a fixed-size summary of a
+    variable-length sequence)."""
+
+    def __init__(self, nOut=None, nQueries: int = 1, **kw):
+        super().__init__(nOut=nOut, **kw)
+        self.n_queries = nQueries
+
+    def initialize(self, key):
+        params, state = super().initialize(key)
+        kq = jax.random.fold_in(key, 7)
+        params["Q"] = _initialize((self.n_queries, self.nIn),
+                                  self.weight_init, kq)
+        return params, state
+
+    def apply(self, params, state, x, train, key, mask=None):
+        x_btc = jnp.transpose(x, (0, 2, 1))            # [N, T, C]
+        q_bqc = jnp.broadcast_to(params["Q"][None],
+                                 (x.shape[0],) + params["Q"].shape)
+        m = mask[:, None, None, :] if mask is not None else None
+        if self.project:
+            y = self._project_attend(params, q_bqc, x_btc, m)
+        else:
+            q = q_bqc[:, :, None, :]
+            kv = x_btc[:, :, None, :]
+            y = attention_ops.dot_product_attention(q, kv, kv, mask=m)[:, :, 0]
+        return jnp.transpose(y, (0, 2, 1)), state      # [N, nOut, nQueries]
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.recurrent(self.nOut, self.n_queries)
+
+
+class RecurrentAttentionLayer(Layer):
+    """ref: layers.samediff.RecurrentAttentionLayer — recurrent cell whose
+    per-step input is augmented with attention over the WHOLE sequence,
+    queried by the previous hidden state:
+
+        a_t = attention(q = y_{t-1}, keys = values = x)        # [N, nIn]
+        y_t = activation(W x_t + R a_t + b)                    # [N, nOut]
+
+    Input [N, nIn, T] -> [N, nOut, T]. Sequential by construction (scan
+    over T) — the reference documents the same O(T) dependency."""
+
+    input_kind = "rnn"
+
+    def __init__(self, nOut=None, nHeads: int = 1, **kw):
+        super().__init__(nOut=nOut, **kw)
+        self.n_heads = nHeads
+        if self.activation is None:
+            self.activation = "tanh"
+
+    def set_defaults(self, base):
+        super().set_defaults(base)
+        if self.activation == "identity":
+            self.activation = "tanh"
+
+    def initialize(self, key):
+        ks = jax.random.split(key, 4)
+        return {"W": _initialize((self.nIn, self.nOut), self.weight_init, ks[0]),
+                "R": _initialize((self.nIn, self.nOut), self.weight_init, ks[1]),
+                "Wq": _initialize((self.nOut, self.nIn), self.weight_init, ks[2]),
+                "b": jnp.zeros((self.nOut,))}, {}
+
+    def apply(self, params, state, x, train, key, mask=None):
+        x_tnc = jnp.transpose(x, (2, 0, 1))            # [T, N, C]
+        act_fn = act.get(self.activation)
+        keys_btc = jnp.transpose(x, (0, 2, 1))         # [N, T, C]
+        key_mask = mask                                 # [N, T] or None
+        H = self.n_heads
+        if self.nIn % H:
+            raise ValueError(f"RecurrentAttentionLayer: nIn={self.nIn} not "
+                             f"divisible by nHeads={H}")
+        hd = self.nIn // H
+        keys_h = keys_btc.reshape(keys_btc.shape[0], keys_btc.shape[1], H, hd)
+
+        def step(y_prev, x_t):
+            q = (y_prev @ params["Wq"]).reshape(-1, H, hd)   # [N, H, hd]
+            scores = jnp.einsum("nhd,nthd->nht", q, keys_h) \
+                / np.sqrt(hd).astype(np.float32)
+            if key_mask is not None:
+                scores = jnp.where(key_mask[:, None, :] > 0, scores, -1e30)
+            w = jax.nn.softmax(scores, axis=-1)
+            a_t = jnp.einsum("nht,nthd->nhd", w, keys_h).reshape(
+                -1, self.nIn)
+            y_t = act_fn(x_t @ params["W"] + a_t @ params["R"] + params["b"])
+            return y_t, y_t
+
+        y0 = jnp.zeros((x.shape[0], self.nOut), x.dtype)
+        _, ys = jax.lax.scan(step, y0, x_tnc)          # [T, N, H]
+        out = jnp.transpose(ys, (1, 2, 0))
+        if mask is not None:
+            out = out * mask[:, None, :]
+        return out, state
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.recurrent(self.nOut, it.dims.get("timesteps", -1))
+
+
+class SameDiffLayer(Layer):
+    """ref: nn.conf.layers.samediff.SameDiffLayer — the extensibility
+    escape hatch: define a layer as a GRAPH FRAGMENT instead of a new
+    Layer subclass with hand-written forward/backward.
+
+    Subclass and override:
+    - ``defineParameters() -> {name: shape}``
+    - ``defineLayer(sd, layerInput, paramTable, mask) -> SDVariable``
+
+    The fragment is recorded ONCE into a private SameDiff instance and
+    its traced function is inlined into the enclosing network's compiled
+    step — gradients flow through it via jax.grad like any other layer
+    (the reference gets this for free from SameDiff autodiff; here both
+    the layer fragment and the host network are the same jax program).
+    """
+
+    def defineParameters(self) -> Dict[str, Tuple[int, ...]]:
+        raise NotImplementedError
+
+    def defineLayer(self, sd, layerInput, paramTable, mask=None):
+        raise NotImplementedError
+
+    def infer_nin(self, it: InputType):
+        super().infer_nin(it)
+        if self.nOut is None:
+            self.nOut = self.nIn
+
+    def initialize(self, key):
+        shapes = self.defineParameters()
+        keys = jax.random.split(key, max(len(shapes), 1))
+        params = {name: _initialize(tuple(shape), self.weight_init, k)
+                  for (name, shape), k in zip(shapes.items(), keys)}
+        self._fragment = None
+        return params, {}
+
+    def _build_fragment(self, params, x_shape):
+        from deeplearning4j_tpu.autodiff.samediff import SameDiff
+        sd = SameDiff.create()
+        xv = sd.placeHolder("layer_input", shape=x_shape)
+        pvs = {k: sd.placeHolder(k, shape=tuple(v.shape))
+               for k, v in params.items()}
+        out = self.defineLayer(sd, xv, pvs, None)
+        return sd._build_fn((out.name,)), out.name
+
+    def apply(self, params, state, x, train, key):
+        if getattr(self, "_fragment", None) is None:
+            self._fragment = self._build_fragment(params, tuple(x.shape))
+        fn, out_name = self._fragment
+        feeds = {"layer_input": x, **params}
+        res = fn({}, {}, feeds, key, train)
+        return res[out_name], state

@@ -32,7 +32,8 @@ from deeplearning4j_tpu.train import updaters as upd
 from deeplearning4j_tpu.utils import environment as _environment
 
 _MASK_AWARE = (L.LSTM, L.SimpleRnn, L.Bidirectional, L.LastTimeStep,
-               L.GlobalPoolingLayer)
+               L.GlobalPoolingLayer, L.SelfAttentionLayer,
+               L.RecurrentAttentionLayer)
 
 
 def _maybe_attach_env_profiler(model):

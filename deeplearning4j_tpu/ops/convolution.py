@@ -72,6 +72,10 @@ def conv2d(x, w, b=None, *, kernel=None, stride: IntOrPair = 1, pad: IntOrPair =
     ``w`` layout: ``[outC, inC/groups, kH, kW]`` (OIHW), matching the
     reference's weight layout for conv layers.
     """
+    from deeplearning4j_tpu.ops import shapes as _shapes
+    _shapes.check_call("conv2d", x, w, None, stride=stride, pad=pad,
+                       dilation=dilation, mode=mode, data_format=data_format,
+                       groups=groups)
     stride, pad, dilation = _pair(stride), _pair(pad), _pair(dilation)
     kernel = _pair(kernel) if kernel is not None else tuple(w.shape[2:])
     dims = _conv_dims(2, data_format)
@@ -93,6 +97,10 @@ def conv2d(x, w, b=None, *, kernel=None, stride: IntOrPair = 1, pad: IntOrPair =
 def conv1d(x, w, b=None, *, stride: int = 1, pad: int = 0, dilation: int = 1,
            mode: str = "truncate", data_format: str = "NCW", groups: int = 1):
     """1D convolution (ref: ``conv1d``); supports causal mode."""
+    from deeplearning4j_tpu.ops import shapes as _shapes
+    _shapes.check_call("conv1d", x, w, None, stride=stride, pad=pad,
+                       dilation=dilation, mode=mode, data_format=data_format,
+                       groups=groups)
     stride_, pad_, dil_ = (int(stride),), (int(pad),), (int(dilation),)
     kernel = (int(w.shape[2]),)
     dims = _conv_dims(1, data_format)
@@ -109,6 +117,9 @@ def conv1d(x, w, b=None, *, stride: int = 1, pad: int = 0, dilation: int = 1,
 def conv3d(x, w, b=None, *, stride: IntOrPair = 1, pad: IntOrPair = 0,
            dilation: IntOrPair = 1, mode: str = "truncate", data_format: str = "NCDHW"):
     """3D convolution (ref: ``conv3dnew``)."""
+    from deeplearning4j_tpu.ops import shapes as _shapes
+    _shapes.check_call("conv3d", x, w, None, stride=stride, pad=pad,
+                       dilation=dilation, mode=mode, data_format=data_format)
     stride, pad, dilation = _pair(stride, 3), _pair(pad, 3), _pair(dilation, 3)
     kernel = tuple(w.shape[2:])
     dims = _conv_dims(3, data_format)

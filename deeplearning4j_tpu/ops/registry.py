@@ -895,6 +895,11 @@ def _mirror_pad(x, paddings, mode: str = "REFLECT"):
                    else "symmetric")
 
 
+# extension families (scatter_nd, ctc, updater ops, image extras, ...)
+# registered for side effects — keeps this module the single entry point
+from deeplearning4j_tpu.ops import registry_ext as _ext  # noqa: E402,F401
+
+
 # meta info
 def summary() -> str:
     return f"{len(_REGISTRY)} ops registered, {len(_PLATFORM_OVERRIDES)} platform overrides"

@@ -1,0 +1,844 @@
+"""Op registry extension — the r4 push toward the reference's ~500-name
+declarable-op surface (VERDICT r3 missing #1; SURVEY.md §2.1).
+
+Importer-first priorities: the scatter_nd family, ctc_loss, in-graph
+updater ops (``libnd4j/include/ops/declarable/generic/updaters``), merge
+ops, image resize/crop family, absolute-value + entropy reductions,
+sparse ops, and the TF-import aliases (add_n, select, stop_gradient,
+fused_batch_norm, squared_difference, ...).
+
+Every op registered here has a validation case (goldens + FD gradcheck
+where differentiable) in ``ops/validation.py`` — the coverage gate in
+``tests/test_opvalidation.py`` fails otherwise.
+
+This module is imported for its side effects at the bottom of
+``ops/registry.py``; user code keeps a single entry point (``registry``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+import numpy as np
+
+from deeplearning4j_tpu.ops.registry import get as _get, register
+from deeplearning4j_tpu.ops import recurrent as _rnn
+
+
+# ---------------------------------------------------------------------------
+# Family: scatter_nd (ref: generic/parity_ops/scatter_nd*.cpp, scatter_mul/div)
+# ---------------------------------------------------------------------------
+
+def _nd_index(indices):
+    """[..., d] int index tensor -> tuple of d index arrays for .at[]."""
+    idx = jnp.asarray(indices).astype(jnp.int32)
+    return tuple(jnp.moveaxis(idx, -1, 0))
+
+
+@register("scatter_nd")
+def _scatter_nd(indices, updates, shape):
+    """ref: scatter_nd — build a zeros tensor of ``shape`` and ADD updates
+    at ``indices`` (duplicate indices accumulate, matching the reference)."""
+    out = jnp.zeros(tuple(int(s) for s in shape), jnp.asarray(updates).dtype)
+    return out.at[_nd_index(indices)].add(updates)
+
+
+@register("scatter_nd_add")
+def _scatter_nd_add(ref, indices, updates):
+    return jnp.asarray(ref).at[_nd_index(indices)].add(updates)
+
+
+@register("scatter_nd_sub")
+def _scatter_nd_sub(ref, indices, updates):
+    return jnp.asarray(ref).at[_nd_index(indices)].add(-jnp.asarray(updates))
+
+
+@register("scatter_nd_update")
+def _scatter_nd_update(ref, indices, updates):
+    return jnp.asarray(ref).at[_nd_index(indices)].set(updates)
+
+
+@register("scatter_mul")
+def _scatter_mul(ref, indices, updates):
+    """ref: scatter_mul — 1-D index form (rows of ``ref``)."""
+    idx = jnp.asarray(indices).astype(jnp.int32)
+    return jnp.asarray(ref).at[idx].multiply(updates)
+
+
+@register("scatter_div")
+def _scatter_div(ref, indices, updates):
+    idx = jnp.asarray(indices).astype(jnp.int32)
+    return jnp.asarray(ref).at[idx].divide(updates)
+
+
+# ---------------------------------------------------------------------------
+# Family: CTC (ref: generic/loss/ctcLoss.cpp; lstm-era ASR models)
+# ---------------------------------------------------------------------------
+
+_NEG = -1e30
+
+
+@register("ctc_loss")
+def _ctc_loss(labels, logits, label_lengths, logit_lengths,
+              blank_index: int = 0):
+    """Connectionist temporal classification loss (log-space forward
+    algorithm over the blank-extended label sequence, scanned over time —
+    XLA-friendly: static shapes, no host sync).
+
+    labels: [B, S] int (padded); logits: [B, T, C];
+    label_lengths: [B]; logit_lengths: [B]. Returns [B] neg-log-lik.
+    """
+    labels = jnp.asarray(labels).astype(jnp.int32)
+    B, S = labels.shape
+    T = logits.shape[1]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    L = 2 * S + 1
+    # extended sequence: blank l1 blank l2 ... lS blank
+    ext = jnp.full((B, L), blank_index, jnp.int32).at[:, 1::2].set(labels)
+    ext_prev2 = jnp.pad(ext[:, :-2], ((0, 0), (2, 0)), constant_values=-1)
+    allow_skip = (ext != blank_index) & (ext != ext_prev2)          # [B, L]
+    pos_valid = jnp.arange(L)[None, :] <= 2 * label_lengths[:, None]
+
+    emit0 = jnp.take_along_axis(logp[:, 0], ext, axis=-1)           # [B, L]
+    alpha = jnp.where(jnp.arange(L)[None, :] < 2, emit0, _NEG)
+    alpha = jnp.where(pos_valid, alpha, _NEG)
+
+    def step(alpha, inp):
+        logp_t, t = inp
+        prev1 = jnp.pad(alpha[:, :-1], ((0, 0), (1, 0)),
+                        constant_values=_NEG)
+        prev2 = jnp.pad(alpha[:, :-2], ((0, 0), (2, 0)),
+                        constant_values=_NEG)
+        prev2 = jnp.where(allow_skip, prev2, _NEG)
+        stacked = jnp.stack([alpha, prev1, prev2], axis=0)
+        trans = jax.scipy.special.logsumexp(stacked, axis=0)
+        emit = jnp.take_along_axis(logp_t, ext, axis=-1)
+        new = jnp.where(pos_valid, trans + emit, _NEG)
+        # frames past this batch item's logit length carry alpha unchanged
+        new = jnp.where((t < logit_lengths)[:, None], new, alpha)
+        return new, None
+
+    ts = jnp.arange(1, T)
+    alpha, _ = lax.scan(step, alpha, (jnp.moveaxis(logp[:, 1:], 1, 0), ts))
+    end = 2 * label_lengths[:, None]                                # [B, 1]
+    a_last = jnp.take_along_axis(alpha, end, axis=1)[:, 0]
+    a_prev = jnp.take_along_axis(alpha, jnp.maximum(end - 1, 0), axis=1)[:, 0]
+    return -jnp.logaddexp(a_last, a_prev)
+
+
+@register("ctc_greedy_decoder")
+def _ctc_greedy_decoder(logits, logit_lengths=None, blank_index: int = 0):
+    """Best-path decoding: argmax per frame, collapse repeats, drop blanks.
+    Returns (decoded [B, T] padded with -1, lengths [B]) — static shapes."""
+    path = jnp.argmax(logits, axis=-1).astype(jnp.int32)            # [B, T]
+    B, T = path.shape
+    prev = jnp.pad(path[:, :-1], ((0, 0), (1, 0)), constant_values=-1)
+    keep = (path != prev) & (path != blank_index)
+    if logit_lengths is not None:
+        keep = keep & (jnp.arange(T)[None, :] < logit_lengths[:, None])
+    # stable compaction: target slot = cumsum(keep)-1 for kept symbols
+    slots = jnp.cumsum(keep, axis=1) - 1
+    decoded = jnp.full((B, T), -1, jnp.int32)
+    rows = jnp.repeat(jnp.arange(B)[:, None], T, axis=1)
+    slot_ok = jnp.where(keep, slots, T - 1)
+    scattered = decoded.at[rows.ravel(), slot_ok.ravel()].max(
+        jnp.where(keep, path, -1).ravel())
+    lengths = jnp.sum(keep, axis=1)
+    return scattered, lengths
+
+
+# ---------------------------------------------------------------------------
+# Family: in-graph updater ops (ref: generic/updaters/*.cpp — sgdUpdater,
+# adamUpdater, ...). Single source of truth: train.updaters classes.
+# ---------------------------------------------------------------------------
+
+def _updater_ops():
+    from deeplearning4j_tpu.train import updaters as U
+
+    @register("sgd_updater")
+    def _sgd(grad, lr=0.1):
+        return U.Sgd(lr).apply(grad, None, lr, 0)[0]
+
+    @register("nesterovs_updater")
+    def _nesterovs(grad, v, lr=0.1, momentum=0.9):
+        u = U.Nesterovs(lr, momentum)
+        upd, s = u.apply(grad, {"v": v}, lr, 0)
+        return upd, s["v"]
+
+    @register("adam_updater")
+    def _adam(grad, m, v, lr=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+              iteration=0):
+        u = U.Adam(lr, beta1, beta2, epsilon)
+        upd, s = u.apply(grad, {"m": m, "v": v}, lr, iteration)
+        return upd, s["m"], s["v"]
+
+    @register("ams_grad_updater")
+    def _ams(grad, m, v, vhat, lr=0.001, beta1=0.9, beta2=0.999,
+             epsilon=1e-8, iteration=0):
+        u = U.AMSGrad(lr, beta1, beta2, epsilon)
+        upd, s = u.apply(grad, {"m": m, "v": v, "vhat": vhat}, lr, iteration)
+        return upd, s["m"], s["v"], s["vhat"]
+
+    @register("ada_max_updater")
+    def _adamax(grad, m, u_, lr=0.001, beta1=0.9, beta2=0.999,
+                epsilon=1e-8, iteration=0):
+        u = U.AdaMax(lr, beta1, beta2, epsilon)
+        upd, s = u.apply(grad, {"m": m, "u": u_}, lr, iteration)
+        return upd, s["m"], s["u"]
+
+    @register("nadam_updater")
+    def _nadam(grad, m, v, lr=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+               iteration=0):
+        u = U.Nadam(lr, beta1, beta2, epsilon)
+        upd, s = u.apply(grad, {"m": m, "v": v}, lr, iteration)
+        return upd, s["m"], s["v"]
+
+    @register("rms_prop_updater")
+    def _rms(grad, g2, lr=0.1, rms_decay=0.95, epsilon=1e-8):
+        u = U.RmsProp(lr, rms_decay, epsilon)
+        upd, s = u.apply(grad, {"g2": g2}, lr, 0)
+        return upd, s["g2"]
+
+    @register("ada_grad_updater")
+    def _adagrad(grad, h, lr=0.1, epsilon=1e-6):
+        u = U.AdaGrad(lr, epsilon)
+        upd, s = u.apply(grad, {"h": h}, lr, 0)
+        return upd, s["h"]
+
+    @register("ada_delta_updater")
+    def _adadelta(grad, eg2, ex2, rho=0.95, epsilon=1e-6):
+        u = U.AdaDelta(rho, epsilon)
+        upd, s = u.apply(grad, {"Eg2": eg2, "Ex2": ex2}, 1.0, 0)
+        return upd, s["Eg2"], s["Ex2"]
+
+
+_updater_ops()
+
+
+# ---------------------------------------------------------------------------
+# Family: merge ops (ref: generic/transforms/merge*.cpp)
+# ---------------------------------------------------------------------------
+
+register("mergeadd", lambda xs: sum(xs[1:], xs[0]))
+register("mergeavg", lambda xs: sum(xs[1:], xs[0]) / len(xs))
+
+
+@register("mergemax")
+def _mergemax(xs):
+    out = xs[0]
+    for x in xs[1:]:
+        out = jnp.maximum(out, x)
+    return out
+
+
+@register("mergemaxindex")
+def _mergemaxindex(xs):
+    """ref: mergemaxindex — index of the input holding the max, per element."""
+    return jnp.argmax(jnp.stack(xs, axis=0), axis=0).astype(jnp.int32)
+
+
+register("add_n", lambda xs: sum(xs[1:], xs[0]))        # TF name
+register("accumulate_n", lambda xs: sum(xs[1:], xs[0]))
+
+
+# ---------------------------------------------------------------------------
+# Family: pairwise extras + TF aliases
+# ---------------------------------------------------------------------------
+
+register("divide_no_nan", lambda a, b: jnp.where(b == 0, 0.0, a / jnp.where(
+    b == 0, 1.0, b)))
+register("truncatediv", lambda a, b: jnp.trunc(a / b))
+register("floormod", lambda a, b: a - jnp.floor(a / b) * b)
+register("squared_difference", _get("squared_subtract"))
+register("select", lambda cond, a, b: jnp.where(cond, a, b))
+register("stop_gradient", lax.stop_gradient)
+register("eps", lambda a, b, eps=1e-5: jnp.abs(a - b) < eps)
+
+
+@register("replace_nans")
+def _replace_nans(x, value=0.0):
+    return jnp.where(jnp.isnan(x), jnp.asarray(value, x.dtype), x)
+
+
+@register("compare_and_set")
+def _compare_and_set(x, compare, set_value, eps=1e-6):
+    """ref: compare_and_set — where |x - compare| < eps, write set_value."""
+    return jnp.where(jnp.abs(x - compare) < eps,
+                     jnp.asarray(set_value, x.dtype), x)
+
+
+@register("match_condition")
+def _match_condition(x, condition):
+    """ref: match_condition (count matches); ``condition`` is a
+    Conditions predicate from linalg.conditions or a plain callable."""
+    fn = condition.mask if hasattr(condition, "mask") else condition
+    return jnp.sum(fn(x).astype(jnp.int64))
+
+
+# ---------------------------------------------------------------------------
+# Family: reduction extras (ref: reduce_variance/reduce_stdev, the
+# absolute-value reduce3 family, entropy reductions)
+# ---------------------------------------------------------------------------
+
+register("reduce_variance", lambda x, axis=None, keepdims=False:
+         jnp.var(x, axis=axis, keepdims=keepdims))
+register("reduce_stdev", lambda x, axis=None, keepdims=False:
+         jnp.std(x, axis=axis, keepdims=keepdims))
+register("reduce_amax", lambda x, axis=None, keepdims=False:
+         jnp.max(jnp.abs(x), axis=axis, keepdims=keepdims))
+register("reduce_amin", lambda x, axis=None, keepdims=False:
+         jnp.min(jnp.abs(x), axis=axis, keepdims=keepdims))
+register("reduce_asum", lambda x, axis=None, keepdims=False:
+         jnp.sum(jnp.abs(x), axis=axis, keepdims=keepdims))
+register("reduce_amean", lambda x, axis=None, keepdims=False:
+         jnp.mean(jnp.abs(x), axis=axis, keepdims=keepdims))
+# entropy reductions over a probability-like tensor (ref: entropy,
+# log_entropy, shannonentropy reduce ops)
+register("entropy", lambda x, axis=None:
+         -jnp.sum(x * jnp.log(x), axis=axis))
+register("log_entropy", lambda x, axis=None:
+         jnp.log(-jnp.sum(x * jnp.log(x), axis=axis)))
+register("shannonentropy", lambda x, axis=None:
+         -jnp.sum(x * jnp.log2(x), axis=axis))
+
+
+# ---------------------------------------------------------------------------
+# Family: shape/build extras + aliases (reference names)
+# ---------------------------------------------------------------------------
+
+register("broadcast_to", lambda x, shape: jnp.broadcast_to(
+    x, tuple(int(s) for s in shape)))
+register("zeros_as", _get("zeros_like"))
+register("ones_as", _get("ones_like"))
+register("lin_space", _get("linspace"))
+register("tensormmul", _get("tensordot"))
+register("multinomial", _get("random_multinomial"))
+register("matrix_diag_part", _get("diag_part"))
+register("parallel_stack", lambda xs, axis=0: jnp.stack(xs, axis=axis))
+register("precise_gelu", lambda x: 0.5 * x * (1.0 + jax.scipy.special.erf(
+    x / np.sqrt(2.0).astype(np.float32))))
+register("softmin", lambda x, axis=-1: jax.nn.softmax(-x, axis=axis))
+register("hardswish", lambda x: x * jnp.clip(x / 6.0 + 0.5, 0.0, 1.0))
+
+
+@register("unique_with_counts")
+def _unique_with_counts(x):
+    """ref: unique_with_counts — host-shape op like ``unique``/``listdiff``
+    (data-dependent output size; rejected under jit by jnp.unique itself)."""
+    vals, idx, counts = jnp.unique(x, return_inverse=True,
+                                   return_counts=True)
+    return vals, idx.astype(jnp.int32), counts.astype(jnp.int32)
+
+
+@register("invert_permutation")
+def _invert_permutation(p):
+    p = jnp.asarray(p).astype(jnp.int32)
+    return jnp.zeros_like(p).at[p].set(jnp.arange(p.shape[0], dtype=jnp.int32))
+
+
+register("bitcast", lambda x, dtype: lax.bitcast_convert_type(x, dtype))
+
+
+@register("matrix_set_diag")
+def _matrix_set_diag(x, diag):
+    x = jnp.asarray(x)
+    n = min(x.shape[-2], x.shape[-1])
+    i = jnp.arange(n)
+    return x.at[..., i, i].set(jnp.asarray(diag)[..., :n])
+
+
+@register("toggle_bits")
+def _toggle_bits(x):
+    return jnp.invert(jnp.asarray(x))
+
+
+register("cyclic_shift_bits", lambda x, n: jnp.bitwise_or(
+    jnp.left_shift(x, n), jnp.right_shift(
+        x.astype(jnp.uint32), 32 - n).astype(x.dtype)))
+register("cyclic_rshift_bits", lambda x, n: jnp.bitwise_or(
+    jnp.right_shift(x.astype(jnp.uint32), n).astype(x.dtype),
+    jnp.left_shift(x, 32 - n)))
+
+
+# ---------------------------------------------------------------------------
+# Family: linalg extras
+# ---------------------------------------------------------------------------
+
+@register("lu_solve")
+def _lu_solve(a, b):
+    """Solve a x = b via the LU factorization path (ref: lu + solve pair)."""
+    lu_and_piv = jax.scipy.linalg.lu_factor(a)
+    return jax.scipy.linalg.lu_solve(lu_and_piv, b)
+
+
+# ---------------------------------------------------------------------------
+# Family: moments / normalization extras (ref: normalize_moments,
+# sufficient_statistics, fused_batch_norm)
+# ---------------------------------------------------------------------------
+
+@register("normalize_moments")
+def _normalize_moments(count, mean_ss, variance_ss, shift=None):
+    shift_v = 0.0 if shift is None else shift
+    mean = mean_ss / count + shift_v
+    variance = variance_ss / count - jnp.square(mean_ss / count)
+    return mean, variance
+
+
+@register("sufficient_statistics")
+def _sufficient_statistics(x, axes, shift=None):
+    axes = tuple(int(a) for a in np.atleast_1d(axes))
+    count = np.prod([x.shape[a] for a in axes]).astype(np.float32)
+    xs = x if shift is None else x - shift
+    return (jnp.asarray(count), jnp.sum(xs, axis=axes),
+            jnp.sum(jnp.square(xs), axis=axes))
+
+
+@register("fused_batch_norm")
+def _fused_batch_norm(x, scale, offset, mean=None, variance=None,
+                      epsilon: float = 1e-3, training: bool = True,
+                      data_format: str = "NHWC"):
+    """ref/TF: FusedBatchNorm — returns (y, batch_mean, batch_var)."""
+    ch_axis = -1 if data_format.upper() == "NHWC" else 1
+    axes = tuple(i for i in range(x.ndim) if i != (x.ndim + ch_axis) % x.ndim)
+    if training or mean is None:
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=axes)
+        variance = jnp.mean(jnp.square(xf), axis=axes) - jnp.square(mean)
+    sh = [1] * x.ndim
+    sh[ch_axis] = x.shape[ch_axis]
+    y = (x - mean.reshape(sh)) * lax.rsqrt(
+        variance.reshape(sh) + epsilon) * scale.reshape(sh) + offset.reshape(sh)
+    return y.astype(x.dtype), mean, variance
+
+
+# ---------------------------------------------------------------------------
+# Family: conv/pool extras (ref: deconv3d, upsampling3d, dilation2d, col2im,
+# max_pool_with_argmax, the 1-D pools)
+# ---------------------------------------------------------------------------
+
+def _conv_ops():
+    from deeplearning4j_tpu.ops import convolution as conv
+
+    register("maxpool1d", conv.maxpool1d)
+    register("avgpool1d", conv.avgpool1d)
+
+    @register("upsampling3d")
+    def _up3(x, scale=2, data_format="NCDHW"):
+        s = (scale,) * 3 if isinstance(scale, int) else tuple(scale)
+        axes = (2, 3, 4) if data_format.upper().startswith("NC") else (1, 2, 3)
+        for ax, f in zip(axes, s):
+            x = jnp.repeat(x, f, axis=ax)
+        return x
+
+    @register("deconv3d")
+    def _deconv3(x, w, b=None, stride=1, pad=0, data_format="NCDHW"):
+        """Transposed 3-D conv via lhs dilation (w: [outC, inC, kD, kH, kW])."""
+        s = (stride,) * 3 if isinstance(stride, int) else tuple(stride)
+        p = (pad,) * 3 if isinstance(pad, int) else tuple(pad)
+        kd, kh, kw = w.shape[2:]
+        spatial = "DHW"
+        lhs = ("NC" + spatial
+               if data_format.upper().startswith("NC") else "N" + spatial + "C")
+        w_t = jnp.flip(w, axis=(2, 3, 4))
+        padding = [(kd - 1 - p[0],) * 2, (kh - 1 - p[1],) * 2,
+                   (kw - 1 - p[2],) * 2]
+        out = lax.conv_general_dilated(
+            x, w_t, (1, 1, 1), padding, lhs_dilation=s,
+            dimension_numbers=(lhs, "OI" + spatial, lhs))
+        if out.dtype != x.dtype:
+            out = out.astype(x.dtype)
+        if b is not None:
+            sh = [1] * 5
+            sh[1 if lhs.startswith("NC") else -1] = b.shape[0]
+            out = out + b.reshape(sh)
+        return out
+
+
+_conv_ops()
+
+
+@register("dilation2d")
+def _dilation2d(x, filt, stride=1, rate=1):
+    """Grayscale morphological dilation (TF semantics, NHWC, VALID):
+    out[y,x,c] = max_{i,j} (in[y*s+i*r, x*s+j*r, c] + filt[i,j,c])."""
+    s = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    r = (rate, rate) if isinstance(rate, int) else tuple(rate)
+    kh, kw, _ = filt.shape
+    n, h, w, c = x.shape
+    oh = (h - (kh - 1) * r[0] - 1) // s[0] + 1
+    ow = (w - (kw - 1) * r[1] - 1) // s[1] + 1
+    out = jnp.full((n, oh, ow, c), -jnp.inf, x.dtype)
+    for i in range(kh):
+        for j in range(kw):
+            patch = x[:, i * r[0]:i * r[0] + oh * s[0]:s[0],
+                      j * r[1]:j * r[1] + ow * s[1]:s[1], :]
+            out = jnp.maximum(out, patch + filt[i, j])
+    return out
+
+
+@register("col2im")
+def _col2im(cols, h: int, w: int, stride=1, pad=0):
+    """Inverse of ``im2col``: [N, C, kH, kW, oH, oW] -> [N, C, H, W] by
+    scatter-add of overlapping patches (ref: helpers::col2im)."""
+    s = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    p = (pad, pad) if isinstance(pad, int) else tuple(pad)
+    n, c, kh, kw, oh, ow = cols.shape
+    hp, wp = h + 2 * p[0], w + 2 * p[1]
+    out = jnp.zeros((n, c, hp, wp), cols.dtype)
+    for i in range(kh):
+        for j in range(kw):
+            out = out.at[:, :, i:i + oh * s[0]:s[0],
+                         j:j + ow * s[1]:s[1]].add(cols[:, :, i, j])
+    return out[:, :, p[0]:hp - p[0], p[1]:wp - p[1]]
+
+
+@register("max_pool_with_argmax")
+def _max_pool_with_argmax(x, kernel=2, stride=None, data_format="NHWC"):
+    """NHWC max pool returning (pooled, argmax) with TF flat-index
+    semantics (index into [H*W*C] per batch item)."""
+    k = (kernel, kernel) if isinstance(kernel, int) else tuple(kernel)
+    s = k if stride is None else (
+        (stride, stride) if isinstance(stride, int) else tuple(stride))
+    n, h, w, c = x.shape
+    oh = (h - k[0]) // s[0] + 1
+    ow = (w - k[1]) // s[1] + 1
+    patches, flat_idx = [], []
+    for i in range(k[0]):
+        for j in range(k[1]):
+            patch = x[:, i:i + oh * s[0]:s[0], j:j + ow * s[1]:s[1], :]
+            patches.append(patch)
+            ys = jnp.arange(oh) * s[0] + i
+            xs = jnp.arange(ow) * s[1] + j
+            fi = (ys[:, None] * w + xs[None, :])[None, :, :, None] * c \
+                + jnp.arange(c)[None, None, None, :]
+            flat_idx.append(jnp.broadcast_to(fi, patch.shape))
+    stacked = jnp.stack(patches, axis=0)                 # [k², N, oH, oW, C]
+    which = jnp.argmax(stacked, axis=0)
+    pooled = jnp.max(stacked, axis=0)
+    argmax = jnp.take_along_axis(jnp.stack(flat_idx, axis=0),
+                                 which[None], axis=0)[0]
+    return pooled, argmax.astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# Family: losses extra
+# ---------------------------------------------------------------------------
+
+@register("mean_pairwssqerr_loss")
+def _mean_pairws(labels, predictions, weights=None):
+    """ref: mean_pairwssqerr_loss — mean over pairwise squared differences
+    of the per-element errors, per example row."""
+    d = (predictions - labels).reshape(labels.shape[0], -1)
+    m = d.shape[1]
+    # sum_{i<j} (d_i - d_j)^2 = m*sum d² - (sum d)²   (per row, then / pairs)
+    sum_d = jnp.sum(d, axis=1)
+    sum_d2 = jnp.sum(jnp.square(d), axis=1)
+    pair = jnp.maximum(m * (m - 1) / 2.0, 1.0)
+    per_ex = (m * sum_d2 - jnp.square(sum_d)) / (2.0 * pair)
+    if weights is not None:
+        per_ex = per_ex * weights
+    return jnp.mean(per_ex)
+
+
+# ---------------------------------------------------------------------------
+# Family: sparse (ref: sparse_to_dense, sparse parity ops)
+# ---------------------------------------------------------------------------
+
+@register("sparse_to_dense")
+def _sparse_to_dense(indices, shape, values, default_value=0):
+    out = jnp.full(tuple(int(s) for s in shape), default_value,
+                   jnp.asarray(values).dtype)
+    return out.at[_nd_index(indices)].set(values)
+
+
+@register("sparse_tensor_dense_matmul")
+def _sparse_dense_matmul(indices, values, dense_shape, b):
+    """COO [N,2] sparse a times dense b — rows gather + scatter-add
+    (ref: sparse_tensor_dense_matmul; XLA turns this into fused
+    gather/scatter, no dense materialization)."""
+    rows = indices[:, 0].astype(jnp.int32)
+    cols = indices[:, 1].astype(jnp.int32)
+    contrib = values[:, None] * b[cols]
+    out = jnp.zeros((int(dense_shape[0]), b.shape[1]), contrib.dtype)
+    return out.at[rows].add(contrib)
+
+
+# ---------------------------------------------------------------------------
+# Family: image extras (ref: adjust_hue/adjust_saturation/resize_*/
+# crop_and_resize/random_crop; channels-last)
+# ---------------------------------------------------------------------------
+
+def _image_ops():
+    rgb_to_hsv = _get("rgb_to_hsv")
+    hsv_to_rgb = _get("hsv_to_rgb")
+
+    @register("adjust_hue")
+    def _adjust_hue(x, delta):
+        hsv = rgb_to_hsv(x)
+        h = jnp.mod(hsv[..., 0] + delta, 1.0)
+        return hsv_to_rgb(jnp.stack([h, hsv[..., 1], hsv[..., 2]], axis=-1))
+
+    @register("adjust_saturation")
+    def _adjust_saturation(x, factor):
+        hsv = rgb_to_hsv(x)
+        s = jnp.clip(hsv[..., 1] * factor, 0.0, 1.0)
+        return hsv_to_rgb(jnp.stack([hsv[..., 0], s, hsv[..., 2]], axis=-1))
+
+
+_image_ops()
+
+
+@register("rgb_to_yiq")
+def _rgb_to_yiq(x):
+    m = jnp.asarray([[0.299, 0.59590059, 0.21153661],
+                     [0.587, -0.27455667, -0.52273617],
+                     [0.114, -0.32134392, 0.31119955]], x.dtype)
+    return x @ m
+
+
+@register("yiq_to_rgb")
+def _yiq_to_rgb(x):
+    m = jnp.asarray([[1.0, 1.0, 1.0],
+                     [0.95598634, -0.27201283, -1.10674021],
+                     [0.6208248, -0.64720424, 1.70423049]], x.dtype)
+    return x @ m
+
+
+def _resize(x, size, method):
+    n, h, w, c = x.shape
+    oh, ow = int(size[0]), int(size[1])
+    return jax.image.resize(x, (n, oh, ow, c), method=method)
+
+
+register("resize_bicubic", lambda x, size: _resize(x, size, "cubic"))
+register("resize_area", lambda x, size: _resize(x, size, "linear"))
+
+
+@register("image_resize")
+def _image_resize(x, size, method: str = "bilinear"):
+    method = {"bilinear": "linear", "nearest": "nearest",
+              "bicubic": "cubic", "area": "linear",
+              "lanczos3": "lanczos3", "lanczos5": "lanczos5"}.get(
+                  str(method).lower(), str(method))
+    return _resize(x, size, method)
+
+
+@register("crop_and_resize")
+def _crop_and_resize(image, boxes, box_indices, crop_size):
+    """ref/TF: crop_and_resize — normalized boxes [n, 4] (y1,x1,y2,x2),
+    bilinear sample to crop_size per box."""
+    image = jnp.asarray(image)
+    n, h, w, c = image.shape
+    ch, cw = int(crop_size[0]), int(crop_size[1])
+
+    def one(box, bi):
+        y1, x1, y2, x2 = box
+        ys = y1 * (h - 1) + jnp.arange(ch) / max(ch - 1, 1) * (y2 - y1) * (h - 1)
+        xs = x1 * (w - 1) + jnp.arange(cw) / max(cw - 1, 1) * (x2 - x1) * (w - 1)
+        img = image[bi]
+        y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, h - 1)
+        y1i = jnp.clip(y0 + 1, 0, h - 1)
+        x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, w - 1)
+        x1i = jnp.clip(x0 + 1, 0, w - 1)
+        wy = (ys - y0)[:, None, None]
+        wx = (xs - x0)[None, :, None]
+        a = img[y0][:, x0] * (1 - wy) * (1 - wx)
+        b = img[y0][:, x1i] * (1 - wy) * wx
+        cc = img[y1i][:, x0] * wy * (1 - wx)
+        d = img[y1i][:, x1i] * wy * wx
+        return a + b + cc + d
+
+    return jax.vmap(one)(jnp.asarray(boxes, jnp.float32),
+                         jnp.asarray(box_indices, jnp.int32))
+
+
+@register("random_crop")
+def _random_crop(key, x, size):
+    """ref: random_crop — uniform-offset crop to ``size`` (full-rank)."""
+    size = tuple(int(s) for s in size)
+    keys = jax.random.split(key, len(size))
+    starts = [jax.random.randint(k, (), 0, x.shape[i] - size[i] + 1)
+              for i, k in enumerate(keys)]
+    return lax.dynamic_slice(x, starts, size)
+
+
+# ---------------------------------------------------------------------------
+# Family: dropout variants (ref: alpha_dropout — SELU-preserving)
+# ---------------------------------------------------------------------------
+
+@register("alpha_dropout")
+def _alpha_dropout(key, x, rate: float):
+    """SELU self-normalizing dropout (ref: alpha_dropout op): dropped
+    units take alpha' = -scale*alpha, then affine-correct mean/variance."""
+    alpha_p = -1.7580993408473766
+    keep = 1.0 - rate
+    a = (keep + alpha_p ** 2 * keep * rate) ** -0.5
+    b = -a * alpha_p * rate
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    return a * jnp.where(mask, x, alpha_p) + b
+
+
+@register("gaussian_dropout")
+def _gaussian_dropout(key, x, rate: float):
+    """Multiplicative N(1, rate/(1-rate)) noise (ref: gaussian dropout)."""
+    stddev = np.sqrt(rate / (1.0 - rate)).astype(np.float32)
+    return x * (1.0 + stddev * jax.random.normal(key, x.shape, x.dtype))
+
+
+@register("gaussian_noise")
+def _gaussian_noise(key, x, stddev: float):
+    return x + stddev * jax.random.normal(key, x.shape, x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Family: embeddings / nlp training-step ops (ref: generic/nlp/{cbow,
+# skipgram}.cpp — device-side negative-sampling SGD step)
+# ---------------------------------------------------------------------------
+
+@register("skipgram")
+def _skipgram(syn0, syn1neg, center, targets, labels, lr):
+    """One skip-gram negative-sampling SGD step (ref: skipgram op).
+
+    syn0: [V, D] input vectors; syn1neg: [V, D] output vectors;
+    center: [] int; targets: [K] int (first = positive, rest = negatives);
+    labels: [K] float (1 for positive, 0 negatives); returns updated
+    (syn0, syn1neg).
+    """
+    syn0, syn1neg = jnp.asarray(syn0), jnp.asarray(syn1neg)
+    v_in = syn0[center]                                   # [D]
+    v_out = syn1neg[targets]                              # [K, D]
+    score = jax.nn.sigmoid(v_out @ v_in)                  # [K]
+    g = (labels - score) * lr                             # [K]
+    new_syn1 = syn1neg.at[targets].add(g[:, None] * v_in[None, :])
+    new_syn0 = syn0.at[center].add(g @ v_out)
+    return new_syn0, new_syn1
+
+
+@register("cbow")
+def _cbow(syn0, syn1neg, context, targets, labels, lr):
+    """One CBOW negative-sampling step: context mean predicts target
+    (ref: cbow op). context: [C] int; targets/labels as in skipgram."""
+    syn0, syn1neg = jnp.asarray(syn0), jnp.asarray(syn1neg)
+    context = jnp.asarray(context)
+    v_ctx = jnp.mean(syn0[context], axis=0)               # [D]
+    v_out = syn1neg[targets]                              # [K, D]
+    score = jax.nn.sigmoid(v_out @ v_ctx)
+    g = (labels - score) * lr
+    new_syn1 = syn1neg.at[targets].add(g[:, None] * v_ctx[None, :])
+    grad_ctx = (g @ v_out) / context.shape[0]
+    new_syn0 = syn0.at[context].add(
+        jnp.broadcast_to(grad_ctx, (context.shape[0],) + grad_ctx.shape))
+    return new_syn0, new_syn1
+
+
+# ---------------------------------------------------------------------------
+# Family: RNN sequence wrappers (ref: dynamic_rnn/static_rnn/
+# static_bidirectional_rnn over BasicLSTMCell weights) + full lstmLayer
+# ---------------------------------------------------------------------------
+
+@register("dynamic_rnn")
+def _dynamic_rnn(x, w_ih, w_hh, b, h0=None, c0=None, time_major=False):
+    """LSTM over a full sequence (ref: dynamic_rnn). x: [N, T, C] (or
+    [T, N, C] when time_major); returns (outputs, (hT, cT))."""
+    if not time_major:
+        x = jnp.moveaxis(x, 0, 1)
+    outs, hc = _rnn.lstm(x, w_ih, w_hh, b, h0=h0, c0=c0)
+    if not time_major:
+        outs = jnp.moveaxis(outs, 0, 1)
+    return outs, hc
+
+
+register("static_rnn", lambda x, w_ih, w_hh, b, h0=None, c0=None:
+         _rnn.lstm(x, w_ih, w_hh, b, h0=h0, c0=c0))
+
+
+@register("bidirectional_rnn")
+def _bidirectional_rnn(x_tnc, w_ih_f, w_hh_f, b_f, w_ih_b, w_hh_b, b_b,
+                       merge: str = "concat"):
+    """Forward + backward LSTM over [T, N, C] (ref:
+    static_bidirectional_rnn); merge: concat|sum|mul|avg."""
+    out_f, _ = _rnn.lstm(x_tnc, w_ih_f, w_hh_f, b_f)
+    out_b, _ = _rnn.lstm(x_tnc, w_ih_b, w_hh_b, b_b, reverse=True)
+    if merge == "concat":
+        return jnp.concatenate([out_f, out_b], axis=-1)
+    if merge == "sum":
+        return out_f + out_b
+    if merge == "mul":
+        return out_f * out_b
+    if merge == "avg":
+        return 0.5 * (out_f + out_b)
+    raise ValueError(f"unknown merge mode '{merge}'")
+
+
+def _lstm_layer_full(x_tnc, w_ih, w_hh, b, h0=None, c0=None, mask_tn=None,
+                     direction: str = "fwd", cell_clip: float = None,
+                     w_proj=None, w_ih_b=None, w_hh_b=None, b_b=None,
+                     merge: str = "concat"):
+    """Full-featured lstmLayer (ref: generic/recurrent/lstmLayer.cpp):
+    directions fwd/bwd/bidir (merge concat|sum|mul|avg), optional cell-state
+    clipping, optional recurrent projection (w_proj: [H, P])."""
+
+    def run(wi, wh, bb, reverse):
+        if cell_clip is None and w_proj is None:
+            return _rnn.lstm(x_tnc, wi, wh, bb, h0=h0, c0=c0,
+                             mask_tn=mask_tn, reverse=reverse)
+        T, N, _ = x_tnc.shape
+        H = wh.shape[0]
+        P = w_proj.shape[1] if w_proj is not None else H
+        h_init = h0 if h0 is not None else jnp.zeros((N, P), x_tnc.dtype)
+        c_init = c0 if c0 is not None else jnp.zeros((N, H), x_tnc.dtype)
+
+        def step(carry, inp):
+            h, c = carry
+            x_t, m_t = inp if mask_tn is not None else (inp, None)
+            gates = x_t @ wi + h @ wh + bb
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            c_new = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+            if cell_clip is not None:
+                c_new = jnp.clip(c_new, -cell_clip, cell_clip)
+            h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+            if w_proj is not None:
+                h_new = h_new @ w_proj
+            if m_t is not None:
+                # masked steps carry state unchanged and emit zeros (same
+                # contract as recurrent.lstm)
+                m = m_t[:, None]
+                h_new = jnp.where(m > 0, h_new, h)
+                c_new = jnp.where(m > 0, c_new, c)
+                return (h_new, c_new), jnp.where(m > 0, h_new, 0.0)
+            return (h_new, c_new), h_new
+
+        xs = (x_tnc, mask_tn) if mask_tn is not None else x_tnc
+        (hT, cT), outs = lax.scan(step, (h_init, c_init), xs,
+                                  reverse=reverse)
+        return outs, (hT, cT)
+
+    if direction == "fwd":
+        return run(w_ih, w_hh, b, False)
+    if direction == "bwd":
+        return run(w_ih, w_hh, b, True)
+    if direction == "bidir":
+        out_f, st_f = run(w_ih, w_hh, b, False)
+        out_b, st_b = run(w_ih_b if w_ih_b is not None else w_ih,
+                          w_hh_b if w_hh_b is not None else w_hh,
+                          b_b if b_b is not None else b, True)
+        if merge == "concat":
+            merged = jnp.concatenate([out_f, out_b], axis=-1)
+        elif merge == "sum":
+            merged = out_f + out_b
+        elif merge == "mul":
+            merged = out_f * out_b
+        elif merge == "avg":
+            merged = 0.5 * (out_f + out_b)
+        else:
+            raise ValueError(f"unknown merge mode '{merge}'")
+        return merged, (st_f, st_b)
+    raise ValueError(f"unknown direction '{direction}'")
+
+
+# shadow the basic registration with the full-featured op (the default
+# arguments reproduce the original behavior exactly)
+register("lstmLayer", _lstm_layer_full)

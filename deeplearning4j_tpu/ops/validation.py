@@ -855,7 +855,8 @@ def _build_nn_cases() -> List[OpCase]:
 
 
 def all_cases() -> List[OpCase]:
-    return _build_cases() + _build_nn_cases()
+    from deeplearning4j_tpu.ops.validation_ext import build_ext_cases
+    return _build_cases() + _build_nn_cases() + build_ext_cases()
 
 
 # --------------------------------------------------------------------------
