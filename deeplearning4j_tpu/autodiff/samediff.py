@@ -1135,6 +1135,9 @@ def _initialize(shape, init: str, rng_key=None, dtype=jnp.float32):
     if len(shape) == 4:  # conv OIHW
         rf = shape[2] * shape[3]
         fan_in, fan_out = shape[1] * rf, shape[0] * rf
+    if len(shape) == 5:  # conv3d OIDHW
+        rf = shape[2] * shape[3] * shape[4]
+        fan_in, fan_out = shape[1] * rf, shape[0] * rf
     if init == "zeros":
         return jnp.zeros(shape, dtype)
     if init == "ones":

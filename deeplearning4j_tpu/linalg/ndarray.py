@@ -772,3 +772,314 @@ def maxIndex(self) -> int:
 @_extend(NDArray)
 def minIndex(self) -> int:
     return int(jnp.argmin(self._value))
+
+
+# ---------------------------------------------------------------------------
+# r4 surface push toward the ~300-method INDArray interface (VERDICT r3 #9).
+# Families are generated like _add_methods above; the inventory test
+# (tests/test_linalg.py) asserts the method list against a checked-in set.
+# ---------------------------------------------------------------------------
+
+def _add_r4_methods():
+    # -- elementwise transform family (ref: Transforms.* instance forms) --
+    unaries = {
+        "tan": jnp.tan, "asin": jnp.arcsin, "acos": jnp.arccos,
+        "atan": jnp.arctan, "sinh": jnp.sinh, "cosh": jnp.cosh,
+        "asinh": jnp.arcsinh, "acosh": jnp.arccosh, "atanh": jnp.arctanh,
+        "log10": jnp.log10, "log2": jnp.log2, "log1p": jnp.log1p,
+        "expm1": jnp.expm1, "cbrt": jnp.cbrt, "rsqrt": jax.lax.rsqrt,
+        "reciprocal": jnp.reciprocal, "erf": jax.scipy.special.erf,
+        "erfc": jax.scipy.special.erfc, "rint": jnp.round,
+        "trunc": jnp.trunc, "square": jnp.square,
+        "cube": lambda v: v * v * v, "oneMinus": lambda v: 1.0 - v,
+        "frac": lambda v: v - jnp.trunc(v),
+        "softplus": jax.nn.softplus, "softsign": jax.nn.soft_sign,
+        "elu": jax.nn.elu, "selu": jax.nn.selu, "gelu": jax.nn.gelu,
+        "swish": jax.nn.swish, "mish": lambda v: v * jnp.tanh(
+            jax.nn.softplus(v)),
+        "hardSigmoid": jax.nn.hard_sigmoid,
+        "hardTanh": lambda v: jnp.clip(v, -1.0, 1.0),
+        "leakyRelu": lambda v: jnp.where(v >= 0, v, 0.01 * v),
+    }
+    for name, fn in unaries.items():
+        setattr(NDArray, name,
+                (lambda _f: lambda self: self._unary(_f))(fn))
+        setattr(NDArray, name + "i",
+                (lambda _f: lambda self: self._unary(_f, inplace=True))(fn))
+
+    # -- remaining broadcast-vector ops (rsub/rdiv row/column + i) --
+    rops = {"rsub": lambda a, b: b - a, "rdiv": lambda a, b: b / a}
+    for name, fn in rops.items():
+        setattr(NDArray, f"{name}RowVector", (lambda _f: lambda self, o:
+                NDArray(_like_self(self._value,
+                                   _f(self._value, _rowvec(o)))))(fn))
+        setattr(NDArray, f"{name}iRowVector", (lambda _f: lambda self, o:
+                self._set_value(_like_self(self._value,
+                                           _f(self._value, _rowvec(o)))))(fn))
+        setattr(NDArray, f"{name}ColumnVector", (lambda _f: lambda self, o:
+                NDArray(_like_self(self._value,
+                                   _f(self._value, _colvec(o)))))(fn))
+        setattr(NDArray, f"{name}iColumnVector", (lambda _f: lambda self, o:
+                self._set_value(_like_self(self._value,
+                                           _f(self._value, _colvec(o)))))(fn))
+
+    # -- in-place comparison family (ref: eqi/neqi/gti/lti/gtei/ltei write
+    # 0/1 into self, keeping self's dtype) --
+    comps = {"eqi": jnp.equal, "neqi": jnp.not_equal, "gti": jnp.greater,
+             "gtei": jnp.greater_equal, "lti": jnp.less,
+             "ltei": jnp.less_equal}
+    for name, fn in comps.items():
+        setattr(NDArray, name, (lambda _f: lambda self, o: self._set_value(
+            _f(self._value, _unwrap(o)).astype(self._value.dtype)))(fn))
+
+
+_add_r4_methods()
+
+
+@_extend(NDArray)
+def pow(self, p) -> "NDArray":
+    return NDArray(self._value ** _unwrap(p))
+
+
+@_extend(NDArray)
+def powi(self, p) -> "NDArray":
+    return self._set_value(self._value ** _unwrap(p))
+
+
+@_extend(NDArray)
+def remainderi(self, o) -> "NDArray":
+    return self._set_value(jnp.remainder(self._value, _unwrap(o)))
+
+
+@_extend(NDArray)
+def cumprodi(self, dim: int = 0) -> "NDArray":
+    return self._set_value(jnp.cumprod(self._value, axis=dim))
+
+
+@_extend(NDArray)
+def argsort(self, dim: int = -1, descending: bool = False) -> "NDArray":
+    idx = jnp.argsort(self._value, axis=dim)
+    return NDArray(jnp.flip(idx, axis=dim) if descending else idx)
+
+
+@_extend(NDArray)
+def isMax(self) -> "NDArray":
+    """1.0 where the (global) max lives (ref: isMax op)."""
+    return NDArray((self._value == jnp.max(self._value))
+                   .astype(self._value.dtype))
+
+
+@_extend(NDArray)
+def logSumExp(self, *dims) -> "NDArray":
+    axis = tuple(dims) if dims else None
+    return NDArray(jax.scipy.special.logsumexp(self._value, axis=axis))
+
+
+# -- matrix helpers --
+@_extend(NDArray)
+def diag(self) -> "NDArray":
+    """Vector -> diagonal matrix; matrix -> diagonal vector (ref: Nd4j.diag)."""
+    return NDArray(jnp.diag(self._value))
+
+
+@_extend(NDArray)
+def trace(self) -> float:
+    return float(jnp.trace(self._value))
+
+
+@_extend(NDArray)
+def outer(self, other) -> "NDArray":
+    return NDArray(jnp.outer(self._value, _unwrap(other)))
+
+
+# -- stats --
+@_extend(NDArray)
+def skewness(self, *dims) -> "NDArray":
+    v = self._value
+    axis = tuple(dims) if dims else None
+    m = jnp.mean(v, axis=axis, keepdims=True)
+    s = jnp.std(v, axis=axis, keepdims=True)
+    return NDArray(jnp.squeeze(jnp.mean(((v - m) / s) ** 3, axis=axis,
+                                        keepdims=True),
+                               axis=axis if axis else None))
+
+
+@_extend(NDArray)
+def kurtosis(self, *dims) -> "NDArray":
+    v = self._value
+    axis = tuple(dims) if dims else None
+    m = jnp.mean(v, axis=axis, keepdims=True)
+    s = jnp.std(v, axis=axis, keepdims=True)
+    return NDArray(jnp.squeeze(jnp.mean(((v - m) / s) ** 4, axis=axis,
+                                        keepdims=True) - 3.0,
+                               axis=axis if axis else None))
+
+
+@_extend(NDArray)
+def normMaxNumber(self) -> float:
+    return float(jnp.max(jnp.abs(self._value)))
+
+
+# -- shape / layout --
+def _reinstall(self, new) -> "NDArray":
+    """Swap the buffer allowing a SHAPE change — only for non-views
+    (reshapei/transposei/permutei; a view's footprint in its base is
+    fixed)."""
+    if self._base is not None:
+        raise ValueError("cannot reshape/transpose a view in place")
+    self._buf = new
+    return self
+
+
+@_extend(NDArray)
+def reshapei(self, *shape) -> "NDArray":
+    if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+        shape = tuple(shape[0])
+    return _reinstall(self, jnp.reshape(self._value, shape))
+
+
+@_extend(NDArray)
+def transposei(self) -> "NDArray":
+    return _reinstall(self, jnp.transpose(self._value))
+
+
+@_extend(NDArray)
+def permutei(self, *axes) -> "NDArray":
+    return _reinstall(self, jnp.transpose(self._value, axes))
+
+
+@_extend(NDArray)
+def moveAxis(self, src: int, dst: int) -> "NDArray":
+    return NDArray(jnp.moveaxis(self._value, src, dst))
+
+
+@_extend(NDArray)
+def repmat(self, *reps) -> "NDArray":
+    """ref: INDArray.repmat — tile like MATLAB repmat."""
+    return NDArray(jnp.tile(self._value, reps))
+
+
+@_extend(NDArray)
+def broadcastTo(self, *shape) -> "NDArray":
+    if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+        shape = tuple(shape[0])
+    return NDArray(jnp.broadcast_to(self._value, shape))
+
+
+# -- is-checks --
+@_extend(NDArray)
+def isRowVector(self) -> bool:
+    return self.rank() == 1 or (self.rank() == 2 and self.shape[0] == 1)
+
+
+@_extend(NDArray)
+def isColumnVector(self) -> bool:
+    return self.rank() == 2 and self.shape[1] == 1
+
+
+@_extend(NDArray)
+def isSquare(self) -> bool:
+    return self.rank() == 2 and self.shape[0] == self.shape[1]
+
+
+@_extend(NDArray)
+def isEmpty(self) -> bool:
+    return self.length() == 0
+
+
+# -- scalar getters / conversions --
+@_extend(NDArray)
+def getFloat(self, *idx) -> float:
+    return float(self._value[idx if len(idx) > 1 else idx[0]])
+
+
+@_extend(NDArray)
+def getLong(self, *idx) -> int:
+    return int(self._value[idx if len(idx) > 1 else idx[0]])
+
+
+@_extend(NDArray)
+def toLongVector(self):
+    return np.asarray(self._value).astype(np.int64).reshape(-1)
+
+
+@_extend(NDArray)
+def toLongMatrix(self):
+    return np.asarray(self._value).astype(np.int64)
+
+
+@_extend(NDArray)
+def toByteVector(self):
+    return np.asarray(self._value).astype(np.int8).reshape(-1)
+
+
+@_extend(NDArray)
+def data(self):
+    """Flat host view of the buffer (ref: INDArray.data())."""
+    return np.asarray(self._value).reshape(-1)
+
+
+# -- rows/columns/put --
+@_extend(NDArray)
+def getRows(self, *rows) -> "NDArray":
+    return NDArray(self._value[jnp.asarray(rows, jnp.int32)])
+
+
+@_extend(NDArray)
+def getColumns(self, *cols) -> "NDArray":
+    return NDArray(self._value[:, jnp.asarray(cols, jnp.int32)])
+
+
+@_extend(NDArray)
+def getWhere(self, comp, condition):
+    """Elements matching ``condition`` as a flat host array (ref:
+    getWhere; ``comp`` is unused here because linalg.conditions
+    predicates already carry their comparison value). Data-dependent
+    output size — an eager host op like unique/listdiff."""
+    fn = condition.mask if hasattr(condition, "mask") else condition
+    v = np.asarray(self._value)
+    return NDArray(v[np.asarray(fn(jnp.asarray(v)))].reshape(-1))
+
+
+@_extend(NDArray)
+def putWhereWithMask(self, mask, put) -> "NDArray":
+    m = _unwrap(mask)
+    return NDArray(jnp.where(m > 0, _unwrap(put), self._value))
+
+
+@_extend(NDArray)
+def putSlice(self, dim_0_index: int, value) -> "NDArray":
+    """Write a slice along dim 0 in place (ref: putSlice)."""
+    return self._set_value(self._value.at[dim_0_index].set(_unwrap(value)))
+
+
+# -- allocation-alikes --
+@_extend(NDArray)
+def like(self) -> "NDArray":
+    """Zeros with self's shape+dtype (ref: INDArray.like)."""
+    return NDArray(jnp.zeros_like(self._value))
+
+
+@_extend(NDArray)
+def ulike(self) -> "NDArray":
+    """Uninitialized-alike: same contract as like() here — XLA has no
+    uninitialized allocation (ref: INDArray.ulike)."""
+    return NDArray(jnp.zeros_like(self._value))
+
+
+# -- workspace API (ref: INDArray.detach/leverage/migrate). There are no
+# workspaces in this runtime: XLA owns allocation and buffers are
+# immutable, so these are documented identities kept for API parity. --
+@_extend(NDArray)
+def detach(self) -> "NDArray":
+    return self
+
+
+@_extend(NDArray)
+def leverage(self) -> "NDArray":
+    return self
+
+
+@_extend(NDArray)
+def migrate(self) -> "NDArray":
+    return self

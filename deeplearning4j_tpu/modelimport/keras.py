@@ -93,6 +93,11 @@ class Hdf5Archive:
             elif any(p == "forward" or p.startswith("forward_")
                      for p in parts[:-1]):
                 base = "fwd/" + base
+            elif len(parts) >= 2 and parts[-2] in ("query", "key", "value",
+                                                   "attention_output"):
+                # MultiHeadAttention sub-projections: four kernels/biases
+                # whose basenames would otherwise collide
+                base = f"{parts[-2]}/{base}"
             out[base] = np.asarray(g[key])
         return out
 
@@ -141,6 +146,12 @@ def _flatten_perm(c: int, h: int, w: int) -> np.ndarray:
     return np.arange(h * w * c).reshape(h, w, c).transpose(2, 0, 1).reshape(-1)
 
 
+def _flatten_perm3d(c: int, d: int, h: int, w: int) -> np.ndarray:
+    """Same for volumes: Keras (d, h, w, c) -> our (c, d, h, w)."""
+    return (np.arange(d * h * w * c).reshape(d, h, w, c)
+            .transpose(3, 0, 1, 2).reshape(-1))
+
+
 class _Imported:
     """One mapped layer: our layer object + how to fill its params/state."""
 
@@ -159,6 +170,9 @@ def _map_dense(cfg) -> _Imported:
         if pre_it is not None and pre_it.kind == "cnn":
             perm = _flatten_perm(pre_it.channels, pre_it.height, pre_it.width)
             W = W[perm]
+        elif pre_it is not None and pre_it.kind == "cnn3d":
+            W = W[_flatten_perm3d(pre_it.channels, pre_it.depth,
+                                  pre_it.height, pre_it.width)]
         params = {"W": jnp.asarray(W)}
         if "bias" in kw:
             params["b"] = jnp.asarray(kw["bias"])
@@ -513,6 +527,149 @@ def _map_repeat_vector(cfg) -> _Imported:
 
 _SKIP = {"InputLayer", "Flatten", "Reshape"}  # handled by preprocessors
 
+def _map_conv3d(cfg) -> _Imported:
+    mode, _ = _conv_mode(cfg.get("padding", "valid"))
+    if str(cfg.get("data_format", "channels_last")) == "channels_first":
+        raise KerasImportError("channels_first Keras convs are not "
+                               "supported; save the model channels_last")
+    dil = cfg.get("dilation_rate", (1, 1, 1))
+    if tuple(dil) != (1, 1, 1):
+        raise KerasImportError("dilated Conv3D does not import "
+                               "(Convolution3D has no dilation)")
+    lay = L.Convolution3D(kernelSize=tuple(cfg["kernel_size"]),
+                          stride=tuple(cfg.get("strides", (1, 1, 1))),
+                          nOut=int(cfg["filters"]), convolutionMode=mode,
+                          hasBias=bool(cfg.get("use_bias", True)),
+                          activation=_act(cfg.get("activation")))
+
+    def fill(kw, pre_it):
+        # keras [kD, kH, kW, inC, outC] -> ours [outC, inC, kD, kH, kW]
+        W = np.transpose(kw["kernel"], (4, 3, 0, 1, 2))
+        params = {"W": jnp.asarray(W)}
+        if "bias" in kw:
+            params["b"] = jnp.asarray(kw["bias"])
+        return params, None
+    return _Imported(lay, cfg["name"], fill)
+
+
+def _map_pool3d(cfg, pooling: str) -> _Imported:
+    mode, _ = _conv_mode(cfg.get("padding", "valid"))
+    if mode != "truncate":
+        raise KerasImportError("SAME-padded 3D pooling does not import")
+    lay = L.Subsampling3DLayer(poolingType=pooling,
+                               kernelSize=tuple(cfg.get("pool_size",
+                                                        (2, 2, 2))),
+                               stride=tuple(cfg["strides"])
+                               if cfg.get("strides") else None)
+    return _Imported(lay, cfg["name"])
+
+
+def _map_upsampling1d(cfg) -> _Imported:
+    return _Imported(L.Upsampling1D(size=int(cfg.get("size", 2))),
+                     cfg["name"])
+
+
+def _map_zero_padding1d(cfg) -> _Imported:
+    return _Imported(L.ZeroPadding1DLayer(padding=cfg.get("padding", 1)),
+                     cfg["name"])
+
+
+def _map_cropping1d(cfg) -> _Imported:
+    return _Imported(L.Cropping1D(cropping=cfg.get("cropping", 1)),
+                     cfg["name"])
+
+
+def _map_masking(cfg) -> _Imported:
+    return _Imported(L.MaskZeroLayer(maskValue=cfg.get("mask_value", 0.0)),
+                     cfg["name"])
+
+
+def _map_gaussian_noise(cfg) -> _Imported:
+    return _Imported(L.GaussianNoiseLayer(stddev=cfg.get("stddev", 0.1)),
+                     cfg["name"])
+
+
+def _map_gaussian_dropout(cfg) -> _Imported:
+    return _Imported(L.GaussianDropoutLayer(rate=cfg.get("rate", 0.1)),
+                     cfg["name"])
+
+
+def _map_alpha_dropout(cfg) -> _Imported:
+    return _Imported(L.AlphaDropoutLayer(rate=cfg.get("rate", 0.1)),
+                     cfg["name"])
+
+
+def _map_softmax_layer(cfg) -> _Imported:
+    if cfg.get("axis", -1) not in (-1, 1):
+        raise KerasImportError("Softmax layer axis must be the feature axis")
+    return _Imported(L.ActivationLayer("softmax"), cfg["name"])
+
+
+def _map_thresholded_relu(cfg) -> _Imported:
+    if abs(cfg.get("theta", 1.0) - 1.0) > 1e-9:
+        raise KerasImportError("ThresholdedReLU imports with theta=1.0 only")
+    return _Imported(L.ActivationLayer("thresholdedrelu"), cfg["name"])
+
+
+def _map_relu_layer(cfg) -> _Imported:
+    if cfg.get("max_value") is not None or cfg.get("threshold", 0.0):
+        raise KerasImportError("ReLU layer with max_value/threshold "
+                               "does not import")
+    slope = cfg.get("negative_slope", 0.0) or 0.0
+    if slope:
+        return _map_leaky_relu({**cfg, "alpha": slope})
+    return _Imported(L.ActivationLayer("relu"), cfg["name"])
+
+
+def _map_time_distributed(cfg) -> _Imported:
+    inner = cfg.get("layer", {})
+    icls = inner.get("class_name")
+    if icls != "Dense":
+        raise KerasImportError(f"TimeDistributed({icls}) unsupported "
+                               f"(Dense only)")
+    icfg = dict(inner["config"])
+    lay = L.TimeDistributed(nOut=int(icfg["units"]),
+                            activation=_act(icfg.get("activation")))
+    lay.has_bias = bool(icfg.get("use_bias", True))
+
+    def fill(kw, pre_it):
+        params = {"W": jnp.asarray(kw["kernel"])}
+        if "bias" in kw:
+            params["b"] = jnp.asarray(kw["bias"])
+        return params, None
+    return _Imported(lay, cfg["name"], fill)
+
+
+def _map_multi_head_attention(cfg) -> _Imported:
+    """Keras MultiHeadAttention used SELF-attentively (query is value).
+    keras kernels [E, H, hd] reshape to our [nIn, H*hd] projections."""
+    H = int(cfg["num_heads"])
+    hd = int(cfg["key_dim"])
+    if cfg.get("value_dim") not in (None, cfg["key_dim"]):
+        raise KerasImportError("MultiHeadAttention with value_dim != "
+                               "key_dim does not import")
+    lay = L.SelfAttentionLayer(nHeads=H, headSize=hd, projectInput=True,
+                               useBias=bool(cfg.get("use_bias", True)),
+                               activation="identity")
+
+    def fill(kw, pre_it):
+        def proj(name):
+            k = kw[f"{name}/kernel"]          # [E, H, hd]
+            return jnp.asarray(k.reshape(k.shape[0], H * hd))
+        params = {"Wq": proj("query"), "Wk": proj("key"),
+                  "Wv": proj("value"),
+                  "Wo": jnp.asarray(kw["attention_output/kernel"]
+                                    .reshape(H * hd, -1))}
+        if "query/bias" in kw:
+            params.update({
+                "bq": jnp.asarray(kw["query/bias"].reshape(-1)),
+                "bk": jnp.asarray(kw["key/bias"].reshape(-1)),
+                "bv": jnp.asarray(kw["value/bias"].reshape(-1)),
+                "bo": jnp.asarray(kw["attention_output/bias"].reshape(-1))})
+        return params, None
+    return _Imported(lay, cfg["name"], fill)
+
+
 _MAPPERS = {
     "Dense": _map_dense,
     "Conv1D": _map_conv1d,
@@ -545,6 +702,21 @@ _MAPPERS = {
     "RepeatVector": _map_repeat_vector,
     "Dropout": _map_dropout,
     "SpatialDropout2D": _map_dropout,
+    "Conv3D": _map_conv3d,
+    "MaxPooling3D": lambda c: _map_pool3d(c, "max"),
+    "AveragePooling3D": lambda c: _map_pool3d(c, "avg"),
+    "UpSampling1D": _map_upsampling1d,
+    "ZeroPadding1D": _map_zero_padding1d,
+    "Cropping1D": _map_cropping1d,
+    "Masking": _map_masking,
+    "GaussianNoise": _map_gaussian_noise,
+    "GaussianDropout": _map_gaussian_dropout,
+    "AlphaDropout": _map_alpha_dropout,
+    "Softmax": _map_softmax_layer,
+    "ThresholdedReLU": _map_thresholded_relu,
+    "ReLU": _map_relu_layer,
+    "TimeDistributed": _map_time_distributed,
+    "MultiHeadAttention": _map_multi_head_attention,
 }
 
 
@@ -556,6 +728,8 @@ def _layer_config(entry: Dict) -> Tuple[str, Dict]:
 
 def _input_type_from_batch_shape(shape: List) -> InputType:
     dims = [d for d in shape[1:]]
+    if len(dims) == 4:    # keras NDHWC -> our convolutional3D(d, h, w, c)
+        return InputType.convolutional3D(dims[0], dims[1], dims[2], dims[3])
     if len(dims) == 3:    # keras NHWC -> our convolutional(h, w, c)
         return InputType.convolutional(dims[0], dims[1], dims[2])
     if len(dims) == 2:    # keras [T, C] -> our recurrent(C, T)
@@ -663,6 +837,14 @@ class KerasModelImport:
                     continue
                 if cls not in _MAPPERS:
                     raise KerasImportError(f"unsupported Keras layer '{cls}'")
+                if cls == "MultiHeadAttention":
+                    # self-attention only: query/value/(key) must be the
+                    # same producer — collapses to one graph input
+                    if len(set(inbound)) != 1:
+                        raise KerasImportError(
+                            "MultiHeadAttention imports in self-attention "
+                            "form only (query is value)")
+                    inbound = inbound[:1]
                 imp = _MAPPERS[cls](lcfg)
                 g.addLayer(name, imp.layer, *inbound)
                 alias[name] = name

@@ -46,6 +46,13 @@ class InputType:
     def recurrent(size: int, timeseries_length: int = -1) -> "InputType":
         return InputType("rnn", size=int(size), timesteps=int(timeseries_length))
 
+    @staticmethod
+    def convolutional3D(depth: int, height: int, width: int,
+                        channels: int) -> "InputType":
+        """NCDHW volumetric input (ref: InputType.convolutional3D)."""
+        return InputType("cnn3d", depth=int(depth), height=int(height),
+                         width=int(width), channels=int(channels))
+
     def __getattr__(self, item):
         try:
             return self.dims[item]
@@ -57,6 +64,9 @@ class InputType:
             return self.dims["size"]
         if self.kind in ("cnn", "cnn_flat"):
             return self.dims["height"] * self.dims["width"] * self.dims["channels"]
+        if self.kind == "cnn3d":
+            return (self.dims["depth"] * self.dims["height"]
+                    * self.dims["width"] * self.dims["channels"])
         if self.kind == "rnn":
             return self.dims["size"] * max(self.dims["timesteps"], 1)
         raise ValueError(self.kind)

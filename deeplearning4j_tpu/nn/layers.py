@@ -1231,11 +1231,12 @@ class SelfAttentionLayer(Layer):
     input_kind = "rnn"
 
     def __init__(self, nOut=None, nHeads: int = 1, headSize: int = None,
-                 projectInput: bool = True, **kw):
+                 projectInput: bool = True, useBias: bool = False, **kw):
         super().__init__(nOut=nOut, **kw)
         self.n_heads = nHeads
         self.head_size = headSize
         self.project = projectInput
+        self.use_bias = useBias
 
     def infer_nin(self, it: InputType):
         super().infer_nin(it)
@@ -1255,10 +1256,15 @@ class SelfAttentionLayer(Layer):
             return {}, {}
         E = self.n_heads * self.head_size
         ks = jax.random.split(key, 4)
-        return {"Wq": _initialize((self.nIn, E), self.weight_init, ks[0]),
-                "Wk": _initialize((self.nIn, E), self.weight_init, ks[1]),
-                "Wv": _initialize((self.nIn, E), self.weight_init, ks[2]),
-                "Wo": _initialize((E, self.nOut), self.weight_init, ks[3])}, {}
+        params = {"Wq": _initialize((self.nIn, E), self.weight_init, ks[0]),
+                  "Wk": _initialize((self.nIn, E), self.weight_init, ks[1]),
+                  "Wv": _initialize((self.nIn, E), self.weight_init, ks[2]),
+                  "Wo": _initialize((E, self.nOut), self.weight_init, ks[3])}
+        if getattr(self, "use_bias", False):
+            params.update({"bq": jnp.zeros((E,)), "bk": jnp.zeros((E,)),
+                           "bv": jnp.zeros((E,)),
+                           "bo": jnp.zeros((self.nOut,))})
+        return params, {}
 
     def _project_attend(self, params, q_btc, kv_btc, m):
         """Projected multi-head attention with nIn != nHeads*headSize
@@ -1266,12 +1272,19 @@ class SelfAttentionLayer(Layer):
         B, Tq = q_btc.shape[0], q_btc.shape[1]
         H, hs = self.n_heads, self.head_size
 
-        def proj(x, w):
-            return (x @ w).reshape(x.shape[0], x.shape[1], H, hs)
+        def proj(x, w, b):
+            y = x @ w
+            if b is not None:
+                y = y + b
+            return y.reshape(x.shape[0], x.shape[1], H, hs)
         ctx = attention_ops.dot_product_attention(
-            proj(q_btc, params["Wq"]), proj(kv_btc, params["Wk"]),
-            proj(kv_btc, params["Wv"]), mask=m)
-        return ctx.reshape(B, Tq, H * hs) @ params["Wo"]
+            proj(q_btc, params["Wq"], params.get("bq")),
+            proj(kv_btc, params["Wk"], params.get("bk")),
+            proj(kv_btc, params["Wv"], params.get("bv")), mask=m)
+        out = ctx.reshape(B, Tq, H * hs) @ params["Wo"]
+        if params.get("bo") is not None:
+            out = out + params["bo"]
+        return out
 
     def _attend(self, params, x, mask):
         x_btc = jnp.transpose(x, (0, 2, 1))            # [N, T, C]
@@ -1446,3 +1459,275 @@ class SameDiffLayer(Layer):
         feeds = {"layer_input": x, **params}
         res = fn({}, {}, feeds, key, train)
         return res[out_name], state
+
+
+class Convolution3D(Layer):
+    """ref: layers.convolution.Convolution3D — NCDHW, W [nOut, nIn, kD, kH, kW]."""
+
+    input_kind = "cnn3d"
+
+    def __init__(self, kernelSize=(3, 3, 3), stride=(1, 1, 1),
+                 padding=(0, 0, 0), nOut=None,
+                 convolutionMode: str = "truncate", hasBias: bool = True,
+                 **kw):
+        super().__init__(nOut=nOut, **kw)
+        self.kernel = tuple(kernelSize) if isinstance(kernelSize, (tuple, list)) \
+            else (kernelSize,) * 3
+        self.stride = tuple(stride) if isinstance(stride, (tuple, list)) \
+            else (stride,) * 3
+        self.padding = tuple(padding) if isinstance(padding, (tuple, list)) \
+            else (padding,) * 3
+        self.mode = convolutionMode
+        self.has_bias = hasBias
+
+    def infer_nin(self, it: InputType):
+        if self.nIn is None:
+            self.nIn = it.channels
+
+    def initialize(self, key):
+        shape = (self.nOut, self.nIn) + self.kernel
+        params = {"W": _initialize(shape, self.weight_init, key)}
+        if self.has_bias:
+            params["b"] = jnp.full((self.nOut,), self.bias_init, jnp.float32)
+        return params, {}
+
+    def apply(self, params, state, x, train, key):
+        x = self._maybe_dropout(x, train, key)
+        out = conv_ops.conv3d(x, params["W"],
+                              params.get("b") if self.has_bias else None,
+                              stride=self.stride, pad=self.padding,
+                              mode=self.mode)
+        return act.get(self.activation)(out), state
+
+    def output_type(self, it: InputType) -> InputType:
+        dims = [conv_ops.conv_output_size(s, self.kernel[i], self.stride[i],
+                                          self.padding[i], 1, self.mode)
+                for i, s in enumerate((it.depth, it.height, it.width))]
+        return InputType.convolutional3D(dims[0], dims[1], dims[2], self.nOut)
+
+
+class Subsampling3DLayer(Layer):
+    """ref: layers.convolution.Subsampling3DLayer — NCDHW pooling."""
+
+    input_kind = "cnn3d"
+    has_params = False
+
+    def __init__(self, poolingType: str = "max", kernelSize=(2, 2, 2),
+                 stride=None, padding=(0, 0, 0), **kw):
+        super().__init__(**kw)
+        self.pooling = poolingType.lower()
+        self.kernel = tuple(kernelSize) if isinstance(kernelSize, (tuple, list)) \
+            else (kernelSize,) * 3
+        self.stride = tuple(stride) if stride is not None else self.kernel
+        self.padding = tuple(padding) if isinstance(padding, (tuple, list)) \
+            else (padding,) * 3
+
+    def infer_nin(self, it: InputType):
+        self.nIn = self.nOut = it.channels
+
+    def initialize(self, key):
+        return {}, {}
+
+    def apply(self, params, state, x, train, key):
+        fn = conv_ops.maxpool3d if self.pooling == "max" else conv_ops.avgpool3d
+        return fn(x, kernel=self.kernel, stride=self.stride,
+                  pad=self.padding), state
+
+    def output_type(self, it: InputType) -> InputType:
+        dims = [conv_ops.conv_output_size(s, self.kernel[i], self.stride[i],
+                                          self.padding[i], 1, "truncate")
+                for i, s in enumerate((it.depth, it.height, it.width))]
+        return InputType.convolutional3D(dims[0], dims[1], dims[2], it.channels)
+
+
+class Upsampling1D(Layer):
+    """ref: layers.convolution.Upsampling1D — [N, C, T] repeat along T."""
+
+    input_kind = "rnn"
+    has_params = False
+
+    def __init__(self, size: int = 2, **kw):
+        super().__init__(**kw)
+        self.size = int(size)
+
+    def infer_nin(self, it):
+        self.nIn = self.nOut = it.size
+
+    def initialize(self, key):
+        return {}, {}
+
+    def apply(self, params, state, x, train, key):
+        return jnp.repeat(x, self.size, axis=2), state
+
+    def output_type(self, it: InputType) -> InputType:
+        t = it.dims.get("timesteps", -1)
+        return InputType.recurrent(it.size, t * self.size if t > 0 else -1)
+
+
+class ZeroPadding1DLayer(Layer):
+    """ref: layers.convolution.ZeroPadding1DLayer — pad along T."""
+
+    input_kind = "rnn"
+    has_params = False
+
+    def __init__(self, padding=1, **kw):
+        super().__init__(**kw)
+        self.pad = tuple(padding) if isinstance(padding, (tuple, list)) \
+            else (int(padding), int(padding))
+
+    def infer_nin(self, it):
+        self.nIn = self.nOut = it.size
+
+    def initialize(self, key):
+        return {}, {}
+
+    def apply(self, params, state, x, train, key):
+        return jnp.pad(x, [(0, 0), (0, 0), tuple(self.pad)]), state
+
+    def output_type(self, it: InputType) -> InputType:
+        t = it.dims.get("timesteps", -1)
+        return InputType.recurrent(it.size,
+                                   t + sum(self.pad) if t > 0 else -1)
+
+
+class Cropping1D(Layer):
+    """ref: layers.convolution.Cropping1D."""
+
+    input_kind = "rnn"
+    has_params = False
+
+    def __init__(self, cropping=1, **kw):
+        super().__init__(**kw)
+        self.crop = tuple(cropping) if isinstance(cropping, (tuple, list)) \
+            else (int(cropping), int(cropping))
+
+    def infer_nin(self, it):
+        self.nIn = self.nOut = it.size
+
+    def initialize(self, key):
+        return {}, {}
+
+    def apply(self, params, state, x, train, key):
+        t = x.shape[2]
+        return x[:, :, self.crop[0]:t - self.crop[1]], state
+
+    def output_type(self, it: InputType) -> InputType:
+        t = it.dims.get("timesteps", -1)
+        return InputType.recurrent(it.size,
+                                   t - sum(self.crop) if t > 0 else -1)
+
+
+class MaskZeroLayer(Layer):
+    """ref: layers.recurrent.MaskZeroLayer / Keras Masking — zero out
+    timesteps whose EVERY feature equals ``maskValue`` (the mask itself
+    flows separately; this matches Keras Masking's forward zeroing)."""
+
+    input_kind = "rnn"
+    has_params = False
+
+    def __init__(self, maskValue: float = 0.0, **kw):
+        super().__init__(**kw)
+        self.mask_value = float(maskValue)
+
+    def infer_nin(self, it):
+        self.nIn = self.nOut = it.size
+
+    def initialize(self, key):
+        return {}, {}
+
+    def apply(self, params, state, x, train, key):
+        keep = jnp.any(x != self.mask_value, axis=1, keepdims=True)
+        return jnp.where(keep, x, 0.0), state
+
+    def output_type(self, it: InputType) -> InputType:
+        return it
+
+
+class GaussianNoiseLayer(Layer):
+    """ref/Keras: GaussianNoise — additive N(0, stddev) noise, train only."""
+
+    input_kind = None
+    has_params = False
+
+    def __init__(self, stddev: float = 0.1, **kw):
+        super().__init__(**kw)
+        self.stddev = float(stddev)
+
+    def infer_nin(self, it):
+        self.nIn = self.nOut = it.arrayElementsPerExample()
+
+    def initialize(self, key):
+        return {}, {}
+
+    def apply(self, params, state, x, train, key):
+        if not train:
+            return x, state
+        return x + self.stddev * jax.random.normal(key, x.shape, x.dtype), state
+
+    def output_type(self, it):
+        return it
+
+
+class GaussianDropoutLayer(GaussianNoiseLayer):
+    """ref/Keras: GaussianDropout — multiplicative N(1, rate/(1-rate))."""
+
+    def __init__(self, rate: float = 0.1, **kw):
+        super(GaussianNoiseLayer, self).__init__(**kw)
+        self.rate = float(rate)
+
+    def apply(self, params, state, x, train, key):
+        if not train or self.rate <= 0:
+            return x, state
+        stddev = float(np.sqrt(self.rate / (1.0 - self.rate)))
+        noise = 1.0 + stddev * jax.random.normal(key, x.shape, x.dtype)
+        return x * noise, state
+
+
+class AlphaDropoutLayer(GaussianNoiseLayer):
+    """ref/Keras: AlphaDropout — SELU self-normalizing dropout."""
+
+    def __init__(self, rate: float = 0.1, **kw):
+        super(GaussianNoiseLayer, self).__init__(**kw)
+        self.rate = float(rate)
+
+    def apply(self, params, state, x, train, key):
+        if not train or self.rate <= 0:
+            return x, state
+        from deeplearning4j_tpu.ops import registry as _R
+        return _R.get("alpha_dropout")(key, x, self.rate), state
+
+
+class TimeDistributed(Layer):
+    """ref/Keras: TimeDistributed(Dense) — the wrapped dense applied at
+    every timestep of [N, C, T] (DL4J expresses this as DenseLayer with
+    RnnToFF/FFToRnn preprocessors; here it is one einsum)."""
+
+    input_kind = "rnn"
+
+    def __init__(self, inner: "DenseLayer" = None, nOut=None, **kw):
+        if inner is not None and not isinstance(inner, DenseLayer):
+            raise ValueError("TimeDistributed supports a Dense inner layer")
+        super().__init__(nOut=nOut if nOut is not None
+                         else (inner.nOut if inner else None), **kw)
+        if inner is not None and self.activation is None:
+            self.activation = inner.activation
+        self.has_bias = inner.has_bias if inner is not None else True
+
+    def initialize(self, key):
+        params = {"W": _initialize((self.nIn, self.nOut), self.weight_init,
+                                   key)}
+        if self.has_bias:
+            params["b"] = jnp.full((self.nOut,), self.bias_init, jnp.float32)
+        return params, {}
+
+    def apply(self, params, state, x, train, key):
+        z = jnp.einsum("nct,ch->nht", x, params["W"])
+        if self.has_bias:
+            z = z + params["b"][None, :, None]
+        a = act.get(self.activation)(z, axis=1) \
+            if self.activation in ("softmax", "logsoftmax") \
+            else act.get(self.activation)(z)
+        return a, state
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.recurrent(self.nOut, it.dims.get("timesteps", -1))

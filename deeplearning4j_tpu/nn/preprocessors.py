@@ -37,13 +37,14 @@ class FeedForwardToCnn(Preprocessor):
 
 
 class CnnToFeedForward(Preprocessor):
-    """[N, c, h, w] -> [N, c*h*w] (ref: CnnToFeedForwardPreProcessor)."""
+    """[N, c, *spatial] -> [N, c*prod(spatial)] (ref:
+    CnnToFeedForwardPreProcessor; also flattens 3-D volumes)."""
 
     def __call__(self, x):
         return jnp.reshape(x, (x.shape[0], -1))
 
     def output_type(self, it: InputType) -> InputType:
-        return InputType.feedForward(it.channels * it.height * it.width)
+        return InputType.feedForward(it.arrayElementsPerExample())
 
 
 class RnnToFeedForward(Preprocessor):
@@ -96,6 +97,8 @@ def preprocessor_for(input_type: InputType, layer) -> Preprocessor | None:
         return None  # already flat rows
     if input_type.kind == "cnn" and need == "ff":
         return CnnToFeedForward()
+    if input_type.kind == "cnn3d" and need == "ff":
+        return CnnToFeedForward()  # flatten works for any spatial rank
     if input_type.kind == "ff" and need == "cnn":
         raise ValueError("feedForward input into a conv layer needs explicit "
                          "InputType.convolutionalFlat(...)")

@@ -1,0 +1,348 @@
+"""A3C + the policy abstraction (VERDICT r3 #10).
+
+Reference parity: ``org.deeplearning4j.rl4j.learning.async.a3c
+.discrete.A3CDiscreteDense`` and the policy hierarchy ``rl4j.policy.
+{Policy, ACPolicy, DQNPolicy, EpsGreedy}`` (SURVEY.md §2.2 rl4j).
+
+TPU-native shape: the reference runs N async learner threads each
+computing gradients in its own copy and applying them Hogwild-style to
+shared params. Here N rollout workers (threads, one MDP instance each)
+act with the CURRENT shared params and push n-step rollouts to a queue;
+ONE trainer applies a single compiled advantage-actor-critic step
+(policy gradient + value regression + entropy bonus, Adam) per rollout.
+On a single chip this preserves A3C's decorrelated-experience property
+(the point of the async design) while keeping every update inside one
+XLA program — applying Hogwild to donated device buffers would serialize
+on the device anyway.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.rl.dqn import _mlp_apply, _mlp_init
+from deeplearning4j_tpu.rl.mdp import MDP
+
+
+# ------------------------------------------------------------------ policies
+class Policy:
+    """ref: rl4j.policy.Policy — maps observations to actions and can
+    play an episode on an MDP."""
+
+    def nextAction(self, obs) -> int:
+        raise NotImplementedError
+
+    def play(self, mdp: MDP, max_steps: int = 1000) -> float:
+        obs = mdp.reset()
+        total = 0.0
+        for _ in range(max_steps):
+            obs, r, done = mdp.step(self.nextAction(obs))
+            total += r
+            if done:
+                break
+        return total
+
+
+class DQNPolicy(Policy):
+    """ref: rl4j.policy.DQNPolicy — greedy over a Q-network."""
+
+    def __init__(self, q_fn: Callable, params):
+        self._q_fn = q_fn
+        self._params = params
+
+    def nextAction(self, obs) -> int:
+        q = self._q_fn(self._params, jnp.asarray(obs, jnp.float32)[None])
+        return int(jnp.argmax(q[0]))
+
+
+class ACPolicy(Policy):
+    """ref: rl4j.policy.ACPolicy — samples from the actor's softmax (or
+    argmax when deterministic)."""
+
+    def __init__(self, pi_fn: Callable, params, deterministic: bool = False,
+                 seed: int = 0):
+        self._pi_fn = pi_fn
+        self._params = params
+        self._det = deterministic
+        self._rng = np.random.RandomState(seed)
+
+    def nextAction(self, obs) -> int:
+        logits = np.asarray(
+            self._pi_fn(self._params, jnp.asarray(obs, jnp.float32)[None]))[0]
+        if self._det:
+            return int(np.argmax(logits))
+        p = np.exp(logits.astype(np.float64) - logits.max())
+        p /= p.sum()   # float64: np.random.choice rejects float32 round-off
+        return int(self._rng.choice(len(p), p=p))
+
+
+class EpsGreedy(Policy):
+    """ref: rl4j.policy.EpsGreedy — anneals exploration around any policy."""
+
+    def __init__(self, inner: Policy, action_space_n: int,
+                 eps_start: float = 1.0, eps_end: float = 0.05,
+                 anneal_steps: int = 1000, seed: int = 0):
+        self.inner = inner
+        self.n = action_space_n
+        self.eps_start, self.eps_end = eps_start, eps_end
+        self.anneal = anneal_steps
+        self._t = 0
+        self._rng = np.random.RandomState(seed)
+
+    def epsilon(self) -> float:
+        frac = min(self._t / max(self.anneal, 1), 1.0)
+        return self.eps_start + (self.eps_end - self.eps_start) * frac
+
+    def nextAction(self, obs) -> int:
+        self._t += 1
+        if self._rng.rand() < self.epsilon():
+            return int(self._rng.randint(self.n))
+        return self.inner.nextAction(obs)
+
+
+# ----------------------------------------------------------------------- A3C
+class A3CConfiguration:
+    """ref: A3CConfiguration (rl4j async configs)."""
+
+    def __init__(self, seed: int = 123, gamma: float = 0.99,
+                 learning_rate: float = 7e-3, n_step: int = 16,
+                 num_threads: int = 2, max_steps: int = 12000,
+                 entropy_beta: float = 0.01, value_coef: float = 0.25,
+                 max_episode_steps: int = 500):
+        self.seed = seed
+        self.gamma = gamma
+        self.learning_rate = learning_rate
+        self.n_step = n_step
+        self.num_threads = num_threads
+        self.max_steps = max_steps
+        self.entropy_beta = entropy_beta
+        self.value_coef = value_coef
+        self.max_episode_steps = max_episode_steps
+
+
+class A3CDiscreteDense:
+    """ref: A3CDiscreteDense — advantage actor-critic over a dense MLP
+    with shared trunk and separate policy/value heads."""
+
+    def __init__(self, mdp_factory: Callable[[int], MDP],
+                 conf: A3CConfiguration = None,
+                 hidden: Tuple[int, ...] = (64,)):
+        self.conf = conf or A3CConfiguration()
+        self.mdp_factory = mdp_factory
+        probe = mdp_factory(0)
+        self.obs_dim = int(np.prod(probe.getObservationSpace().shape))
+        self.n_actions = probe.getActionSpace().n
+        probe.close()
+        rng = np.random.RandomState(self.conf.seed)
+        trunk_sizes = [self.obs_dim, *hidden]
+        self._n_trunk = len(trunk_sizes) - 1
+        self.params: Dict = _mlp_init(rng, trunk_sizes)
+        H = trunk_sizes[-1]
+        lim = float(np.sqrt(6.0 / (H + self.n_actions)))
+        self.params["Wpi"] = jnp.asarray(
+            rng.uniform(-lim, lim, (H, self.n_actions)).astype(np.float32))
+        self.params["bpi"] = jnp.zeros((self.n_actions,), jnp.float32)
+        limv = float(np.sqrt(6.0 / (H + 1)))
+        self.params["Wv"] = jnp.asarray(
+            rng.uniform(-limv, limv, (H, 1)).astype(np.float32))
+        self.params["bv"] = jnp.zeros((1,), jnp.float32)
+        self.opt_state = jax.tree_util.tree_map(
+            lambda p: (jnp.zeros_like(p), jnp.zeros_like(p)), self.params)
+        self._t = jnp.asarray(0, jnp.int32)
+        self._step_fn = self._make_step()
+        self._pi_fn = jax.jit(self._logits)
+        self.episode_rewards: List[float] = []
+        self._lock = threading.Lock()
+
+    # ---------------------------------------------------------- networks
+    def _trunk(self, params, x):
+        for i in range(self._n_trunk):
+            x = jax.nn.relu(x @ params[f"W{i}"] + params[f"b{i}"])
+        return x
+
+    def _logits(self, params, x):
+        h = self._trunk(params, x)
+        return h @ params["Wpi"] + params["bpi"]
+
+    def _value(self, params, x):
+        h = self._trunk(params, x)
+        return (h @ params["Wv"] + params["bv"])[..., 0]
+
+    # ------------------------------------------------------------- update
+    def _make_step(self):
+        gamma = self.conf.gamma
+        beta = self.conf.entropy_beta
+        vc = self.conf.value_coef
+        lr = self.conf.learning_rate
+        b1, b2, eps = 0.9, 0.999, 1e-8
+
+        def loss_fn(params, obs, actions, returns, mask):
+            """Rollouts arrive PADDED to n_step with a validity mask —
+            one static shape, one compiled program (a per-length retrace
+            costs more than the whole rollout on small nets)."""
+            n = jnp.maximum(jnp.sum(mask), 1.0)
+            logits = self._logits(params, obs)
+            logp = jax.nn.log_softmax(logits)
+            v = self._value(params, obs)
+            adv = (returns - v) * mask
+            # per-rollout advantage normalization: keeps the policy
+            # gradient scale independent of the (growing) return scale
+            a = jax.lax.stop_gradient(adv)
+            mean = jnp.sum(a) / n
+            std = jnp.sqrt(jnp.sum(jnp.square((a - mean) * mask)) / n)
+            a = (a - mean) * mask / (std + 1e-6)
+            pg = -jnp.sum(jnp.take_along_axis(
+                logp, actions[:, None], axis=1)[:, 0] * a) / n
+            v_loss = jnp.sum(jnp.square(adv)) / n
+            entropy = -jnp.sum(
+                jnp.sum(jnp.exp(logp) * logp, axis=1) * mask) / n
+            return pg + vc * v_loss - beta * entropy
+
+        @jax.jit
+        def step(params, opt_state, t, obs, actions, returns, mask):
+            loss, grads = jax.value_and_grad(loss_fn)(params, obs, actions,
+                                                      returns, mask)
+            tf = t.astype(jnp.float32) + 1.0
+
+            def adam(p, g, st):
+                m, v = st
+                m = b1 * m + (1 - b1) * g
+                v = b2 * v + (1 - b2) * jnp.square(g)
+                a = lr * jnp.sqrt(1 - b2 ** tf) / (1 - b1 ** tf)
+                return p - a * m / (jnp.sqrt(v) + eps), (m, v)
+
+            flat = jax.tree_util.tree_map(adam, params, grads, opt_state,
+                                          is_leaf=lambda x: isinstance(
+                                              x, jax.Array))
+            new_p = jax.tree_util.tree_map(
+                lambda pair: pair[0], flat,
+                is_leaf=lambda x: isinstance(x, tuple)
+                and len(x) == 2 and isinstance(x[0], jax.Array))
+            new_s = jax.tree_util.tree_map(
+                lambda pair: pair[1], flat,
+                is_leaf=lambda x: isinstance(x, tuple)
+                and len(x) == 2 and isinstance(x[0], jax.Array))
+            return new_p, new_s, t + 1, loss
+        return step
+
+    # ------------------------------------------------------------ training
+    def _worker(self, wid: int, rollouts: "queue.Queue",
+                stop: threading.Event):
+        try:
+            self._worker_body(wid, rollouts, stop)
+        except BaseException as e:   # surface worker crashes to train()
+            self._worker_error = e
+            stop.set()
+
+    def _worker_body(self, wid: int, rollouts: "queue.Queue",
+                     stop: threading.Event):
+        mdp = self.mdp_factory(self.conf.seed + 100 + wid)
+        rng = np.random.RandomState(self.conf.seed + 200 + wid)
+        gamma = self.conf.gamma
+        obs = mdp.reset()
+        ep_reward, ep_steps = 0.0, 0
+        while not stop.is_set():
+            with self._lock:
+                params = self.params
+            traj_o, traj_a, traj_r = [], [], []
+            done = False
+            for _ in range(self.conf.n_step):
+                logits = np.asarray(self._pi_fn(
+                    params, jnp.asarray(obs, jnp.float32)[None]))[0]
+                p = np.exp(logits.astype(np.float64) - logits.max())
+                p /= p.sum()
+                a = int(rng.choice(self.n_actions, p=p))
+                nxt, r, done = mdp.step(a)
+                traj_o.append(np.asarray(obs, np.float32))
+                traj_a.append(a)
+                traj_r.append(r)
+                ep_reward += r
+                ep_steps += 1
+                obs = nxt
+                if done or ep_steps >= self.conf.max_episode_steps:
+                    break
+            # n-step discounted returns bootstrapped from V(s_T)
+            if done or ep_steps >= self.conf.max_episode_steps:
+                boot = 0.0
+                self.episode_rewards.append(ep_reward)
+                obs = mdp.reset()
+                ep_reward, ep_steps = 0.0, 0
+            else:
+                with self._lock:
+                    params = self.params
+                boot = float(self._value_jit(
+                    params, jnp.asarray(obs, jnp.float32)[None])[0])
+            rets = np.zeros(len(traj_r), np.float32)
+            acc = boot
+            for i in reversed(range(len(traj_r))):
+                acc = traj_r[i] + gamma * acc
+                rets[i] = acc
+            T = len(traj_r)
+            n = self.conf.n_step
+            obs_p = np.zeros((n, self.obs_dim), np.float32)
+            obs_p[:T] = np.stack(traj_o)
+            act_p = np.zeros((n,), np.int32)
+            act_p[:T] = traj_a
+            ret_p = np.zeros((n,), np.float32)
+            ret_p[:T] = rets
+            mask = np.zeros((n,), np.float32)
+            mask[:T] = 1.0
+            rollouts.put((obs_p, act_p, ret_p, mask))
+        mdp.close()
+
+    def train(self) -> "A3CDiscreteDense":
+        """Run workers + trainer until max_steps env steps are consumed."""
+        self._value_jit = jax.jit(self._value)
+        self._worker_error = None   # BEFORE workers start: a crash during
+        rollouts: "queue.Queue" = queue.Queue(maxsize=64)   # startup must
+        stop = threading.Event()                            # not be erased
+        workers = [threading.Thread(target=self._worker,
+                                    args=(i, rollouts, stop), daemon=True)
+                   for i in range(self.conf.num_threads)]
+        for w in workers:
+            w.start()
+        consumed = 0
+        while consumed < self.conf.max_steps:
+            try:
+                obs, actions, rets, mask = rollouts.get(timeout=60.0)
+            except queue.Empty:
+                if self._worker_error is not None:
+                    raise RuntimeError("A3C worker died") \
+                        from self._worker_error
+                raise
+            consumed += int(mask.sum())
+            new_p, new_s, self._t, _ = self._step_fn(
+                self.params, self.opt_state, self._t,
+                jnp.asarray(obs), jnp.asarray(actions), jnp.asarray(rets),
+                jnp.asarray(mask))
+            with self._lock:
+                self.params, self.opt_state = new_p, new_s
+        stop.set()
+        # drain so workers blocked on put() can observe stop and exit
+        try:
+            while True:
+                rollouts.get_nowait()
+        except queue.Empty:
+            pass
+        for w in workers:
+            w.join(timeout=5.0)
+        return self
+
+    # -------------------------------------------------------------- policy
+    def getPolicy(self, deterministic: bool = True) -> ACPolicy:
+        """ref: A3CDiscreteDense.getPolicy -> ACPolicy."""
+        return ACPolicy(self._pi_fn, self.params,
+                        deterministic=deterministic, seed=self.conf.seed)
+
+    def evaluate(self, episodes: int = 10, max_steps: int = 500) -> float:
+        mdp = self.mdp_factory(self.conf.seed + 999)
+        pol = self.getPolicy(deterministic=True)
+        total = [pol.play(mdp, max_steps=max_steps) for _ in range(episodes)]
+        mdp.close()
+        return float(np.mean(total))

@@ -1,0 +1,280 @@
+"""DataVec r4 breadth: new transforms, sequence ops, Reducer, Join, and
+the end-to-end CSV → join → sequence window → iterator → fit pipeline
+(VERDICT r3 #6; ref: org.datavec.api.transform.*)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.records import (
+    CollectionRecordReader, CollectionSequenceRecordReader, ColumnType,
+    CSVRecordReader, Join, Reducer, Schema,
+    SequenceRecordReaderDataSetIterator, TransformProcess, executeJoin)
+
+
+def _schema(*cols):
+    b = Schema.Builder()
+    for name, kind in cols:
+        getattr(b, f"addColumn{kind}")(name)
+    return b.build()
+
+
+class TestNewColumnTransforms:
+    def test_numeric_additions(self):
+        sch = _schema(("x", "Double"))
+        tp = (TransformProcess.Builder(sch)
+              .absValueColumn("x")
+              .roundDoubleColumn("x", 1)
+              .build())
+        rows = tp.execute([[-1.26], [2.71]])
+        assert rows == [[1.3], [2.7]]
+
+    def test_subtract_mean_and_replace_empty(self):
+        sch = _schema(("x", "Double"))
+        tp = (TransformProcess.Builder(sch).subtractMean("x").build())
+        rows = tp.execute([[1.0], [3.0]])
+        assert rows == [[-1.0], [1.0]]
+        sch2 = _schema(("s", "String"))
+        tp2 = (TransformProcess.Builder(sch2)
+               .replaceEmptyWithValue("s", "missing").build())
+        assert tp2.execute([[""], ["a"]]) == [["missing"], ["a"]]
+
+    def test_string_additions(self):
+        sch = _schema(("s", "String"))
+        tp = (TransformProcess.Builder(sch)
+              .trimStringTransform("s")
+              .padStringTransform("s", 5, "0", "LEFT")
+              .substringTransform("s", 1, 4)
+              .stringLengthColumn("s", "len")
+              .build())
+        rows = tp.execute([[" 42 "], ["abcdef"]])
+        # " 42 " -> trim "42" -> left-pad "00042" -> substring(1,4) "004"
+        assert rows[0][0] == "004"
+        assert rows[0][1] == 3
+        assert tp.getFinalSchema().getColumnNames() == ["s", "len"]
+
+    def test_map_all_strings_except(self):
+        sch = _schema(("s", "String"))
+        tp = (TransformProcess.Builder(sch)
+              .mapAllStringsExceptList("s", "OTHER", ["a", "b"]).build())
+        assert tp.execute([["a"], ["z"], ["b"]]) == [["a"], ["OTHER"], ["b"]]
+
+    def test_onehot_roundtrip(self):
+        sch = _schema(("pre", "Integer"), ("color", "Categorical"),
+                      ("post", "Integer"))
+        sch.columns[1]["states"] = ["blue", "green", "red"]
+        tp = (TransformProcess.Builder(sch)
+              .categoricalToOneHot("color")
+              .oneHotToCategorical("color", "color[blue]", "color[green]",
+                                   "color[red]")
+              .build())
+        rows = tp.execute([[7, "green", 1], [8, "red", 2]])
+        assert rows == [[7, "green", 1], [8, "red", 2]]
+
+    def test_filter_invalid_and_cond_copy(self):
+        sch = _schema(("a", "Double"), ("b", "Double"))
+        tp = (TransformProcess.Builder(sch)
+              .filterInvalidValues("a")
+              .conditionalCopyValueTransform("b", "a", lambda v: v < 0)
+              .build())
+        rows = tp.execute([[1.0, -5.0], ["bad", 2.0], [3.0, 4.0]])
+        assert rows == [[1.0, 1.0], [3.0, 4.0]]
+
+
+class TestReducer:
+    def test_group_by_aggregation(self):
+        sch = _schema(("key", "String"), ("v", "Double"), ("w", "Double"))
+        red = (Reducer.Builder("key")
+               .sumColumns("v").meanColumns("w").countColumns("v")
+               .build())
+        tp = TransformProcess.Builder(sch).reduce(red).build()
+        rows = tp.execute([["a", 1.0, 10.0], ["b", 5.0, 2.0],
+                           ["a", 2.0, 20.0]])
+        assert rows == [["a", 3.0, 15.0, 2], ["b", 5.0, 2.0, 1]]
+        assert tp.getFinalSchema().getColumnNames() == \
+            ["key", "sum(v)", "mean(w)", "count(v)"]
+
+
+class TestJoin:
+    L = _schema(("id", "Integer"), ("x", "Double"))
+    R = _schema(("id", "Integer"), ("y", "Double"))
+
+    def test_inner(self):
+        j = (Join.Builder("Inner").setJoinColumns("id")
+             .setSchemas(self.L, self.R).build())
+        out = executeJoin(j, [[1, 0.5], [2, 1.5]], [[2, 9.0], [3, 8.0]])
+        assert out == [[2, 1.5, 9.0]]
+        assert j.outputSchema().getColumnNames() == ["id", "x", "y"]
+
+    def test_left_right_full(self):
+        left = [[1, 0.5], [2, 1.5]]
+        right = [[2, 9.0], [3, 8.0]]
+        j = (Join.Builder("LeftOuter").setJoinColumns("id")
+             .setSchemas(self.L, self.R).build())
+        assert executeJoin(j, left, right) == [[1, 0.5, None], [2, 1.5, 9.0]]
+        j = (Join.Builder("RightOuter").setJoinColumns("id")
+             .setSchemas(self.L, self.R).build())
+        assert executeJoin(j, left, right) == [[2, 1.5, 9.0], [3, None, 8.0]]
+        j = (Join.Builder("FullOuter").setJoinColumns("id")
+             .setSchemas(self.L, self.R).build())
+        assert executeJoin(j, left, right) == \
+            [[1, 0.5, None], [2, 1.5, 9.0], [3, None, 8.0]]
+
+
+class TestSequenceOps:
+    SCH = _schema(("dev", "String"), ("t", "Integer"), ("v", "Double"))
+
+    ROWS = [["a", 2, 3.0], ["a", 0, 1.0], ["b", 0, 10.0],
+            ["a", 1, 2.0], ["b", 1, 20.0]]
+
+    def test_convert_to_sequence_sorts(self):
+        tp = (TransformProcess.Builder(self.SCH)
+              .convertToSequence("dev", "t").build())
+        seqs = tp.execute(self.ROWS)
+        assert [[r[2] for r in s] for s in seqs] == [[1.0, 2.0, 3.0],
+                                                     [10.0, 20.0]]
+
+    def test_window_pad_trim_offset_reverse(self):
+        tp = (TransformProcess.Builder(self.SCH)
+              .convertToSequence("dev", "t")
+              .padSequenceToLength(4, 0)
+              .build())
+        seqs = tp.execute(self.ROWS)
+        assert all(len(s) == 4 for s in seqs)
+
+        tp = (TransformProcess.Builder(self.SCH)
+              .convertToSequence("dev", "t").window(2, 1).build())
+        wins = tp.execute(self.ROWS)
+        assert [[r[2] for r in w] for w in wins] == \
+            [[1.0, 2.0], [2.0, 3.0], [10.0, 20.0]]
+
+        tp = (TransformProcess.Builder(self.SCH)
+              .convertToSequence("dev", "t").trimSequence(1).build())
+        assert [[r[2] for r in s] for s in tp.execute(self.ROWS)] == \
+            [[2.0, 3.0], [20.0]]
+
+        tp = (TransformProcess.Builder(self.SCH)
+              .convertToSequence("dev", "t").reverseSequence().build())
+        assert [r[2] for r in tp.execute(self.ROWS)[0]] == [3.0, 2.0, 1.0]
+
+        tp = (TransformProcess.Builder(self.SCH)
+              .convertToSequence("dev", "t")
+              .offsetSequence("v", -1, pad_value=-1.0).build())
+        # offset -1: v_t <- v_{t+1} (next-step label); last step padded
+        assert [r[2] for r in tp.execute(self.ROWS)[0]] == [2.0, 3.0, -1.0]
+
+    def test_diff_moving_split(self):
+        tp = (TransformProcess.Builder(self.SCH)
+              .convertToSequence("dev", "t").sequenceDifference("v").build())
+        assert [r[2] for r in tp.execute(self.ROWS)[0]] == [0.0, 1.0, 1.0]
+
+        tp = (TransformProcess.Builder(self.SCH)
+              .convertToSequence("dev", "t")
+              .sequenceMovingWindowReduce("v", 2, "Mean").build())
+        seqs = tp.execute(self.ROWS)
+        assert [r[-1] for r in seqs[0]] == [1.0, 1.5, 2.5]
+        assert "mean(2)(v)" in tp.getFinalSchema().getColumnNames()
+
+        tp = (TransformProcess.Builder(self.SCH)
+              .convertToSequence("dev", "t").splitSequenceMaxLength(2)
+              .build())
+        assert [len(s) for s in tp.execute(self.ROWS)] == [2, 1, 2]
+
+    def test_execute_sequence_entry(self):
+        tp = (TransformProcess.Builder(self.SCH)
+              .doubleMathOp("v", "Multiply", 2.0)
+              .trimSequenceToLength(1)
+              .build())
+        seqs = tp.executeSequence([[["a", 0, 1.0], ["a", 1, 2.0]]])
+        assert seqs == [[["a", 0, 2.0]]]
+
+    def test_seq_op_without_sequence_fails(self):
+        tp = TransformProcess.Builder(self.SCH).window(2).build()
+        with pytest.raises(ValueError, match="sequence op before"):
+            tp.execute(self.ROWS)
+
+
+class TestEndToEndPipeline:
+    def test_csv_join_window_iterator_fit(self, tmp_path):
+        """CSV → join(meta) → transform → convertToSequence → window →
+        SequenceRecordReaderDataSetIterator → LSTM fit (VERDICT r3 #6
+        'done' criterion)."""
+        # readings.csv: device, time, value
+        readings = tmp_path / "readings.csv"
+        rng = np.random.RandomState(0)
+        lines = []
+        for dev in ("d0", "d1", "d2", "d3"):
+            bias = 2.0 if dev in ("d1", "d3") else -2.0
+            for t in range(8):
+                lines.append(f"{dev},{t},{rng.randn() * 0.3 + bias:.4f}")
+        readings.write_text("\n".join(lines) + "\n")
+        # devices.csv: device, label
+        devices = tmp_path / "devices.csv"
+        devices.write_text("d0,0\nd1,1\nd2,0\nd3,1\n")
+
+        r_schema = _schema(("dev", "String"), ("t", "Integer"),
+                           ("v", "Double"))
+        d_schema = _schema(("dev", "String"), ("label", "Integer"))
+
+        left = list(CSVRecordReader().initialize(str(readings)))
+        right = list(CSVRecordReader().initialize(str(devices)))
+        join = (Join.Builder("Inner").setJoinColumns("dev")
+                .setSchemas(r_schema, d_schema).build())
+        joined = executeJoin(join, left, right)
+        assert len(joined) == 32 and len(joined[0]) == 4
+
+        tp = (TransformProcess.Builder(join.outputSchema())
+              .convertToSequence("dev", "t")
+              .removeColumns("dev", "t")
+              .window(4, 2)
+              .build())
+        windows = tp.execute(joined)
+        assert all(len(w) == 4 for w in windows)
+        assert tp.getFinalSchema().getColumnNames() == ["v", "label"]
+
+        it = SequenceRecordReaderDataSetIterator(
+            CollectionSequenceRecordReader(windows), batch_size=32,
+            label_index=1, num_classes=2)
+
+        from deeplearning4j_tpu.nn.config import (InputType,
+                                                  NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.layers import LSTM, RnnOutputLayer
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.train import updaters
+        conf = (NeuralNetConfiguration.Builder().seed(1)
+                .updater(updaters.Adam(1e-2)).weightInit("xavier").list()
+                .layer(LSTM(nOut=8))
+                .layer(RnnOutputLayer(nOut=2, lossFunction="mcxent"))
+                .setInputType(InputType.recurrent(1, 4)).build())
+        net = MultiLayerNetwork(conf).init()
+        net.fit(it, epochs=1)
+        first = net.score()
+        net.fit(it, epochs=15)
+        assert net.score() < first * 0.8, (first, net.score())
+
+
+class TestReviewRegressions:
+    SCH = _schema(("s", "String"))
+
+    def test_seq_mode_column_add_no_schema_duplication(self):
+        tp = (TransformProcess.Builder(self.SCH)
+              .stringLengthColumn("s", "len").build())
+        seqs = tp.executeSequence([[["ab"], ["abc"]], [["x"]], [["yyyy"]]])
+        assert tp.getFinalSchema().getColumnNames() == ["s", "len"]
+        assert seqs[0] == [["ab", 2], ["abc", 3]]
+
+    def test_execute_sequence_empty_input(self):
+        tp = (TransformProcess.Builder(self.SCH)
+              .trimStringTransform("s")
+              .stringLengthColumn("s", "len").build())
+        assert tp.executeSequence([]) == []
+        assert tp.getFinalSchema().getColumnNames() == ["s", "len"]
+
+    def test_trim_zero_from_end_is_noop(self):
+        sch = _schema(("dev", "String"), ("t", "Integer"), ("v", "Double"))
+        tp = (TransformProcess.Builder(sch)
+              .convertToSequence("dev", "t")
+              .trimSequence(0, from_start=False).build())
+        seqs = tp.execute([["a", 0, 1.0], ["a", 1, 2.0]])
+        assert [len(s) for s in seqs] == [2]

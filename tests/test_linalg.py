@@ -270,3 +270,150 @@ class TestWidenedSurface:
         assert a.toDoubleMatrix().dtype == np.float64
         assert a.ordering() == "c"
         assert a.stride() == (2, 1)
+
+
+class TestR4Surface:
+    """r4 NDArray surface push (VERDICT r3 #9): behavior checks for the
+    new families + an inventory gate against a checked-in method list."""
+
+    def test_new_unaries_and_inplace(self):
+        from deeplearning4j_tpu.linalg.ndarray import NDArray
+        x = NDArray(np.asarray([0.25, 0.5], np.float32))
+        np.testing.assert_allclose(np.asarray(x.asin().jax()),
+                                   np.arcsin([0.25, 0.5]), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(x.oneMinus().jax()),
+                                   [0.75, 0.5])
+        y = NDArray(np.asarray([4.0, 9.0], np.float32))
+        y.rsqrti()
+        np.testing.assert_allclose(np.asarray(y.jax()), [0.5, 1 / 3],
+                                   rtol=1e-6)
+
+    def test_rsub_rdiv_vectors(self):
+        from deeplearning4j_tpu.linalg.ndarray import NDArray
+        m = NDArray(np.asarray([[2.0, 4.0], [8.0, 16.0]], np.float32))
+        r = m.rdivRowVector(np.asarray([2.0, 4.0], np.float32))
+        np.testing.assert_allclose(np.asarray(r.jax()),
+                                   [[1.0, 1.0], [0.25, 0.25]])
+        c = m.rsubColumnVector(np.asarray([1.0, 2.0], np.float32))
+        np.testing.assert_allclose(np.asarray(c.jax()),
+                                   [[-1.0, -3.0], [-6.0, -14.0]])
+
+    def test_inplace_comparisons(self):
+        from deeplearning4j_tpu.linalg.ndarray import NDArray
+        x = NDArray(np.asarray([1.0, 5.0, 3.0], np.float32))
+        x.gti(2.0)
+        np.testing.assert_allclose(np.asarray(x.jax()), [0.0, 1.0, 1.0])
+        assert x.dtype.name.lower().startswith("float")
+
+    def test_matrix_and_stats(self):
+        from deeplearning4j_tpu.linalg.ndarray import NDArray
+        m = NDArray(np.asarray([[1.0, 2.0], [3.0, 4.0]], np.float32))
+        assert m.trace() == 5.0
+        np.testing.assert_allclose(np.asarray(m.diag().jax()), [1.0, 4.0])
+        v = NDArray(np.asarray([1.0, 2.0], np.float32))
+        np.testing.assert_allclose(np.asarray(v.outer(v).jax()),
+                                   [[1.0, 2.0], [2.0, 4.0]])
+        rng = np.random.RandomState(0)
+        z = NDArray(rng.randn(1000).astype(np.float32))
+        assert abs(float(z.skewness().jax())) < 0.3
+        assert abs(float(z.kurtosis().jax())) < 0.5
+
+    def test_shape_and_views(self):
+        from deeplearning4j_tpu.linalg.ndarray import NDArray
+        x = NDArray(np.arange(6, dtype=np.float32))
+        x.reshapei(2, 3)
+        assert x.shape == (2, 3)
+        x.transposei()
+        assert x.shape == (3, 2)
+        assert x.moveAxis(0, 1).shape == (2, 3)
+        assert x.repmat(2, 2).shape == (6, 4)
+        assert x.broadcastTo(5, 3, 2).shape == (5, 3, 2)
+        m = NDArray(np.arange(12, dtype=np.float32).reshape(3, 4))
+        np.testing.assert_allclose(np.asarray(m.getRows(0, 2).jax()),
+                                   np.asarray(m.jax())[[0, 2]])
+        np.testing.assert_allclose(np.asarray(m.getColumns(1, 3).jax()),
+                                   np.asarray(m.jax())[:, [1, 3]])
+        m.putSlice(1, np.zeros(4, np.float32))
+        assert float(m.sumNumber()) == float(np.arange(12).sum()
+                                             - (4 + 5 + 6 + 7))
+
+    def test_where_and_argsort(self):
+        from deeplearning4j_tpu.linalg.conditions import Conditions
+        from deeplearning4j_tpu.linalg.ndarray import NDArray
+        x = NDArray(np.asarray([3.0, -1.0, 5.0, 0.0], np.float32))
+        got = np.asarray(x.getWhere(None, Conditions.greaterThan(0)).jax())
+        np.testing.assert_allclose(got, [3.0, 5.0])
+        masked = x.putWhereWithMask(np.asarray([1, 0, 1, 0], np.float32),
+                                    np.zeros(4, np.float32))
+        np.testing.assert_allclose(np.asarray(masked.jax()),
+                                   [0.0, -1.0, 0.0, 0.0])
+        np.testing.assert_allclose(np.asarray(x.argsort().jax()),
+                                   [1, 3, 0, 2])
+        np.testing.assert_allclose(
+            np.asarray(x.argsort(descending=True).jax()), [2, 0, 3, 1])
+
+    def test_alloc_alikes_and_workspace_identities(self):
+        from deeplearning4j_tpu.linalg.ndarray import NDArray
+        x = NDArray(np.ones((2, 3), np.float32))
+        assert x.like().shape == (2, 3)
+        assert float(x.like().sumNumber()) == 0.0
+        assert x.detach() is x and x.leverage() is x and x.migrate() is x
+
+    def test_method_inventory(self):
+        """Inventory gate: the surface must keep >= 260 public methods and
+        every name in the checked-in core list must exist."""
+        from deeplearning4j_tpu.linalg.ndarray import NDArray
+        meths = {m for m in dir(NDArray) if not m.startswith("_")}
+        assert len(meths) >= 260, len(meths)
+        core = {
+            # arithmetic + i-variants
+            "add", "addi", "sub", "subi", "mul", "muli", "div", "divi",
+            "rsub", "rsubi", "rdiv", "rdivi", "pow", "powi", "neg", "negi",
+            "fmod", "fmodi", "remainder", "remainderi",
+            # broadcast vectors (4 ops x row/col x i)
+            "addRowVector", "addiRowVector", "addColumnVector",
+            "addiColumnVector", "subRowVector", "mulRowVector",
+            "divRowVector", "rsubRowVector", "rdivRowVector",
+            "rsubColumnVector", "rdivColumnVector", "rdiviColumnVector",
+            # comparisons
+            "gt", "gte", "lt", "lte", "eq", "neq", "gti", "gtei", "lti",
+            "ltei", "eqi", "neqi",
+            # reductions
+            "sum", "mean", "max", "min", "prod", "std", "var", "norm1",
+            "norm2", "normMax", "normMaxNumber", "amax", "amin", "amean",
+            "argMax", "argMin", "cumsum", "cumprod", "cumsumi", "cumprodi",
+            "entropy", "logEntropy", "shannonEntropy", "logSumExp",
+            "skewness", "kurtosis", "median", "percentile",
+            # elementwise
+            "abs", "exp", "log", "log2", "log10", "log1p", "expm1", "sqrt",
+            "cbrt", "rsqrt", "square", "cube", "reciprocal", "sign",
+            "floor", "ceil", "round", "rint", "trunc", "frac", "oneMinus",
+            "sin", "cos", "tan", "asin", "acos", "atan", "sinh", "cosh",
+            "tanh", "asinh", "acosh", "atanh", "erf", "erfc", "sigmoid",
+            "relu", "elu", "selu", "gelu", "swish", "mish", "softplus",
+            "softsign", "hardSigmoid", "hardTanh", "leakyRelu", "clip",
+            # linalg / matrix
+            "mmul", "mmuli", "dot", "outer", "diag", "trace",
+            # shape
+            "reshape", "reshapei", "transpose", "transposei", "permute",
+            "permutei", "moveAxis", "swapAxes", "expandDims", "squeeze",
+            "flatten", "ravel", "tile", "repmat", "repeat", "broadcast",
+            "broadcastTo", "reverse", "sort", "argsort",
+            # access
+            "getRow", "getColumn", "getRows", "getColumns", "getScalar",
+            "getDouble", "getFloat", "getInt", "getLong", "putScalar",
+            "put", "putRow", "putColumn", "putSlice", "putWhere",
+            "putWhereWithMask", "getWhere", "replaceWhere",
+            "tensorAlongDimension", "slice_",
+            # meta / conversion
+            "shape", "rank", "length", "size", "stride", "ordering",
+            "dataType", "castTo", "dup", "like", "ulike", "detach",
+            "leverage", "migrate", "data", "numpy", "jax", "isView",
+            "isScalar", "isVector", "isMatrix", "isRowVector",
+            "isColumnVector", "isSquare", "isEmpty", "isNaN", "isInfinite",
+            "toFloatVector", "toDoubleVector", "toIntVector",
+            "toLongVector", "toFloatMatrix", "toDoubleMatrix",
+            "toIntMatrix", "toLongMatrix", "toByteVector", "equalsWithEps",
+        }
+        missing = core - meths
+        assert not missing, f"missing INDArray methods: {sorted(missing)}"

@@ -154,3 +154,58 @@ def DiscreteSearch(space_values):
     return GridSearchCandidateGenerator(
         {k: DiscreteSpace(v) for k, v in space_values.items()},
         discretization_count=max(len(v) for v in space_values.values()))
+
+
+class TestA3C:
+    """A3C + policy abstraction (VERDICT r3 #10; ref: rl4j
+    A3CDiscreteDense, Policy/ACPolicy/DQNPolicy/EpsGreedy)."""
+
+    def test_policies(self):
+        import jax
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.rl.a3c import ACPolicy, DQNPolicy, EpsGreedy
+
+        def fake_net(params, x):
+            return jnp.asarray([[0.0, 10.0]])
+        pol = DQNPolicy(fake_net, {})
+        assert pol.nextAction(np.zeros(4)) == 1
+        ac = ACPolicy(fake_net, {}, deterministic=True)
+        assert ac.nextAction(np.zeros(4)) == 1
+        eg = EpsGreedy(pol, action_space_n=2, eps_start=1.0, eps_end=1.0,
+                       anneal_steps=1, seed=0)
+        acts = {eg.nextAction(np.zeros(4)) for _ in range(50)}
+        assert acts == {0, 1}          # fully exploring
+
+    def test_a3c_solves_cartpole(self):
+        from deeplearning4j_tpu.rl.a3c import (A3CConfiguration,
+                                               A3CDiscreteDense)
+        from deeplearning4j_tpu.rl.mdp import CartPole
+        conf = A3CConfiguration(seed=7, num_threads=2, max_steps=5000,
+                                learning_rate=7e-3, n_step=32,
+                                max_episode_steps=200)
+        a3c = A3CDiscreteDense(CartPole, conf, hidden=(64,))
+        # asynchronous worker/trainer interleaving makes any single run
+        # noisy; "solved" = SOME 10-episode window of the (stochastic)
+        # training rewards sustains a mean > 150 (cap: 4 rounds = 60k
+        # env steps; a random policy averages ~20, the cap is 200)
+        def best_window(rs, w=10):
+            if len(rs) < w:
+                return 0.0
+            return max(float(np.mean(rs[i:i + w]))
+                       for i in range(len(rs) - w + 1))
+        # on-policy PG oscillates; train in 5k-step chunks (cap 60k) and
+        # accept the first chunk where the policy BOTH sustained a
+        # 150+/200 training window AND plays >80 on fresh episodes with
+        # the params of that moment (the stochastic policy A3C optimizes)
+        mdp = CartPole(seed=3)
+        solved = False
+        for _ in range(12):
+            a3c.train()
+            if best_window(a3c.episode_rewards) <= 150.0:
+                continue
+            pol = a3c.getPolicy(deterministic=False)
+            plays = [pol.play(mdp, max_steps=200) for _ in range(5)]
+            if np.mean(plays) > 80.0:
+                solved = True
+                break
+        assert solved, a3c.episode_rewards[-12:]
