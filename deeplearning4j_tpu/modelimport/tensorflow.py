@@ -900,8 +900,9 @@ def _b_resize_nn(p):
 @_b("CropAndResize")
 def _b_crop_and_resize(p):
     size = tuple(p["crop_size"])
+    extrap = float(p.get("extrapolation_value", 0.0))
     return lambda img, boxes, bi: _R.get("crop_and_resize")(
-        img, boxes, bi, size)
+        img, boxes, bi, size, extrapolation_value=extrap)
 
 
 @_b("SpaceToDepth")
@@ -1119,7 +1120,9 @@ def _m_resize(ctx, node, ins):
 
 def _m_crop_and_resize(ctx, node, ins):
     size = [int(v) for v in ctx.const_of(ins[3])]
-    return {"crop_size": size}, ins[:3], 1
+    return ({"crop_size": size,
+             "extrapolation_value": _attr(node, "extrapolation_value", 0.0)},
+            ins[:3], 1)
 
 
 def _m_band_part(ctx, node, ins):

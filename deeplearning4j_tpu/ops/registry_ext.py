@@ -126,6 +126,9 @@ def _ctc_loss(labels, logits, label_lengths, logit_lengths,
     end = 2 * label_lengths[:, None]                                # [B, 1]
     a_last = jnp.take_along_axis(alpha, end, axis=1)[:, 0]
     a_prev = jnp.take_along_axis(alpha, jnp.maximum(end - 1, 0), axis=1)[:, 0]
+    # empty label sequence: only the all-blank path exists; the "end-1" term
+    # would double-count position 0
+    a_prev = jnp.where(label_lengths > 0, a_prev, _NEG)
     return -jnp.logaddexp(a_last, a_prev)
 
 
@@ -251,7 +254,7 @@ register("accumulate_n", lambda xs: sum(xs[1:], xs[0]))
 register("divide_no_nan", lambda a, b: jnp.where(b == 0, 0.0, a / jnp.where(
     b == 0, 1.0, b)))
 register("truncatediv", lambda a, b: jnp.trunc(a / b))
-register("floormod", lambda a, b: a - jnp.floor(a / b) * b)
+register("floormod", lambda a, b: jnp.mod(a, b))  # floor semantics, int-exact
 register("squared_difference", _get("squared_subtract"))
 register("select", lambda cond, a, b: jnp.where(cond, a, b))
 register("stop_gradient", lax.stop_gradient)
@@ -355,12 +358,20 @@ def _toggle_bits(x):
     return jnp.invert(jnp.asarray(x))
 
 
-register("cyclic_shift_bits", lambda x, n: jnp.bitwise_or(
-    jnp.left_shift(x, n), jnp.right_shift(
-        x.astype(jnp.uint32), 32 - n).astype(x.dtype)))
-register("cyclic_rshift_bits", lambda x, n: jnp.bitwise_or(
-    jnp.right_shift(x.astype(jnp.uint32), n).astype(x.dtype),
-    jnp.left_shift(x, 32 - n)))
+def _rotate_bits(x, n, left):
+    """Bit rotation at the true width of x.dtype (8/16/32/64), n==0 safe."""
+    x = jnp.asarray(x)
+    width = x.dtype.itemsize * 8
+    ux = x.astype(jnp.dtype(f"uint{width}"))
+    n = jnp.asarray(n) % width
+    comp = (width - n) % width
+    lo, hi = (n, comp) if left else (comp, n)
+    return jnp.bitwise_or(jnp.left_shift(ux, lo),
+                          jnp.right_shift(ux, hi)).astype(x.dtype)
+
+
+register("cyclic_shift_bits", lambda x, n: _rotate_bits(x, n, left=True))
+register("cyclic_rshift_bits", lambda x, n: _rotate_bits(x, n, left=False))
 
 
 # ---------------------------------------------------------------------------
@@ -614,42 +625,85 @@ def _resize(x, size, method):
 
 
 register("resize_bicubic", lambda x, size: _resize(x, size, "cubic"))
-register("resize_area", lambda x, size: _resize(x, size, "linear"))
+
+
+def _area_weights(in_size: int, out_size: int, dtype):
+    # W[i, j] = |[j, j+1) ∩ [i·s, (i+1)·s)| / s with s = in/out — each output
+    # pixel is the mean of the source pixels its box overlaps (TF area
+    # resampling), not a bilinear tap.
+    s = in_size / out_size
+    j = np.arange(in_size)[None, :]
+    lo = np.arange(out_size)[:, None] * s
+    overlap = np.clip(np.minimum(lo + s, j + 1.0) - np.maximum(lo, j), 0.0,
+                      None)
+    return jnp.asarray(overlap / s, dtype)
+
+
+@register("resize_area")
+def _resize_area(x, size):
+    x = jnp.asarray(x)
+    n, h, w, c = x.shape
+    oh, ow = int(size[0]), int(size[1])
+    dt = x.dtype if jnp.issubdtype(x.dtype, jnp.floating) else jnp.float32
+    wh = _area_weights(h, oh, dt)
+    ww = _area_weights(w, ow, dt)
+    # HIGHEST: the default TPU matmul precision (bf16) loses ~3 decimal
+    # digits on what is semantically an averaging reduction
+    return jnp.einsum("ih,nhwc,jw->nijc", wh, x.astype(dt), ww,
+                      precision=lax.Precision.HIGHEST)
 
 
 @register("image_resize")
 def _image_resize(x, size, method: str = "bilinear"):
+    if str(method).lower() == "area":
+        return _resize_area(x, size)
     method = {"bilinear": "linear", "nearest": "nearest",
-              "bicubic": "cubic", "area": "linear",
+              "bicubic": "cubic",
               "lanczos3": "lanczos3", "lanczos5": "lanczos5"}.get(
                   str(method).lower(), str(method))
     return _resize(x, size, method)
 
 
 @register("crop_and_resize")
-def _crop_and_resize(image, boxes, box_indices, crop_size):
+def _crop_and_resize(image, boxes, box_indices, crop_size,
+                     extrapolation_value: float = 0.0):
     """ref/TF: crop_and_resize — normalized boxes [n, 4] (y1,x1,y2,x2),
-    bilinear sample to crop_size per box."""
+    bilinear sample to crop_size per box. TF sampling formula: crop dims of
+    size 1 sample the box CENTER, and samples outside the image take
+    ``extrapolation_value`` rather than clamping."""
     image = jnp.asarray(image)
     n, h, w, c = image.shape
     ch, cw = int(crop_size[0]), int(crop_size[1])
 
+    def coords(lo, hi, out, in_size):
+        # lerp form: endpoints land EXACTLY on lo/hi (the accumulated
+        # lo + i*scale form can overshoot in_size-1 by one ulp and wrongly
+        # extrapolate the last sample of an in-bounds box)
+        if out > 1:
+            t = jnp.arange(out, dtype=jnp.float32) / (out - 1)
+            return (lo * (1 - t) + hi * t) * (in_size - 1)
+        return 0.5 * (lo + hi) * (in_size - 1) + jnp.zeros((1,))
+
     def one(box, bi):
         y1, x1, y2, x2 = box
-        ys = y1 * (h - 1) + jnp.arange(ch) / max(ch - 1, 1) * (y2 - y1) * (h - 1)
-        xs = x1 * (w - 1) + jnp.arange(cw) / max(cw - 1, 1) * (x2 - x1) * (w - 1)
+        ys = coords(y1, y2, ch, h)
+        xs = coords(x1, x2, cw, w)
+        in_y = (ys >= 0) & (ys <= h - 1)
+        in_x = (xs >= 0) & (xs <= w - 1)
         img = image[bi]
         y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, h - 1)
         y1i = jnp.clip(y0 + 1, 0, h - 1)
         x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, w - 1)
         x1i = jnp.clip(x0 + 1, 0, w - 1)
-        wy = (ys - y0)[:, None, None]
-        wx = (xs - x0)[None, :, None]
-        a = img[y0][:, x0] * (1 - wy) * (1 - wx)
-        b = img[y0][:, x1i] * (1 - wy) * wx
-        cc = img[y1i][:, x0] * wy * (1 - wx)
-        d = img[y1i][:, x1i] * wy * wx
-        return a + b + cc + d
+        wy = jnp.clip(ys - y0, 0.0, 1.0)[:, None, None]
+        wx = jnp.clip(xs - x0, 0.0, 1.0)[None, :, None]
+        out = (img[y0][:, x0] * (1 - wy) * (1 - wx)
+               + img[y0][:, x1i] * (1 - wy) * wx
+               + img[y1i][:, x0] * wy * (1 - wx)
+               + img[y1i][:, x1i] * wy * wx)
+        inside = (in_y[:, None] & in_x[None, :])[:, :, None]
+        return jnp.where(inside, out,
+                         jnp.asarray(extrapolation_value, out.dtype))
 
     return jax.vmap(one)(jnp.asarray(boxes, jnp.float32),
                          jnp.asarray(box_indices, jnp.int32))

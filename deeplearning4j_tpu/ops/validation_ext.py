@@ -127,10 +127,11 @@ def build_ext_cases() -> List[OpCase]:
 
     # ---- CTC ----
     def ctc_args(rng):
-        labels = rng.randint(1, 5, (2, 3)).astype(np.int32)
-        logits = rng.randn(2, 8, 6).astype(np.float32)
-        lab_len = np.asarray([3, 2], np.int32)
-        log_len = np.asarray([8, 6], np.int32)
+        labels = rng.randint(1, 5, (3, 3)).astype(np.int32)
+        logits = rng.randn(3, 8, 6).astype(np.float32)
+        # last item has an EMPTY label sequence (all-blank path only)
+        lab_len = np.asarray([3, 2, 0], np.int32)
+        log_len = np.asarray([8, 6, 5], np.int32)
         return (labels, logits, lab_len, log_len)
     add("ctc_loss", ctc_args, golden=_np_ctc_loss, grad=True,
         grad_arg_idx=(1,), rtol=1e-3)
@@ -240,8 +241,12 @@ def build_ext_cases() -> List[OpCase]:
                                      a / np.where(b == 0, 1.0, b)))
     add("truncatediv", _r2pos(3, 4),
         golden=lambda a, b: np.trunc(a / b))
-    add("floormod", _r2pos(3, 4),
-        golden=lambda a, b: a - np.floor(a / b) * b, grad=False)
+    add("floormod", _r2pos(3, 4), golden=np.mod, grad=False)
+    add("floormod",
+        lambda rng: (np.asarray([7, -7, 9 ** 9], np.int64),
+                     np.asarray([3, 3, 7], np.int64)),
+        golden=np.mod, grad=False,
+        note="integer inputs stay integral (exact for large ints)")
     add("squared_difference", _r2(3, 4), golden=lambda a, b: (a - b) ** 2,
         grad=True)
     add("select", lambda rng: (rng.rand(3, 4) > 0.5,
@@ -332,16 +337,28 @@ def build_ext_cases() -> List[OpCase]:
         grad_arg_idx=(0, 1))
     add("toggle_bits", lambda rng: (np.asarray([0, 1, 255], np.int32),),
         golden=np.invert)
+    def np_rot(x, n, left):
+        width = x.dtype.itemsize * 8
+        ux = x.astype(np.dtype(f"uint{width}"))
+        n = n % width
+        comp = (width - n) % width
+        lo, hi = (n, comp) if left else (comp, n)
+        return np.bitwise_or(np.left_shift(ux, lo),
+                             np.right_shift(ux, hi)).astype(x.dtype)
     add("cyclic_shift_bits",
         lambda rng: (np.asarray([1, 2, 4], np.int32), 3),
-        golden=lambda x, n: np.bitwise_or(
-            np.left_shift(x, n),
-            np.right_shift(x.astype(np.uint32), 32 - n).astype(np.int32)))
+        golden=lambda x, n: np_rot(x, n, True))
     add("cyclic_rshift_bits",
         lambda rng: (np.asarray([8, 16, 32], np.int32), 3),
-        golden=lambda x, n: np.bitwise_or(
-            np.right_shift(x.astype(np.uint32), n).astype(np.int32),
-            np.left_shift(x, 32 - n)))
+        golden=lambda x, n: np_rot(x, n, False))
+    add("cyclic_shift_bits",
+        lambda rng: (np.asarray([1, -128, 77], np.int8), 2),
+        golden=lambda x, n: np_rot(x, n, True),
+        note="width derived from dtype (8-bit rotation, not 32)")
+    add("cyclic_rshift_bits",
+        lambda rng: (np.asarray([1, 1000, -5], np.int16), 0),
+        golden=lambda x, n: np_rot(x, n, False),
+        note="n==0 is identity, no out-of-range shift")
 
     # ---- linalg ----
     def spd_args(rng):
@@ -498,7 +515,9 @@ def build_ext_cases() -> List[OpCase]:
     add("resize_bicubic", lambda rng: (rng.rand(1, 4, 4, 2)
                                        .astype(np.float32), (8, 8)))
     add("resize_area", lambda rng: (rng.rand(1, 4, 4, 2)
-                                    .astype(np.float32), (2, 2)))
+                                    .astype(np.float32), (2, 2)),
+        golden=lambda x, size: x.reshape(1, 2, 2, 2, 2, 2).mean((2, 4)),
+        note="true area averaging: 2x downscale == 2x2 mean pooling")
     add("image_resize", lambda rng: (rng.rand(1, 4, 4, 2)
                                      .astype(np.float32), (8, 8)),
         kwargs={"method": "nearest"},
@@ -511,6 +530,34 @@ def build_ext_cases() -> List[OpCase]:
         return (img, boxes, np.asarray([0, 1], np.int32), (4, 4))
     add("crop_and_resize", car_args,
         note="identity box = bilinear resample of the full image")
+
+    def car_tf_args(rng):
+        img = rng.rand(1, 8, 8, 1).astype(np.float32)
+        # box 0: crop dim 1 → TF samples the box CENTER; box 1: fully
+        # outside the image → every sample takes extrapolation_value
+        boxes = np.asarray([[0.25, 0.25, 0.75, 0.75],
+                            [1.5, 1.5, 2.0, 2.0]], np.float32)
+        return (img, boxes, np.asarray([0, 0], np.int32), (1, 1))
+
+    def np_car_tf(img, boxes, bi, size):
+        h, w = img.shape[1:3]
+        out = np.zeros((len(boxes), 1, 1, img.shape[-1]), np.float32)
+        for k, (y1, x1, y2, x2) in enumerate(boxes):
+            y = 0.5 * (y1 + y2) * (h - 1)
+            x = 0.5 * (x1 + x2) * (w - 1)
+            if 0 <= y <= h - 1 and 0 <= x <= w - 1:
+                y0, x0 = int(np.floor(y)), int(np.floor(x))
+                y1i, x1i = min(y0 + 1, h - 1), min(x0 + 1, w - 1)
+                wy, wx = y - y0, x - x0
+                im = img[bi[k]]
+                out[k, 0, 0] = (im[y0, x0] * (1 - wy) * (1 - wx)
+                                + im[y0, x1i] * (1 - wy) * wx
+                                + im[y1i, x0] * wy * (1 - wx)
+                                + im[y1i, x1i] * wy * wx)
+        return out
+    add("crop_and_resize", car_tf_args, golden=np_car_tf,
+        note="TF formula: dim-1 crops sample box center; out-of-image "
+             "boxes take extrapolation_value")
 
     add("random_crop", lambda rng: (jax.random.PRNGKey(3),
                                     rng.rand(6, 6, 3).astype(np.float32),

@@ -16,13 +16,15 @@ from __future__ import annotations
 
 import queue
 import threading
+import warnings
 from typing import Any, List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from deeplearning4j_tpu.data.dataset import DataSet, DataSetIterator
+from deeplearning4j_tpu.data.dataset import (AsyncDataSetIterator, DataSet,
+                                             DataSetIterator)
 from deeplearning4j_tpu.parallel.mesh import DeviceMesh
 
 
@@ -45,6 +47,11 @@ class ParallelWrapper:
         model = self.model
         if not model._initialized:
             model.init()
+        fresh = False
+        if self.prefetch and not isinstance(iterator, AsyncDataSetIterator):
+            # the wrapper's constructor resets the base and starts prefetching
+            iterator = AsyncDataSetIterator(iterator, prefetch=self.prefetch)
+            fresh = True
         # replicate params/opt state once; batches are sharded per step
         with self.mesh:
             model._ensure_opt_state()
@@ -56,8 +63,9 @@ class ParallelWrapper:
             # see incompatible devices; _ensure_clock rebuilds it (fresh,
             # uncommitted) from _iteration on the first sharded step
             model._t_dev = None
-            for _ in range(epochs):
-                iterator.reset()
+            for e in range(epochs):
+                if e or not fresh:
+                    iterator.reset()
                 while iterator.hasNext():
                     ds = iterator.next()
                     ds = self._shard(ds)
@@ -96,10 +104,21 @@ class ParallelWrapper:
         out.labels_mask = put(ds.labels_mask)
         return out
 
-    def averagingFrequency(self, n):  # API parity no-ops: sync SPMD has no interval
+    def averagingFrequency(self, n):
+        # API-parity shim: sync SPMD allreduces inside ONE XLA program every
+        # step; there is no averaging interval to configure. Warn so callers
+        # porting reference configs know the knob has no effect here.
+        warnings.warn(
+            "ParallelWrapper.averagingFrequency has no effect: gradients are "
+            "allreduced synchronously by XLA every step (no interval)",
+            stacklevel=2)
         return self
 
     def workers(self, n):
+        warnings.warn(
+            "ParallelWrapper.workers has no effect: the worker count is the "
+            "mesh's data-axis size (%d); pass a different DeviceMesh instead"
+            % self.mesh.size("data"), stacklevel=2)
         return self
 
 
