@@ -29,6 +29,7 @@ frames (SURVEY.md §3.3) — compiler-friendly by construction.
 
 from __future__ import annotations
 
+import base64
 import json
 import os
 import zipfile
@@ -752,6 +753,39 @@ class SameDiff:
         self._grad_cache.clear()
         self._train_step_cache = None
 
+    def convertToVariables(self, *names):
+        """Promote constants to trainable variables (ref:
+        SameDiff.convertToVariables) — THE unfreeze step for fine-tuning
+        an imported frozen graph: imported weights land as constants;
+        promote them, attach a loss, and fit()."""
+        for n in names:
+            n = n.name if isinstance(n, SDVariable) else n
+            if n in self._variables:
+                continue
+            if n not in self._constants:
+                raise ValueError(f"'{n}' is not a constant")
+            self._variables[n] = self._constants.pop(n)
+            self._vars[n].var_type = "VARIABLE"
+        self._updater_state = None       # shape of the state tree changed
+        self._invalidate()
+        return self
+
+    def convertToConstants(self, *names):
+        """Freeze variables into constants (ref: SameDiff.convertToConstants
+        — transfer-learning freeze; frozen leaves get no updater state and
+        no gradient computation)."""
+        for n in names:
+            n = n.name if isinstance(n, SDVariable) else n
+            if n in self._constants:
+                continue
+            if n not in self._variables:
+                raise ValueError(f"'{n}' is not a variable")
+            self._constants[n] = self._variables.pop(n)
+            self._vars[n].var_type = "CONSTANT"
+        self._updater_state = None
+        self._invalidate()
+        return self
+
     def _total_loss_fn(self):
         loss_names = tuple(self._loss_variables)
         if not loss_names:
@@ -898,9 +932,30 @@ class SameDiff:
     def while_loop(self, cond_fn, body_fn, init_vars: Sequence[SDVariable],
                    name: str = None):
         """Lower to lax.while_loop (ref: interpreted Enter/Exit/Merge frames).
-        cond_fn/body_fn operate on raw jax arrays (tuples)."""
+
+        Two body forms:
+        - Python callables over raw jax arrays — fast to write, but the
+          node cannot be serialized (no data form for a closure).
+        - SameDiff subgraphs — ``cond_fn``/``body_fn`` are SameDiff
+          instances whose placeholders (declaration order) are the loop
+          carries; the last-recorded node output (or an explicit
+          ``outputs`` list via attrs) is the result. These round-trip
+          through save()/load() and are what the TF importer emits for
+          StatelessWhile.
+        """
         names = [self._as_var(v).name for v in init_vars]
         n = len(names)
+        if isinstance(cond_fn, SameDiff) and isinstance(body_fn, SameDiff):
+            attrs = {"cond": subgraph_spec(cond_fn,
+                                           cond_fn._default_outputs(1)),
+                     "body": subgraph_spec(body_fn,
+                                           body_fn._default_outputs(n))}
+            if _sub_has_rng(attrs["cond"], attrs["body"]):
+                attrs["__rng__"] = True
+            fn = _make_subwhile_fn(attrs)
+            return self._record_fn("while_loop", fn, names, name=name,
+                                   n_out=n, attrs=attrs, rebuild="subwhile")
+
         def fn(*args):
             def body(c):
                 out = body_fn(*c)
@@ -910,12 +965,62 @@ class SameDiff:
         return self._record_fn("while_loop", fn, names, name=name, n_out=n)
 
     def cond(self, pred: SDVariable, true_fn, false_fn, operands: Sequence[SDVariable],
-             name: str = None):
+             name: str = None, n_out: int = 1):
+        """Lower to lax.cond. Branches are Python callables (not
+        serializable) or SameDiff subgraphs (round-trip; see while_loop)."""
         names = [self._as_var(pred).name] + [self._as_var(v).name for v in operands]
+        if isinstance(true_fn, SameDiff) and isinstance(false_fn, SameDiff):
+            attrs = {"true": subgraph_spec(true_fn,
+                                           true_fn._default_outputs(n_out)),
+                     "false": subgraph_spec(false_fn,
+                                            false_fn._default_outputs(n_out))}
+            if _sub_has_rng(attrs["true"], attrs["false"]):
+                attrs["__rng__"] = True
+            fn = _make_subcond_fn(attrs)
+            return self._record_fn("cond", fn, names, name=name, n_out=n_out,
+                                   attrs=attrs, rebuild="subcond")
+
         def fn(p, *args):
             return jax.lax.cond(p, lambda c: true_fn(*c), lambda c: false_fn(*c),
                                 tuple(args))
         return self._record_fn("cond", fn, names, name=name)
+
+    def invoke_subgraph(self, sub: "SameDiff", inputs: Sequence[SDVariable],
+                        outputs: Sequence[str] = None, name: str = None):
+        """Record a whole subgraph as ONE node (function-call inlining —
+        ref: the import of PartitionedCall / FunctionDef bodies).
+        Differentiable and serializable."""
+        names = [self._as_var(v).name for v in inputs]
+        outs = list(outputs) if outputs else sub._default_outputs(1)
+        attrs = {"sub": subgraph_spec(sub, outs)}
+        if _sub_has_rng(attrs["sub"]):
+            attrs["__rng__"] = True
+        fn = _make_subcall_fn(attrs)
+        return self._record_fn("subgraph", fn, names, name=name,
+                               n_out=len(outs), attrs=attrs, rebuild="subcall")
+
+    def setOutputs(self, *names):
+        """Mark this graph's result variables (used when the graph serves
+        as a control-flow body / called subgraph)."""
+        self._marked_outputs = [n.name if isinstance(n, SDVariable) else n
+                                for n in names]
+        return self
+
+    def _default_outputs(self, n: int) -> List[str]:
+        """Explicitly marked outputs, else the last n recorded outputs —
+        the convention for subgraph results."""
+        marked = getattr(self, "_marked_outputs", None)
+        if marked:
+            if len(marked) != n:
+                raise ValueError(f"subgraph marks {len(marked)} outputs, "
+                                 f"{n} required")
+            return list(marked)
+        if not self._nodes:
+            # identity subgraph: outputs are the last n placeholders
+            phs = list(self._placeholders)
+            return phs[-n:]
+        outs = [o for node in self._nodes for o in node.outputs]
+        return outs[-n:]
 
     # ------------------------------------------------------------- utilities
     def variables(self) -> List[SDVariable]:
@@ -946,19 +1051,7 @@ class SameDiff:
                  "loss_variables": self._loss_variables,
                  "step": self._step}
         for node in self._nodes:
-            spec = {"op": node.op, "inputs": node.inputs,
-                    "outputs": node.outputs,
-                    "attrs": {k: v for k, v in node.attrs.items() if k != "__rng__"},
-                    "rng": bool(node.attrs.get("__rng__"))}
-            if node.rebuild is not None:
-                spec["rebuild"] = node.rebuild
-            elif not op_registry.has(node.op):
-                raise ValueError(
-                    f"node '{node.op}' is not serializable: its body is an "
-                    f"arbitrary Python closure (while_loop/cond bodies are "
-                    f"compiled to lax primitives and have no data form — "
-                    f"rebuild such graphs from code after load)")
-            graph["nodes"].append(spec)
+            graph["nodes"].append(_node_to_spec(node))
         if self.training_config is not None:
             graph["training_config"] = self.training_config.to_config()
         arrays = {f"var::{k}": np.asarray(v) for k, v in self._variables.items()}
@@ -996,22 +1089,7 @@ class SameDiff:
             elif kind == "upd":
                 upd_leaves[int(name)] = jnp.asarray(arrays[k])
         for nd_spec in graph["nodes"]:
-            attrs = dict(nd_spec["attrs"])
-            attrs = {k: (tuple(v) if isinstance(v, list) and k != "index" else v)
-                     for k, v in attrs.items()}
-            rebuild = nd_spec.get("rebuild")
-            if rebuild is not None:
-                if rebuild not in _FN_REBUILDERS and rebuild == "tf":
-                    # TF-imported graphs: the rebuilder registers on import
-                    import deeplearning4j_tpu.modelimport.tensorflow  # noqa: F401
-                fn = _FN_REBUILDERS[rebuild](attrs)
-            elif nd_spec.get("rng"):
-                fn = _make_rng_fn(nd_spec["op"], attrs)
-                attrs["__rng__"] = True
-            else:
-                fn = op_registry.get(nd_spec["op"])
-            node = _Node(nd_spec["op"], fn, nd_spec["inputs"], nd_spec["outputs"],
-                         attrs, rebuild=rebuild)
+            node = _node_from_spec(nd_spec)
             sd._nodes.append(node)
             for on in node.outputs:
                 sd._vars[on] = SDVariable(sd, on, "ARRAY")
@@ -1024,6 +1102,182 @@ class SameDiff:
             leaves = [upd_leaves[i] for i in range(len(upd_leaves))]
             sd._updater_state = _treedef_from_json(graph["updater_treedef"], leaves)
         return sd
+
+
+def _node_to_spec(node: _Node) -> dict:
+    """JSON-able spec of one node (shared by save() and subgraph specs)."""
+    spec = {"op": node.op, "inputs": node.inputs, "outputs": node.outputs,
+            "attrs": {k: v for k, v in node.attrs.items() if k != "__rng__"},
+            "rng": bool(node.attrs.get("__rng__"))}
+    if node.rebuild is not None:
+        spec["rebuild"] = node.rebuild
+    elif not op_registry.has(node.op):
+        raise ValueError(
+            f"node '{node.op}' is not serializable: its body is an "
+            f"arbitrary Python closure. while_loop/cond round-trip when "
+            f"their bodies are SameDiff subgraphs (pass SameDiff instances "
+            f"instead of Python callables); raw-callable bodies have no "
+            f"data form and must be rebuilt from code after load.")
+    return spec
+
+
+def _node_from_spec(nd_spec: dict) -> _Node:
+    """Rebuild a node (with executable fn) from its JSON spec."""
+    attrs = dict(nd_spec["attrs"])
+    attrs = {k: (tuple(v) if isinstance(v, list) and k != "index" else v)
+             for k, v in attrs.items()}
+    rebuild = nd_spec.get("rebuild")
+    if rebuild is not None:
+        if rebuild not in _FN_REBUILDERS and rebuild == "tf":
+            # TF-imported graphs: the rebuilder registers on import
+            import deeplearning4j_tpu.modelimport.tensorflow  # noqa: F401
+        fn = _FN_REBUILDERS[rebuild](attrs)
+        if nd_spec.get("rng"):
+            # control-flow nodes whose subgraph bodies hold RNG ops still
+            # receive (key, train) from the executor
+            attrs["__rng__"] = True
+    elif nd_spec.get("rng"):
+        fn = _make_rng_fn(nd_spec["op"], attrs)
+        attrs["__rng__"] = True
+    else:
+        fn = op_registry.get(nd_spec["op"])
+    return _Node(nd_spec["op"], fn, nd_spec["inputs"], nd_spec["outputs"],
+                 attrs, rebuild=rebuild)
+
+
+# ------------------------------------------------------------- subgraphs
+# A SameDiff graph can serve as the body of a control-flow node (while/
+# cond) or a function call. The subgraph serializes to a fully
+# self-contained JSON spec (arrays base64-inline — control-flow bodies
+# are small), so control flow round-trips through save()/load() — the
+# TPU-native answer to the reference's FlatBuffers'd Enter/Exit/Merge
+# frames (SURVEY.md §2.2 SameDiff core).
+
+def _arr_to_json(a) -> dict:
+    a = np.asarray(a)
+    return {"dtype": str(a.dtype), "shape": list(a.shape),
+            "data": base64.b64encode(a.tobytes()).decode("ascii")}
+
+
+def _arr_from_json(d) -> np.ndarray:
+    return np.frombuffer(base64.b64decode(d["data"]),
+                         np.dtype(d["dtype"])).reshape(d["shape"]).copy()
+
+
+def subgraph_spec(sub: "SameDiff", outputs: Sequence[str]) -> dict:
+    """Self-contained JSON spec of ``sub``: placeholders (in declared
+    order — the call convention), variables folded to constants (subgraph
+    weights are closed over, not trained), nodes, and output names."""
+    return {
+        "ph_order": list(sub._placeholders),
+        "placeholders": {k: [list(v[0]) if v[0] else None,
+                             str(np.dtype(v[1]) if not isinstance(v[1], str)
+                                 else v[1])]
+                         for k, v in sub._placeholders.items()},
+        "consts": {k: _arr_to_json(v)
+                   for k, v in {**sub._constants, **sub._variables}.items()},
+        "nodes": [_node_to_spec(n) for n in sub._nodes],
+        "outputs": list(outputs),
+        # containing nodes thread (rng_key, train) through when True, so
+        # dropout/noise inside control-flow bodies stays live in training
+        "has_rng": any(n.attrs.get("__rng__") for n in sub._nodes),
+    }
+
+
+def subgraph_from_spec(spec: dict) -> "SameDiff":
+    sub = SameDiff()
+    for name in spec["ph_order"]:
+        shp, dt = spec["placeholders"][name]
+        sub.placeHolder(name, shape=tuple(shp) if shp else None,
+                        dtype=np.dtype(dt))
+    for name, d in spec["consts"].items():
+        sub.constant(_arr_from_json(d), name=name)
+    for nd_spec in spec["nodes"]:
+        node = _node_from_spec(nd_spec)
+        sub._nodes.append(node)
+        for on in node.outputs:
+            sub._vars[on] = SDVariable(sub, on, "ARRAY")
+            sub._producers[on] = node
+    return sub
+
+
+def subgraph_fn(spec: dict) -> Callable:
+    """Compile a subgraph spec to ``call(*args, key=None, train=False) ->
+    tuple(outputs)`` with args bound to the placeholders in declared
+    order. RNG nodes inside the subgraph consume ``key``/``train``."""
+    sub = subgraph_from_spec(spec)
+    outputs = tuple(spec["outputs"])
+    ph_names = spec["ph_order"]
+    base = sub._build_fn(outputs)
+
+    def call(*args, key=None, train=False):
+        k = key if key is not None else jax.random.PRNGKey(0)
+        outs = base({}, sub._constants, dict(zip(ph_names, args)), k, train)
+        return tuple(outs[n] for n in outputs)
+    return call
+
+
+def _sub_has_rng(*specs) -> bool:
+    return any(s.get("has_rng") for s in specs)
+
+
+def _make_subwhile_fn(attrs: dict) -> Callable:
+    cond = subgraph_fn(attrs["cond"])
+    body = subgraph_fn(attrs["body"])
+    n = len(attrs["body"]["outputs"])
+
+    def run(args, key, train):
+        res = jax.lax.while_loop(
+            lambda c: jnp.reshape(cond(*c, key=key, train=train)[0],
+                                  ()).astype(bool),
+            lambda c: body(*c, key=key, train=train), tuple(args))
+        return res if n > 1 else res[0]
+
+    if _sub_has_rng(attrs["cond"], attrs["body"]):
+        # recorded with __rng__: _build_fn appends (key, train)
+        def fn(*all_args):
+            *args, key, train = all_args
+            return run(args, key, train)
+        return fn
+    return lambda *args, **_kw: run(args, None, False)
+
+
+def _make_subcond_fn(attrs: dict) -> Callable:
+    tfn = subgraph_fn(attrs["true"])
+    ffn = subgraph_fn(attrs["false"])
+    n = len(attrs["true"]["outputs"])
+
+    def run(p, args, key, train):
+        res = jax.lax.cond(jnp.reshape(p, ()).astype(bool),
+                           lambda c: tfn(*c, key=key, train=train),
+                           lambda c: ffn(*c, key=key, train=train),
+                           tuple(args))
+        return res if n > 1 else res[0]
+
+    if _sub_has_rng(attrs["true"], attrs["false"]):
+        def fn(p, *all_args):
+            *args, key, train = all_args
+            return run(p, args, key, train)
+        return fn
+    return lambda p, *args, **_kw: run(p, args, None, False)
+
+
+def _make_subcall_fn(attrs: dict) -> Callable:
+    """Inline function call: one node that executes a whole subgraph
+    (differentiable — jax traces straight through)."""
+    sub = subgraph_fn(attrs["sub"])
+    n = len(attrs["sub"]["outputs"])
+
+    def run(args, key, train):
+        res = sub(*args, key=key, train=train)
+        return res if n > 1 else res[0]
+
+    if _sub_has_rng(attrs["sub"]):
+        def fn(*all_args):
+            *args, key, train = all_args
+            return run(args, key, train)
+        return fn
+    return lambda *args, **_kw: run(args, None, False)
 
 
 def _make_rng_fn(op: str, params: Dict) -> Callable:
@@ -1103,6 +1357,9 @@ _FN_REBUILDERS = {
     "std": _make_std_fn,
     "variance": _make_variance_fn,
     "multi_head_dot_product_attention": _make_mha_fn,
+    "subwhile": _make_subwhile_fn,
+    "subcond": _make_subcond_fn,
+    "subcall": _make_subcall_fn,
 }
 
 

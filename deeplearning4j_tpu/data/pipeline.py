@@ -1,0 +1,318 @@
+"""Multi-worker host data pipeline: decode + augment in worker processes,
+hand batches to the training loop through a shared-memory ring.
+
+Reference parity: the reference feeds its training loops through
+``ImageRecordReader -> RecordReaderDataSetIterator -> AsyncDataSetIterator``
+with JavaCV decoding on host threads (SURVEY.md §3.1 input pipeline;
+§7 hard-part #5 "prove the host can feed the chip"). The TPU-native
+re-design differs in three ways:
+
+1. **Worker processes, not threads** — Python decode (cv2/PIL) holds the
+   GIL for numpy conversion, so real parallelism needs processes. Batches
+   cross the process boundary through a ``multiprocessing.shared_memory``
+   ring: workers write decoded pixels straight into a preallocated slot,
+   the consumer hands the slot to ``jax.device_put`` — no pickling, no
+   per-batch allocation, one host memcpy total.
+2. **uint8 to the device** — slots hold uint8 NCHW; the cast to the
+   compute dtype happens ON DEVICE inside the jitted train step
+   (``nn/layers.policy_cast``), so the host ships 1/4 the bytes and never
+   pays a float conversion. ``dtype="float32"`` opts back into host-side
+   float batches for nets that need pre-normalized input.
+3. **Fixed shapes** — every ring batch has the same [B, C, H, W] shape
+   (tail files that do not fill a batch are dropped by default, or folded
+   into a final host-decoded partial batch with ``drop_last=False``), so
+   the train step compiles exactly once.
+
+Throughput model (documented for the bench): sustained img/s =
+min(workers x per-core decode rate, device step rate). On a single-core
+host the pipeline is decode-bound at ~1/decode_ms img/s no matter how
+many workers run; see BASELINE.md "data pipeline" for the measured
+numbers and the multi-core projection.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import queue
+import uuid
+from multiprocessing import get_context
+from multiprocessing import shared_memory as _shm
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet, DataSetIterator
+from deeplearning4j_tpu.data.image import (ImageTransform, NativeImageLoader,
+                                           ParentPathLabelGenerator,
+                                           _list_images)
+
+
+def _decode_one(path: str, height: int, width: int, channels: int
+                ) -> np.ndarray:
+    """Decode + resize one file to CHW uint8. cv2 (libjpeg-turbo) when
+    available — ~1.5x PIL on the same core — else PIL."""
+    try:
+        import cv2
+        flag = cv2.IMREAD_GRAYSCALE if channels == 1 else cv2.IMREAD_COLOR
+        img = cv2.imread(path, flag)
+        if img is None:
+            raise ValueError(f"cv2 failed to decode {path}")
+        if img.shape[:2] != (height, width):
+            img = cv2.resize(img, (width, height),
+                             interpolation=cv2.INTER_LINEAR)
+        if channels == 1:
+            img = img[:, :, None]
+        else:
+            img = img[:, :, ::-1]                    # BGR -> RGB (PIL parity)
+        return np.ascontiguousarray(np.transpose(img, (2, 0, 1)))
+    except ImportError:
+        from PIL import Image
+        img = Image.open(path).convert("L" if channels == 1 else "RGB")
+        if img.size != (width, height):
+            img = img.resize((width, height), Image.BILINEAR)
+        arr = np.asarray(img, np.uint8)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        return np.transpose(arr, (2, 0, 1))
+
+
+def _worker_main(shm_name: str, slot_shape, slot_dtype: str, n_slots: int,
+                 files: List[str], hw, task_q, free_q, ready_q,
+                 transform_bytes: Optional[bytes], seed: int):
+    """Worker loop: pull a batch assignment, decode into a free ring slot,
+    announce it ready. Runs until the ``None`` sentinel."""
+    try:
+        import cv2
+        cv2.setNumThreads(1)        # one decode stream per worker process
+    except ImportError:
+        pass
+    height, width, channels = hw
+    transform = None
+    if transform_bytes is not None:
+        import pickle
+        transform = pickle.loads(transform_bytes)
+    rng = np.random.RandomState(seed)
+    # the parent owns the ring; this process must not register (and later
+    # unlink) it with the shared resource tracker — Python <3.13 has no
+    # track=False, so stub the register call around the attach
+    from multiprocessing import resource_tracker
+    _orig_register = resource_tracker.register
+    resource_tracker.register = lambda *a, **kw: None
+    try:
+        shm = _shm.SharedMemory(name=shm_name)
+    finally:
+        resource_tracker.register = _orig_register
+    ring = np.ndarray((n_slots,) + tuple(slot_shape),
+                      dtype=np.dtype(slot_dtype), buffer=shm.buf)
+    try:
+        while True:
+            task = task_q.get()
+            if task is None:
+                break
+            batch_id, idxs, labels = task
+            slot = free_q.get()
+            buf = ring[slot]
+            for row, i in enumerate(idxs):
+                img = _decode_one(files[i], height, width, channels)
+                if transform is not None:
+                    img = transform.transform(img.astype(np.float32), rng)
+                    img = np.clip(img, 0, 255)
+                buf[row] = img          # implicit cast to the slot dtype
+            ready_q.put((batch_id, slot, labels))
+    finally:
+        shm.close()
+
+
+class MultiWorkerImageIterator(DataSetIterator):
+    """Directory-of-class-directories image pipeline with N decode worker
+    processes (ref: ImageRecordReader + RecordReaderDataSetIterator +
+    AsyncDataSetIterator, collapsed into the one seam that matters for
+    feeding a TPU — see module docstring for the design deltas).
+
+    ``next()`` returns uint8 NCHW DataSets by default; the network casts
+    on device. Worker processes use the ``spawn`` start method: this
+    process typically holds a live TPU client, and forking a process with
+    an initialized accelerator runtime is undefined behaviour.
+    """
+
+    def __init__(self, root: str, height: int, width: int, channels: int = 3,
+                 batch_size: int = 32, workers: Optional[int] = None,
+                 n_slots: Optional[int] = None, dtype: str = "uint8",
+                 transform: Optional[ImageTransform] = None,
+                 label_generator=None, shuffle: bool = False,
+                 drop_last: bool = True, seed: int = 12345,
+                 files: Optional[Sequence[str]] = None,
+                 start_method: str = "spawn"):
+        self.height, self.width, self.channels = height, width, channels
+        self.batch_size = int(batch_size)
+        self.workers = max(1, workers if workers is not None
+                           else (os.cpu_count() or 1))
+        self.n_slots = n_slots if n_slots is not None else 2 * self.workers + 2
+        self.np_dtype = np.dtype({"uint8": np.uint8,
+                                  "float32": np.float32}[dtype])
+        self.transform = transform
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.seed = seed
+        self._label_gen = label_generator or ParentPathLabelGenerator()
+        self._files = list(files) if files is not None else _list_images(root)
+        if not self._files:
+            raise FileNotFoundError(f"no images under {root}")
+        self.labels = sorted({self._label_gen.getLabelForPath(f)
+                              for f in self._files})
+        self._label_idx = np.asarray(
+            [self.labels.index(self._label_gen.getLabelForPath(f))
+             for f in self._files], np.int32)
+        self._ctx = get_context(start_method)
+        self._shm = None
+        self._procs: List = []
+        self._epoch = 0
+        self._started = False
+        self._loader = NativeImageLoader(height, width, channels)
+        atexit.register(self.close)
+        self.reset()
+
+    # ------------------------------------------------------------ lifecycle
+    def _start(self):
+        slot_shape = (self.batch_size, self.channels, self.height, self.width)
+        slot_bytes = int(np.prod(slot_shape)) * self.np_dtype.itemsize
+        self._shm = _shm.SharedMemory(
+            create=True, size=self.n_slots * slot_bytes,
+            name=f"dl4jtpu_{uuid.uuid4().hex[:12]}")
+        self._ring = np.ndarray((self.n_slots,) + slot_shape,
+                                dtype=self.np_dtype, buffer=self._shm.buf)
+        self._task_q = self._ctx.Queue()
+        self._free_q = self._ctx.Queue()
+        self._ready_q = self._ctx.Queue()
+        for s in range(self.n_slots):
+            self._free_q.put(s)
+        tbytes = None
+        if self.transform is not None:
+            import pickle
+            tbytes = pickle.dumps(self.transform)
+        # decode workers must NOT initialize an accelerator backend: spawn
+        # re-runs sitecustomize in each child, and a TPU bootstrap there
+        # would fight the parent for the chip. Pin the children to CPU and
+        # strip the TPU bootstrap trigger for the duration of the spawn.
+        saved = {k: os.environ.get(k)
+                 for k in ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS")}
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        try:
+            for w in range(self.workers):
+                p = self._ctx.Process(
+                    target=_worker_main,
+                    args=(self._shm.name, slot_shape, self.np_dtype.str,
+                          self.n_slots, self._files,
+                          (self.height, self.width, self.channels),
+                          self._task_q, self._free_q, self._ready_q,
+                          tbytes, self.seed + 7919 * w),
+                    daemon=True)
+                p.start()
+                self._procs.append(p)
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        self._started = True
+
+    def close(self):
+        """Stop workers and release the shared-memory ring."""
+        if not self._started:
+            return
+        self._started = False
+        for _ in self._procs:
+            self._task_q.put(None)
+        for p in self._procs:
+            p.join(timeout=5)
+            if p.is_alive():
+                p.terminate()
+        self._procs = []
+        try:
+            self._shm.close()
+            self._shm.unlink()
+        except FileNotFoundError:
+            pass
+        self._shm = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------- epoching
+    def reset(self):
+        if self._started and getattr(self, "_pending", 0):
+            # mid-epoch reset: discard unstarted tasks, then absorb whatever
+            # the workers already have in flight (count-based, so a task a
+            # worker popped but hasn't finished is simply awaited)
+            try:
+                while True:
+                    self._task_q.get_nowait()
+                    self._pending -= 1
+            except queue.Empty:
+                pass
+            while self._pending > 0:
+                _, slot, _ = self._ready_q.get()
+                self._free_q.put(slot)
+                self._pending -= 1
+        order = np.arange(len(self._files))
+        if self.shuffle:
+            np.random.RandomState(self.seed + self._epoch).shuffle(order)
+            self._epoch += 1
+        n_full = len(order) // self.batch_size
+        self._tail = [] if self.drop_last \
+            else order[n_full * self.batch_size:].tolist()
+        if not self._started:
+            self._start()
+        self._pending = 0
+        for b in range(n_full):
+            idxs = order[b * self.batch_size:(b + 1) * self.batch_size]
+            self._task_q.put((b, idxs.tolist(),
+                              self._label_idx[idxs].tolist()))
+            self._pending += 1
+        self._tail_done = False
+
+    def hasNext(self):
+        return self._pending > 0 or (bool(self._tail) and not self._tail_done)
+
+    def next(self) -> DataSet:
+        if self._pending > 0:
+            batch_id, slot, labels = self._ready_q.get()
+            self._pending -= 1
+            # one host memcpy out of the ring; the slot is immediately
+            # reusable, and jax.device_put on the copy overlaps with the
+            # next decode
+            feats = np.array(self._ring[slot], copy=True)
+            self._free_q.put(slot)
+        else:
+            self._tail_done = True
+            idxs = self._tail
+            feats = np.empty((len(idxs), self.channels, self.height,
+                              self.width), self.np_dtype)
+            rng = np.random.RandomState(self.seed - 1)
+            for row, i in enumerate(idxs):
+                img = _decode_one(self._files[i], self.height, self.width,
+                                  self.channels)
+                if self.transform is not None:
+                    img = np.clip(self.transform.transform(
+                        img.astype(np.float32), rng), 0, 255)
+                feats[row] = img
+            labels = self._label_idx[idxs].tolist()
+        y = np.eye(len(self.labels), dtype=np.float32)[
+            np.asarray(labels, np.int64)]
+        return self._apply_pre(DataSet(feats, y))
+
+    # ------------------------------------------------------------- metadata
+    def batch(self):
+        return self.batch_size
+
+    def totalOutcomes(self):
+        return len(self.labels)
+
+    def inputColumns(self):
+        return self.channels * self.height * self.width

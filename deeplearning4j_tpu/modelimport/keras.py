@@ -33,8 +33,8 @@ import numpy as np
 from deeplearning4j_tpu.nn import layers as L
 from deeplearning4j_tpu.nn import preprocessors as pp
 from deeplearning4j_tpu.nn.config import (InputType, NeuralNetConfiguration)
-from deeplearning4j_tpu.nn.graph import (ComputationGraph, ElementWiseVertex,
-                                         MergeVertex)
+from deeplearning4j_tpu.nn.graph import (ComputationGraph, DotProductVertex,
+                                         ElementWiseVertex, MergeVertex)
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
 
@@ -194,6 +194,35 @@ def _map_conv2d(cfg) -> _Imported:
 
     def fill(kw, pre_it):
         params = {"W": jnp.asarray(kw["kernel"].transpose(3, 2, 0, 1))}
+        if "bias" in kw:
+            params["b"] = jnp.asarray(kw["bias"])
+        return params, None
+    return _Imported(lay, cfg["name"], fill)
+
+
+def _map_conv2d_transpose(cfg) -> _Imported:
+    mode, pad = _conv_mode(cfg.get("padding", "valid"))
+    if cfg.get("output_padding") not in (None, [None, None]):
+        raise KerasImportError(
+            "Conv2DTranspose output_padding is not supported")
+    if str(cfg.get("data_format", "channels_last")) == "channels_first":
+        raise KerasImportError("channels_first Keras convs are not supported; "
+                               "save the model channels_last")
+    if _pair(cfg.get("dilation_rate", 1)) != (1, 1):
+        raise KerasImportError(
+            "dilated Conv2DTranspose does not import (deconv2d has no "
+            "dilation path)")
+    lay = L.Deconvolution2D(
+        kernelSize=_pair(cfg["kernel_size"]),
+        stride=_pair(cfg.get("strides", 1)), padding=pad,
+        nOut=int(cfg["filters"]), convolutionMode=mode,
+        hasBias=bool(cfg.get("use_bias", True)),
+        activation=_act(cfg.get("activation")))
+
+    def fill(kw, pre_it):
+        # keras transposed-conv kernel [kH, kW, cOut, cIn] (out/in swapped
+        # vs Conv2D) -> ours [cOut, cIn, kH, kW]
+        params = {"W": jnp.asarray(kw["kernel"].transpose(2, 3, 0, 1))}
         if "bias" in kw:
             params["b"] = jnp.asarray(kw["bias"])
         return params, None
@@ -670,8 +699,43 @@ def _map_multi_head_attention(cfg) -> _Imported:
     return _Imported(lay, cfg["name"], fill)
 
 
+def _map_spatial_dropout(cfg) -> _Imported:
+    # channel dropout (whole feature maps), matching Keras training
+    # semantics — NOT element-wise DropoutLayer
+    return _Imported(L.SpatialDropoutLayer(float(cfg.get("rate", 0.5))),
+                     cfg["name"])
+
+
+def _map_zero_padding3d(cfg) -> _Imported:
+    return _Imported(L.ZeroPadding3DLayer(padding=cfg.get("padding", 1)),
+                     cfg["name"])
+
+
+def _map_cropping3d(cfg) -> _Imported:
+    return _Imported(L.Cropping3D(crop=cfg.get("cropping", 1)), cfg["name"])
+
+
+def _map_upsampling3d(cfg) -> _Imported:
+    return _Imported(L.Upsampling3D(size=cfg.get("size", 2)), cfg["name"])
+
+
+def _map_activity_regularization(cfg) -> _Imported:
+    # inference/structure no-op: the activity penalty only shifts training
+    # loss; DL4J imports it the same way
+    return _Imported(L.ActivationLayer("identity"), cfg["name"])
+
+
 _MAPPERS = {
     "Dense": _map_dense,
+    "Conv2DTranspose": _map_conv2d_transpose,
+    "ZeroPadding3D": _map_zero_padding3d,
+    "Cropping3D": _map_cropping3d,
+    "UpSampling3D": _map_upsampling3d,
+    "SpatialDropout1D": _map_spatial_dropout,
+    "SpatialDropout3D": _map_spatial_dropout,
+    "GlobalMaxPooling3D": lambda c: _map_global_pool(c, "max"),
+    "GlobalAveragePooling3D": lambda c: _map_global_pool(c, "avg"),
+    "ActivityRegularization": _map_activity_regularization,
     "Conv1D": _map_conv1d,
     "Conv2D": _map_conv2d,
     "DepthwiseConv2D": _map_depthwise_conv2d,
@@ -701,7 +765,7 @@ _MAPPERS = {
     "Permute": _map_permute,
     "RepeatVector": _map_repeat_vector,
     "Dropout": _map_dropout,
-    "SpatialDropout2D": _map_dropout,
+    "SpatialDropout2D": _map_spatial_dropout,
     "Conv3D": _map_conv3d,
     "MaxPooling3D": lambda c: _map_pool3d(c, "max"),
     "AveragePooling3D": lambda c: _map_pool3d(c, "avg"),
@@ -824,6 +888,20 @@ class KerasModelImport:
                     continue
                 if cls in _ELEMENTWISE:
                     g.addVertex(name, ElementWiseVertex(_ELEMENTWISE[cls]), *inbound)
+                    alias[name] = name
+                    continue
+                if cls == "Dot":
+                    axes = lcfg.get("axes", -1)
+                    ok = axes in (-1, 1) or (isinstance(axes, (list, tuple))
+                                             and all(a in (-1, 1)
+                                                     for a in axes))
+                    if not ok:
+                        raise KerasImportError(
+                            f"Dot axes {axes} unsupported (last-axis dot "
+                            f"of 2D inputs only)")
+                    g.addVertex(name, DotProductVertex(
+                        normalize=bool(lcfg.get("normalize", False))),
+                        *inbound)
                     alias[name] = name
                     continue
                 if cls == "Concatenate":

@@ -578,9 +578,12 @@ _sdmod._FN_REBUILDERS["tf"] = _tf_rebuild
 class _Ctx:
     """Per-import state handed to each op mapper."""
 
-    def __init__(self, sd: SameDiff):
+    def __init__(self, sd: SameDiff, library: Dict = None):
         self.sd = sd
         self.consts: Dict[str, np.ndarray] = {}   # const folding table
+        # FunctionDefs by name (graph_def.library) — the bodies of
+        # StatelessWhile/StatelessIf/PartitionedCall nodes
+        self.library: Dict[str, Any] = library or {}
 
     def const_of(self, name: str) -> np.ndarray:
         if name not in self.consts:
@@ -1300,33 +1303,119 @@ class TFGraphImport:
             graph_def = gd
 
         sd = SameDiff.create()
-        ctx = _Ctx(sd)
+        library = {f.signature.name: f
+                   for f in graph_def.library.function} \
+            if graph_def.HasField("library") else {}
+        ctx = _Ctx(sd, library)
         for node in graph_def.node:
-            data_ins = [_var_name(i) for i in node.input
-                        if not i.startswith("^")]
-            if node.op == "Const":
-                val = _tensor_value(node)
-                ctx.consts[node.name] = val
-                sd.constant(val, name=node.name)
-            elif node.op == "Placeholder":
-                shape = _attr(node, "shape")
-                shape = tuple(None if d in (-1, 0) and i == 0 else
-                              (None if d == -1 else d)
-                              for i, d in enumerate(shape or []))
-                dt = _attr(node, "dtype") or np.float32
-                sd.placeHolder(node.name, shape=shape or None, dtype=dt)
-            elif node.op == "NoOp":
-                continue
-            elif node.op in _MAPPERS:
-                params, used, n_out = _MAPPERS[node.op](ctx, node, data_ins)
-                _record_tf_node(ctx, node, params, used, n_out)
-            else:
-                raise TFImportError(
-                    f"unmapped TF op '{node.op}' (node '{node.name}') — add "
-                    f"a mapper to modelimport.tensorflow._MAPPERS. (Control "
-                    f"flow frames and training-mode ops intentionally do not "
-                    f"import; see module docstring.)")
+            _import_one(ctx, node, _var_name)
         return sd
+
+
+def _import_one(ctx: _Ctx, node, resolver):
+    """Import one NodeDef into ctx.sd (shared by the GraphDef loop and
+    FunctionDef bodies; ``resolver`` maps the container's input-ref syntax
+    to variable names)."""
+    data_ins = [resolver(i) for i in node.input if not i.startswith("^")]
+    if node.op == "Const":
+        val = _tensor_value(node)
+        ctx.consts[node.name] = val
+        ctx.sd.constant(val, name=node.name)
+    elif node.op == "Placeholder":
+        shape = _attr(node, "shape")
+        shape = tuple(None if d in (-1, 0) and i == 0 else
+                      (None if d == -1 else d)
+                      for i, d in enumerate(shape or []))
+        dt = _attr(node, "dtype") or np.float32
+        ctx.sd.placeHolder(node.name, shape=shape or None, dtype=dt)
+    elif node.op == "NoOp":
+        return
+    elif node.op in _MAPPERS:
+        params, used, n_out = _MAPPERS[node.op](ctx, node, data_ins)
+        _record_tf_node(ctx, node, params, used, n_out)
+    else:
+        raise TFImportError(
+            f"unmapped TF op '{node.op}' (node '{node.name}') — add "
+            f"a mapper to modelimport.tensorflow._MAPPERS. (TF1 "
+            f"Enter/Exit/Merge control-flow frames and training-mode ops "
+            f"intentionally do not import; TF2 functional control flow "
+            f"(StatelessWhile/StatelessIf/While/If) does.)")
+
+
+def _fn_var_name(ref: str) -> str:
+    """FunctionDef-body input ref -> variable name: 'arg' stays, a
+    'node:field:k' output ref collapses to the GraphDef ':k' convention."""
+    parts = ref.split(":")
+    if len(parts) == 1:
+        return parts[0]
+    if len(parts) == 3:
+        return parts[0] if parts[2] == "0" else f"{parts[0]}:{parts[2]}"
+    return _var_name(ref)
+
+
+def _import_function(ctx: _Ctx, fname: str):
+    """FunctionDef -> (sub-SameDiff, output names). Function args become
+    placeholders in signature order — the subgraph call convention
+    (autodiff.samediff.subgraph_fn)."""
+    if fname not in ctx.library:
+        raise TFImportError(f"function '{fname}' not in graph library")
+    fdef = ctx.library[fname]
+    sub = SameDiff.create()
+    sctx = _Ctx(sub, ctx.library)
+    for arg in fdef.signature.input_arg:
+        sub.placeHolder(arg.name, shape=None,
+                        dtype=_DTYPES.get(arg.type, np.float32))
+    for node in fdef.node_def:
+        _import_one(sctx, node, _fn_var_name)
+    outs = [_fn_var_name(fdef.ret[o.name])
+            for o in fdef.signature.output_arg]
+    return sub, outs
+
+
+def _m_functional_while(ctx, node, ins):
+    """TF2 functional while (ref: the interpreted Enter/Exit/Merge frame
+    loop in SURVEY §3.3, re-designed as lax.while_loop over compiled
+    subgraph bodies)."""
+    cond_sd, cond_outs = _import_function(ctx, node.attr["cond"].func.name)
+    body_sd, body_outs = _import_function(ctx, node.attr["body"].func.name)
+    if len(body_outs) != len(ins):
+        raise TFImportError(
+            f"While '{node.name}': body returns {len(body_outs)} values "
+            f"for {len(ins)} loop vars")
+    params = {"cond": _sdmod.subgraph_spec(cond_sd, cond_outs),
+              "body": _sdmod.subgraph_spec(body_sd, body_outs)}
+    return params, ins, len(ins)
+
+
+def _m_functional_if(ctx, node, ins):
+    then_sd, then_outs = _import_function(
+        ctx, node.attr["then_branch"].func.name)
+    else_sd, else_outs = _import_function(
+        ctx, node.attr["else_branch"].func.name)
+    params = {"then": _sdmod.subgraph_spec(then_sd, then_outs),
+              "else": _sdmod.subgraph_spec(else_sd, else_outs)}
+    return params, ins, len(then_outs)
+
+
+def _m_partitioned_call(ctx, node, ins):
+    sub, outs = _import_function(ctx, node.attr["f"].func.name)
+    return {"sub": _sdmod.subgraph_spec(sub, outs)}, ins, len(outs)
+
+
+_MAPPERS["StatelessWhile"] = _m_functional_while
+_MAPPERS["While"] = _m_functional_while
+_MAPPERS["StatelessIf"] = _m_functional_if
+_MAPPERS["If"] = _m_functional_if
+_MAPPERS["PartitionedCall"] = _m_partitioned_call
+_MAPPERS["StatefulPartitionedCall"] = _m_partitioned_call
+
+_BUILDERS["StatelessWhile"] = lambda p: _sdmod._make_subwhile_fn(p)
+_BUILDERS["While"] = lambda p: _sdmod._make_subwhile_fn(p)
+_BUILDERS["StatelessIf"] = lambda p: _sdmod._make_subcond_fn(
+    {"true": p["then"], "false": p["else"]})
+_BUILDERS["If"] = _BUILDERS["StatelessIf"]
+_BUILDERS["PartitionedCall"] = lambda p: _sdmod._make_subcall_fn(p)
+_BUILDERS["StatefulPartitionedCall"] = _BUILDERS["PartitionedCall"]
 
 
 def _fold_output_size_ok(fn, ins: List[np.ndarray]) -> bool:

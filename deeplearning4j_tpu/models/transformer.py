@@ -46,6 +46,9 @@ class TransformerConfig:
     causal: bool = False             # False = BERT-style encoder, True = GPT-style
     dtype: Any = jnp.bfloat16
     use_ring_attention: bool = False
+    # fused flash-attention path (Pallas platform override when installed;
+    # scan formulation otherwise) — no [T, T] score matrix
+    use_flash_attention: bool = False
     tie_embeddings: bool = True
     # "preln" = the TPU-first training layout (pre-LN, approximate gelu);
     # "postln_bert" = faithful BERT layout (post-LN residuals, embedding
@@ -152,6 +155,8 @@ def _attention(x, lp, cfg: TransformerConfig, mesh: Optional[DeviceMesh],
         ctx = ring_attention(q, k, v, mesh.mesh, axis_name="seq",
                              is_causal=cfg.causal, batch_axis="data",
                              head_axis="model" if mesh.size("model") > 1 else None)
+    elif cfg.use_flash_attention and attn_mask is None:
+        ctx = attn_ops.flash_attention(q, k, v, is_causal=cfg.causal)
     else:
         m = attn_mask[:, None, None, :] if attn_mask is not None else None
         ctx = attn_ops.dot_product_attention(q, k, v, mask=m,

@@ -108,6 +108,34 @@ class ElementWiseVertex(GraphVertex):
         raise ValueError(self.op)
 
 
+class DotProductVertex(GraphVertex):
+    """Per-example dot product of two same-shape inputs, with optional L2
+    normalization first (the Keras ``Dot``/cosine-proximity merge; ref:
+    KerasDot in the reference's keras-import merge family)."""
+
+    def __init__(self, normalize: bool = False):
+        self.normalize = normalize
+
+    def apply(self, a, b):
+        if a.ndim != 2 or b.ndim != 2:
+            raise ValueError(
+                f"DotProductVertex supports rank-2 [N, C] inputs (got ranks "
+                f"{a.ndim}/{b.ndim}); higher-rank Keras Dot contractions "
+                f"do not import")
+        if self.normalize:
+            a = a / jnp.maximum(jnp.linalg.norm(a, axis=-1, keepdims=True),
+                                1e-12)
+            b = b / jnp.maximum(jnp.linalg.norm(b, axis=-1, keepdims=True),
+                                1e-12)
+        return jnp.sum(a * b, axis=-1, keepdims=True)
+
+    def output_type(self, *its: InputType) -> InputType:
+        return InputType.feedForward(1)
+
+    def to_config(self):
+        return {"@class": "DotProductVertex", "normalize": self.normalize}
+
+
 class SubsetVertex(GraphVertex):
     """Channel-range slice (ref: SubsetVertex)."""
 
@@ -206,8 +234,9 @@ class PreprocessorVertex(GraphVertex):
 
 _VERTEX_CLASSES = {c.__name__: c for c in
                    [MergeVertex, ElementWiseVertex, SubsetVertex,
-                    L2NormalizeVertex, ScaleVertex, ShiftVertex, StackVertex,
-                    UnstackVertex, PreprocessorVertex]}
+                    DotProductVertex, L2NormalizeVertex, ScaleVertex,
+                    ShiftVertex, StackVertex, UnstackVertex,
+                    PreprocessorVertex]}
 
 
 class _GraphNode:
@@ -370,7 +399,10 @@ class ComputationGraph:
     def _forward(self, params, states, inputs: Dict[str, Any], train, key,
                  fmask=None):
         cdt = L.compute_dtype_of(self.conf.base.dtype)
-        env = dict(inputs)
+        env = {k: (v.astype(jnp.float32)
+                   if cdt is None and getattr(v, "dtype", None) == jnp.uint8
+                   else v)
+               for k, v in inputs.items()}   # on-device image-byte cast
         new_states = {}
         for node in self.conf.topo:
             xs = [env[i] for i in node.inputs]

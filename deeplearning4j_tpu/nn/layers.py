@@ -465,6 +465,34 @@ class DropoutLayer(Layer):
         return it
 
 
+class SpatialDropoutLayer(Layer):
+    """Channel dropout: zeroes WHOLE feature maps per example (ref:
+    SpatialDropout in the reference's dropout family / Keras
+    SpatialDropout1D-3D semantics). ``rate`` is the DROP probability.
+    Input layout [N, C, *spatial]."""
+
+    input_kind = None
+    has_params = False
+
+    def __init__(self, rate=0.5, **kw):
+        super().__init__(**kw)
+        self.rate = float(rate)
+
+    def infer_nin(self, it):
+        self.nIn = self.nOut = it.arrayElementsPerExample()
+
+    def apply(self, params, state, x, train, key):
+        if not train or self.rate <= 0.0:
+            return x, state
+        keep = 1.0 - self.rate
+        shape = (x.shape[0], x.shape[1]) + (1,) * (x.ndim - 2)
+        mask = jax.random.bernoulli(key, keep, shape).astype(x.dtype)
+        return x * mask / keep, state
+
+    def output_type(self, it):
+        return it
+
+
 class ZeroPaddingLayer(Layer):
     """ref: layers.ZeroPaddingLayer."""
 
@@ -553,15 +581,15 @@ class GlobalPoolingLayer(Layer):
         self.pooling = poolingType.lower()
 
     def infer_nin(self, it):
-        self.nIn = self.nOut = it.channels if it.kind == "cnn" else it.size \
-            if it.kind == "rnn" else it.arrayElementsPerExample()
+        self.nIn = self.nOut = it.channels if it.kind in ("cnn", "cnn3d") \
+            else it.size if it.kind == "rnn" else it.arrayElementsPerExample()
 
     def apply(self, params, state, x, train, key, mask=None):
         return conv_ops.global_pool(x, self.pooling, data_format="NCHW",
                                     mask=mask), state
 
     def output_type(self, it):
-        n = it.channels if it.kind == "cnn" else it.size
+        n = it.channels if it.kind in ("cnn", "cnn3d") else it.size
         return InputType.feedForward(n)
 
 
@@ -1211,6 +1239,11 @@ def policy_cast(layer, params, x, compute_dt):
         return params, x
     if jnp.issubdtype(x.dtype, jnp.floating) and x.dtype != compute_dt:
         x = x.astype(compute_dt)
+    elif x.dtype == jnp.uint8:
+        # image bytes straight off the host pipeline: cast ON DEVICE (fused
+        # into the first conv program) so the host ships 1/4 the bandwidth
+        # and never pays a float conversion (data/pipeline.py)
+        x = x.astype(compute_dt)
     if params:
         params = jax.tree_util.tree_map(
             lambda a: a.astype(compute_dt)
@@ -1277,10 +1310,16 @@ class SelfAttentionLayer(Layer):
             if b is not None:
                 y = y + b
             return y.reshape(x.shape[0], x.shape[1], H, hs)
-        ctx = attention_ops.dot_product_attention(
-            proj(q_btc, params["Wq"], params.get("bq")),
-            proj(kv_btc, params["Wk"], params.get("bk")),
-            proj(kv_btc, params["Wv"], params.get("bv")), mask=m)
+        qh = proj(q_btc, params["Wq"], params.get("bq"))
+        kh = proj(kv_btc, params["Wk"], params.get("bk"))
+        vh = proj(kv_btc, params["Wv"], params.get("bv"))
+        if m is None and Tq >= 1024:
+            # long unmasked sequences: the fused flash path (Pallas kernel
+            # when installed, scan formulation otherwise) avoids the
+            # [T, T] score matrix
+            ctx = attention_ops.flash_attention(qh, kh, vh)
+        else:
+            ctx = attention_ops.dot_product_attention(qh, kh, vh, mask=m)
         out = ctx.reshape(B, Tq, H * hs) @ params["Wo"]
         if params.get("bo") is not None:
             out = out + params["bo"]
@@ -1538,6 +1577,87 @@ class Subsampling3DLayer(Layer):
                                           self.padding[i], 1, "truncate")
                 for i, s in enumerate((it.depth, it.height, it.width))]
         return InputType.convolutional3D(dims[0], dims[1], dims[2], it.channels)
+
+
+def _triple_pads(spec):
+    """int | (a, b, c) | ((lo, hi), ...) -> three (lo, hi) pairs."""
+    if isinstance(spec, (int, np.integer)):
+        spec = (spec,) * 3
+    return tuple((int(p), int(p)) if isinstance(p, (int, np.integer))
+                 else (int(p[0]), int(p[1])) for p in spec)
+
+
+class ZeroPadding3DLayer(Layer):
+    """ref: layers.convolution.ZeroPadding3DLayer — NCDHW."""
+
+    input_kind = "cnn3d"
+    has_params = False
+
+    def __init__(self, padding=(1, 1, 1), **kw):
+        super().__init__(**kw)
+        self.pad = _triple_pads(padding)
+
+    def infer_nin(self, it):
+        self.nIn = self.nOut = it.channels
+
+    def apply(self, params, state, x, train, key):
+        return jnp.pad(x, [(0, 0), (0, 0)] + list(self.pad)), state
+
+    def output_type(self, it):
+        d, h, w = ((s + sum(p)) for s, p in
+                   zip((it.depth, it.height, it.width), self.pad))
+        return InputType.convolutional3D(d, h, w, it.channels)
+
+
+class Cropping3D(Layer):
+    """ref: layers.convolution.Cropping3D — NCDHW."""
+
+    input_kind = "cnn3d"
+    has_params = False
+
+    def __init__(self, crop=(1, 1, 1), **kw):
+        super().__init__(**kw)
+        self.crop = _triple_pads(crop)
+
+    def infer_nin(self, it):
+        self.nIn = self.nOut = it.channels
+
+    def apply(self, params, state, x, train, key):
+        (d0, d1), (h0, h1), (w0, w1) = self.crop
+        D, H, W = x.shape[2:]
+        return x[:, :, d0:D - d1, h0:H - h1, w0:W - w1], state
+
+    def output_type(self, it):
+        d, h, w = ((s - sum(c)) for s, c in
+                   zip((it.depth, it.height, it.width), self.crop))
+        return InputType.convolutional3D(d, h, w, it.channels)
+
+
+class Upsampling3D(Layer):
+    """ref: layers.convolution.Upsampling3D — nearest repeat, NCDHW."""
+
+    input_kind = "cnn3d"
+    has_params = False
+
+    def __init__(self, size=2, **kw):
+        super().__init__(**kw)
+        self.scale = tuple(size) if isinstance(size, (tuple, list)) \
+            else (int(size),) * 3
+
+    def infer_nin(self, it):
+        self.nIn = self.nOut = it.channels
+
+    def apply(self, params, state, x, train, key):
+        for ax, s in zip((2, 3, 4), self.scale):
+            if s != 1:
+                x = jnp.repeat(x, s, axis=ax)
+        return x, state
+
+    def output_type(self, it):
+        return InputType.convolutional3D(it.depth * self.scale[0],
+                                         it.height * self.scale[1],
+                                         it.width * self.scale[2],
+                                         it.channels)
 
 
 class Upsampling1D(Layer):

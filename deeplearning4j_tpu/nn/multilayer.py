@@ -117,6 +117,8 @@ class MultiLayerNetwork:
     # --------------------------------------------------------------- forward
     def _forward(self, params, states, x, train: bool, key, fmask=None):
         cdt = L.compute_dtype_of(self.conf.base.dtype)
+        if cdt is None and getattr(x, "dtype", None) == jnp.uint8:
+            x = x.astype(jnp.float32)   # on-device image-byte cast (fp32 nets)
         new_states = []
         for i, layer in enumerate(self.layers):
             if i in self.conf.preprocessors:

@@ -76,15 +76,25 @@ def flash_attention(q, k, v, *, mask=None, is_causal: bool = False,
                     block_size: int = 512):
     """Blockwise attention with online softmax — O(T) memory.
 
-    The hot-path formulation flash attention uses, expressed as a
-    ``lax.scan`` over KV blocks so XLA keeps the running (max, sum, acc)
-    in registers/VMEM. Numerics: fp32 accumulation regardless of input
-    dtype. A hand-tiled Pallas kernel can override this via the platform-
-    helper seam in ops/registry.py (ref: libnd4j PlatformHelper).
-
+    Dispatch: a Pallas fused kernel registered as the platform override
+    (``ops.pallas_kernels.make_flash_attention_override``) takes the call
+    when installed; otherwise the ``lax.scan`` formulation below runs.
     Shapes: q [B, Tq, H, D]; k, v [B, Tk, H, D]; mask broadcastable to
     [B, H, Tq, Tk].
     """
+    from deeplearning4j_tpu.ops import registry as _reg
+    ov = _reg._PLATFORM_OVERRIDES.get("flash_attention")
+    if ov is not None:
+        return ov(q, k, v, mask=mask, is_causal=is_causal,
+                  block_size=block_size)
+    return _flash_attention_scan(q, k, v, mask=mask, is_causal=is_causal,
+                                 block_size=block_size)
+
+
+def _flash_attention_scan(q, k, v, *, mask=None, is_causal: bool = False,
+                          block_size: int = 512):
+    """The portable scan formulation (fp32 accumulation; runs on any
+    backend — also the fallback for shapes/masks the kernel rejects)."""
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
     blk = min(block_size, Tk)

@@ -26,6 +26,13 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "quick: fast smoke tier covering every subsystem "
+        "(`pytest -m quick`, target <120s — the CI gate)")
+
+
 @pytest.fixture(scope="session")
 def devices():
     return jax.devices()

@@ -113,3 +113,73 @@ class TestPlatformDispatch:
         got = np.asarray(sd.output({"x": xv}, [out.name])[out.name])
         np.testing.assert_allclose(got, np.asarray(jax.nn.softmax(xv * 2, -1)),
                                    rtol=1e-5, atol=1e-6)
+
+
+class TestFlashAttentionKernel:
+    """Pallas fused flash attention (VERDICT r4 #5): forward and custom
+    backward must match exact einsum attention."""
+
+    def _qkv(self, B=2, T=256, H=2, D=64, dtype=jnp.float32, seed=0):
+        rng = np.random.RandomState(seed)
+        mk = lambda: jnp.asarray(rng.randn(B, T, H, D).astype(np.float32),
+                                 dtype)
+        return mk(), mk(), mk()
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_forward_matches_exact(self, causal):
+        from deeplearning4j_tpu.ops import attention as attn_ops
+        from deeplearning4j_tpu.ops.pallas_kernels import \
+            make_flash_attention_override
+        q, k, v = self._qkv()
+        fa = make_flash_attention_override(interpret=True, bq=128, bk=128)
+        got = np.asarray(fa(q, k, v, is_causal=causal))
+        want = np.asarray(attn_ops.dot_product_attention(
+            q, k, v, is_causal=causal))
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_grads_match_exact(self, causal):
+        from deeplearning4j_tpu.ops import attention as attn_ops
+        from deeplearning4j_tpu.ops.pallas_kernels import \
+            make_flash_attention_override
+        q, k, v = self._qkv(T=128)
+        fa = make_flash_attention_override(interpret=True, bq=128, bk=128)
+
+        def loss_fa(q, k, v):
+            return jnp.sum(jnp.sin(fa(q, k, v, is_causal=causal)))
+
+        def loss_exact(q, k, v):
+            return jnp.sum(jnp.sin(attn_ops.dot_product_attention(
+                q, k, v, is_causal=causal)))
+
+        g1 = jax.grad(loss_fa, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(loss_exact, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-5)
+
+    def test_masked_and_odd_shapes_fall_back(self):
+        from deeplearning4j_tpu.ops.pallas_kernels import \
+            make_flash_attention_override
+        from deeplearning4j_tpu.ops import attention as attn_ops
+        fa = make_flash_attention_override(interpret=True, bq=128, bk=128)
+        rng = np.random.RandomState(1)
+        # odd T (not block-divisible) and a mask both route to the scan path
+        q = jnp.asarray(rng.randn(1, 100, 2, 64), jnp.float32)
+        mask = jnp.ones((1, 1, 100, 100))
+        got = np.asarray(fa(q, q, q, mask=mask))
+        want = np.asarray(attn_ops.dot_product_attention(q, q, q, mask=mask))
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+    def test_dispatch_through_flash_attention_entry(self):
+        """attention.flash_attention routes through the installed override."""
+        from deeplearning4j_tpu.ops import attention as attn_ops
+        from deeplearning4j_tpu.ops import pallas_kernels as pk
+        q, k, v = self._qkv(T=128)
+        pk.install_platform_overrides(interpret=True)
+        try:
+            got = np.asarray(attn_ops.flash_attention(q, k, v))
+        finally:
+            pk.uninstall_platform_overrides()
+        want = np.asarray(attn_ops.dot_product_attention(q, k, v))
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)

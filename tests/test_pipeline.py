@@ -1,0 +1,145 @@
+"""Multi-worker image pipeline: correctness of the shared-memory ring
+(ref test model: datavec-data-image record-reader round-trip tests +
+AsyncDataSetIterator ordering tests, SURVEY.md §4)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.pipeline import (MultiWorkerImageIterator,
+                                              _decode_one)
+
+pytestmark = pytest.mark.quick
+
+
+@pytest.fixture(scope="module")
+def image_root(tmp_path_factory):
+    """37 tiny JPEGs across 3 class dirs (non-divisible by batch size)."""
+    from PIL import Image
+    root = tmp_path_factory.mktemp("imgs")
+    rng = np.random.RandomState(0)
+    n = 0
+    for cls in ("ant", "bee", "cat"):
+        d = root / cls
+        d.mkdir()
+        for i in range(13 if cls != "cat" else 11):
+            arr = rng.randint(0, 255, (32, 32, 3), dtype=np.uint8)
+            Image.fromarray(arr).save(d / f"{i}.jpg", quality=90)
+            n += 1
+    assert n == 37
+    return str(root)
+
+
+def _reference_pairs(root, h, w):
+    """Single-threaded decode of every file -> {(label, checksum)}."""
+    out = []
+    for cls in sorted(os.listdir(root)):
+        for f in sorted(os.listdir(os.path.join(root, cls))):
+            img = _decode_one(os.path.join(root, cls, f), h, w, 3)
+            out.append((cls, int(img.astype(np.int64).sum())))
+    return sorted(out)
+
+
+class TestMultiWorkerPipeline:
+    def test_full_epoch_matches_single_thread(self, image_root):
+        it = MultiWorkerImageIterator(image_root, 16, 16, batch_size=8,
+                                      workers=2, drop_last=False)
+        try:
+            got = []
+            while it.hasNext():
+                ds = it.next()
+                assert ds.features.dtype == np.uint8
+                assert ds.features.shape[1:] == (3, 16, 16)
+                for r in range(ds.features.shape[0]):
+                    lab = it.labels[int(np.argmax(ds.labels[r]))]
+                    got.append((lab,
+                                int(ds.features[r].astype(np.int64).sum())))
+            assert sorted(got) == _reference_pairs(image_root, 16, 16)
+        finally:
+            it.close()
+
+    def test_drop_last_and_second_epoch(self, image_root):
+        it = MultiWorkerImageIterator(image_root, 16, 16, batch_size=8,
+                                      workers=2, drop_last=True)
+        try:
+            n1 = sum(it.next().features.shape[0] for _ in
+                     iter(lambda: it.hasNext(), False))
+            assert n1 == 32            # 37 -> 4 full batches of 8
+            it.reset()
+            n2 = 0
+            while it.hasNext():
+                n2 += it.next().features.shape[0]
+            assert n2 == 32
+        finally:
+            it.close()
+
+    def test_mid_epoch_reset_recovers_all_batches(self, image_root):
+        it = MultiWorkerImageIterator(image_root, 16, 16, batch_size=8,
+                                      workers=2, drop_last=True)
+        try:
+            it.next()                  # consume one, then reset mid-epoch
+            it.reset()
+            n = 0
+            while it.hasNext():
+                n += it.next().features.shape[0]
+            assert n == 32
+        finally:
+            it.close()
+
+    def test_shuffle_changes_order_keeps_set(self, image_root):
+        def epoch_sums(shuffle):
+            it = MultiWorkerImageIterator(image_root, 16, 16, batch_size=8,
+                                          workers=1, shuffle=shuffle,
+                                          drop_last=False, seed=7)
+            try:
+                sums = []
+                while it.hasNext():
+                    ds = it.next()
+                    sums += [int(ds.features[r].astype(np.int64).sum())
+                             for r in range(ds.features.shape[0])]
+                return sums
+            finally:
+                it.close()
+        plain, shuf = epoch_sums(False), epoch_sums(True)
+        assert sorted(plain) == sorted(shuf)
+
+    def test_float32_mode_supports_host_normalizer(self, image_root):
+        from deeplearning4j_tpu.data.dataset import ImagePreProcessingScaler
+        it = MultiWorkerImageIterator(image_root, 16, 16, batch_size=8,
+                                      workers=1, dtype="float32")
+        it.setPreProcessor(ImagePreProcessingScaler())
+        try:
+            ds = it.next()
+            assert ds.features.dtype == np.float32
+            assert 0.0 <= ds.features.min() and ds.features.max() <= 1.0
+        finally:
+            it.close()
+
+    def test_uint8_batches_train_end_to_end(self, image_root):
+        """uint8 features cast on device inside the jitted step
+        (nn/layers.policy_cast) — both fp32 and bf16 policies."""
+        from deeplearning4j_tpu.nn.config import (InputType,
+                                                  NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.layers import (ConvolutionLayer,
+                                                  GlobalPoolingLayer,
+                                                  OutputLayer)
+        for dtype in ("float", "bfloat16"):
+            conf = (NeuralNetConfiguration.Builder().seed(0).dataType(dtype)
+                    .list()
+                    .layer(ConvolutionLayer(kernelSize=(3, 3), nOut=4,
+                                            activation="relu"))
+                    .layer(GlobalPoolingLayer())
+                    .layer(OutputLayer(nOut=3, lossFunction="mcxent",
+                                       activation="softmax"))
+                    .setInputType(InputType.convolutional(16, 16, 3))
+                    .build())
+            from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+            net = MultiLayerNetwork(conf).init()
+            it = MultiWorkerImageIterator(image_root, 16, 16, batch_size=8,
+                                          workers=1, drop_last=True)
+            try:
+                net.fit(it, epochs=1)
+                assert np.isfinite(net.score())
+            finally:
+                it.close()

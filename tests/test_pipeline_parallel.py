@@ -1,0 +1,113 @@
+"""Pipeline parallelism: pipelined execution must match single-device
+execution exactly — forward, loss, AND the updated parameters after one
+train step (SURVEY.md §2.3 PP row; VERDICT r4 #4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models import transformer as tfm
+from deeplearning4j_tpu.parallel.mesh import DeviceMesh
+from deeplearning4j_tpu.parallel import pipeline as pp
+from deeplearning4j_tpu.train import updaters
+
+pytestmark = pytest.mark.quick
+
+
+@pytest.fixture(scope="module")
+def devices8():
+    ds = jax.devices()
+    if len(ds) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return ds
+
+
+def _setup(n_layers=4):
+    cfg = tfm.TransformerConfig.tiny(dtype=jnp.float32, causal=True,
+                                     n_layers=n_layers)
+    params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    B, T = 8, 16
+    tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, T)), jnp.int32)
+    targets = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, T)), jnp.int32)
+    return cfg, params, tokens, targets
+
+
+class TestPipelineParallel:
+    def test_pipeline_loss_matches_single_device(self, devices8):
+        cfg, params, tokens, targets = _setup()
+        want = float(tfm.loss_fn(params, tokens, targets, cfg))
+        mesh = DeviceMesh(jax.sharding.Mesh(
+            np.asarray(devices8).reshape(2, 4), ("data", "pipe")))
+        pparams = pp.to_pipeline_params(params)
+        pparams = jax.tree_util.tree_map(
+            jax.device_put, pparams, pp.pipeline_param_shardings(cfg, mesh),
+            is_leaf=lambda x: isinstance(x, jax.Array))
+        with mesh.mesh:
+            got = float(pp.pipeline_loss_fn(pparams, tokens, targets, cfg,
+                                            mesh, n_micro=4))
+        np.testing.assert_allclose(got, want, rtol=2e-5)
+
+    def test_pipeline_train_step_matches_single_device(self, devices8):
+        cfg, params, tokens, targets = _setup()
+        updater = updaters.Adam(1e-2)
+
+        # single-device reference step on the SAME math (pipeline layout,
+        # trivial 1-stage pipe) vs a real 4-stage pipe
+        def run(mesh_shape, names, n_micro):
+            n = int(np.prod(mesh_shape))
+            mesh = DeviceMesh(jax.sharding.Mesh(
+                np.asarray(devices8[:n]).reshape(mesh_shape), names))
+            pparams = pp.to_pipeline_params(
+                jax.tree_util.tree_map(jnp.copy, params))
+            pparams = jax.tree_util.tree_map(
+                jax.device_put, pparams,
+                pp.pipeline_param_shardings(cfg, mesh),
+                is_leaf=lambda x: isinstance(x, jax.Array))
+            opt = jax.tree_util.tree_map(
+                lambda p: updater.init_state(p.astype(jnp.float32)), pparams,
+                is_leaf=lambda x: isinstance(x, jax.Array))
+            step = pp.make_pipeline_train_step(cfg, updater, mesh, n_micro)
+            with mesh.mesh:
+                new_p, _, _, loss = step(pparams, opt,
+                                         jnp.asarray(0, jnp.int32),
+                                         tokens, targets)
+            return float(loss), jax.device_get(new_p)
+
+        loss1, p1 = run((1, 1), ("data", "pipe"), 1)
+        loss4, p4 = run((2, 4), ("data", "pipe"), 4)
+        np.testing.assert_allclose(loss4, loss1, rtol=2e-5)
+        flat1 = jax.tree_util.tree_leaves(p1)
+        flat4 = jax.tree_util.tree_leaves(p4)
+        for a, b in zip(flat1, flat4):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_pipeline_vs_unpipelined_forward_math(self, devices8):
+        """The pipeline block math itself (stacked scan) must equal the
+        reference layer loop — catches drift between _block and
+        models.transformer.forward."""
+        cfg, params, tokens, targets = _setup(n_layers=2)
+        want = float(tfm.loss_fn(params, tokens, targets, cfg))
+        mesh = DeviceMesh(jax.sharding.Mesh(
+            np.asarray(devices8[:2]).reshape(1, 2), ("data", "pipe")))
+        pparams = pp.to_pipeline_params(params)
+        with mesh.mesh:
+            got = float(pp.pipeline_loss_fn(pparams, tokens, targets, cfg,
+                                            mesh, n_micro=2))
+        np.testing.assert_allclose(got, want, rtol=2e-5)
+
+    def test_microbatch_roundtrip_and_validation(self, devices8):
+        x = jnp.arange(24.0).reshape(8, 3)
+        m = pp.microbatch(x, 4)
+        assert m.shape == (4, 2, 3)
+        np.testing.assert_allclose(np.asarray(pp.unmicrobatch(m)),
+                                   np.asarray(x))
+        with pytest.raises(ValueError, match="not divisible"):
+            pp.microbatch(x, 3)
+        mesh = DeviceMesh(jax.sharding.Mesh(
+            np.asarray(devices8).reshape(1, 8), ("data", "pipe")))
+        with pytest.raises(ValueError, match="pipeline depth"):
+            pp.pipeline_apply(lambda p, a: a, jnp.zeros((8, 1)),
+                              jnp.zeros((2, 1, 4)), mesh)
