@@ -714,13 +714,54 @@ class SameDiff:
         phs = {k: jnp.asarray(v) for k, v in placeholders.items()}
         key = tuple(output_names), tuple(sorted((k, v.shape, str(v.dtype))
                                                 for k, v in phs.items())), train
+        if rng_key is None:
+            rng_key = jax.random.PRNGKey(self._step)
+        if getattr(self, "_exec_backend", "jax") == "native":
+            return self._exec_native(key, phs, output_names, train, rng_key)
         if key not in self._fn_cache:
             fn = self._build_fn(tuple(output_names))
             self._fn_cache[key] = jax.jit(fn, static_argnames=("train",))
-        if rng_key is None:
-            rng_key = jax.random.PRNGKey(self._step)
         return self._fn_cache[key](self._variables, self._constants, phs,
                                    rng_key, train=train)
+
+    # ------------------------------------------------------ native backend
+    def setExecBackend(self, backend: str):
+        """Execution backend for output()/eval: "jax" (default) or
+        "native" — the latter lowers the SAME traced program to StableHLO
+        and runs it through the C++ L0 runtime (native/pjrt_runtime.cc),
+        the reference's NativeOpExecutioner seam (SURVEY.md §2.1 row 1 /
+        §7 item 1). jax stays the tracer; the native client owns
+        compilation + buffers + execution."""
+        if backend not in ("jax", "native"):
+            raise ValueError(f"unknown backend '{backend}'")
+        self._exec_backend = backend
+        return self
+
+    def _exec_native(self, key, phs, output_names, train, rng_key):
+        from deeplearning4j_tpu.native import runtime as native_rt
+        cache = getattr(self, "_native_cache", None)
+        if cache is None:
+            cache = self._native_cache = {}
+        args = (self._variables, self._constants, phs, rng_key)
+        if key not in cache:
+            from deeplearning4j_tpu.utils.environment import Environment
+            fn = self._build_fn(tuple(output_names))
+            prec = ("float32"
+                    if Environment.get().matmul_precision == "float32"
+                    else "bfloat16")
+            # keep_unused: the XLA parameter list must match the flattened
+            # pytree order exactly, even for inputs the program ignores;
+            # default_matmul_precision: the env knob must govern the native
+            # executable too (the jax path may run on a different backend)
+            with jax.default_matmul_precision(prec):
+                lowered = jax.jit(fn, static_argnames=("train",),
+                                  keep_unused=True).lower(*args, train=train)
+            exe = native_rt.get_runtime().compile(lowered.as_text())
+            cache[key] = exe
+        flat = [np.asarray(x) for x in jax.tree_util.tree_leaves(args)]
+        outs = cache[key](*flat)
+        treedef = jax.tree_util.tree_structure({n: 0 for n in output_names})
+        return jax.tree_util.tree_unflatten(treedef, outs)
 
     def output(self, placeholders: Dict[str, Any], outputs: Sequence[str],
                train: bool = False) -> Dict[str, jax.Array]:

@@ -212,6 +212,20 @@ class NativeExecutable:
             self._h = None
 
 
+_SHARED_RUNTIME = None
+
+
+def get_runtime() -> "NativeRuntime":
+    """Process-wide shared client for framework execution paths (the
+    ``backend="native"`` seam in autodiff.samediff). Raises
+    NativeRuntimeError when the plugin/toolchain is unavailable — callers
+    surface that as "native backend not available here"."""
+    global _SHARED_RUNTIME
+    if _SHARED_RUNTIME is None:
+        _SHARED_RUNTIME = NativeRuntime.create()
+    return _SHARED_RUNTIME
+
+
 class NativeRuntime:
     """PJRT client owned by the native layer (ref: Nd4j backend init over
     NativeOps — SURVEY.md §2.1)."""

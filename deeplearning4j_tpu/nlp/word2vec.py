@@ -90,7 +90,13 @@ class Word2Vec:
                  negative=5, learning_rate=0.025, min_learning_rate=1e-4,
                  iterations=1, epochs=1, batch_size=512, seed=42,
                  elements_algo="skipgram", tokenizer: TokenizerFactory = None,
-                 sentence_iter=None):
+                 sentence_iter=None, mesh=None):
+        # mesh: shard syn0/syn1 over the mesh's ``model`` axis on the
+        # embedding dim (SURVEY §2.3 "sharded parameter server": the
+        # reference shards huge embeddings across its v1 PS; here GSPMD
+        # keeps each device holding a D/m column slice of both tables and
+        # psums the pair logits — no parameter-server code at all)
+        self.mesh = mesh
         self.layer_size = layer_size
         self.window = window_size
         self.min_word_frequency = min_word_frequency
@@ -129,6 +135,7 @@ class Word2Vec:
             return self
 
         def tokenizerFactory(self, tf): self._kw["tokenizer"] = tf; return self
+        def mesh(self, m): self._kw["mesh"] = m; return self
         def iterate(self, sentence_iter):
             self._kw["sentence_iter"] = sentence_iter
             return self
@@ -153,6 +160,11 @@ class Word2Vec:
         self.syn0 = jnp.asarray(
             (rng.rand(V, D).astype(np.float32) - 0.5) / D)
         self.syn1 = jnp.zeros((V, D), jnp.float32)
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            sh = NamedSharding(self.mesh.mesh, P(None, "model"))
+            self.syn0 = jax.device_put(self.syn0, sh)
+            self.syn1 = jax.device_put(self.syn1, sh)
 
         # unigram^0.75 negative-sampling distribution (reference's table)
         freq = np.asarray(self.vocab.counts, np.float64) ** 0.75

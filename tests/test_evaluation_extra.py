@@ -5,6 +5,8 @@ VERDICT r2 item 9)."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.quick
+
 from deeplearning4j_tpu.evaluation import (EvaluationCalibration, ROC,
                                            ROCBinary)
 

@@ -3,6 +3,8 @@
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.quick
+
 from deeplearning4j_tpu.linalg import DataType, NDArray, nd
 
 

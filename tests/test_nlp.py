@@ -107,6 +107,36 @@ class TestWord2Vec:
         shards = {s.device for s in m.syn0.addressable_shards}
         assert len(shards) == 8
 
+    def test_mesh_sharded_TRAINING_matches_replicated(self):
+        """fit() with embeddings dim-sharded over the model axis (VERDICT
+        r4 #9): same seed must give the same vectors as replicated
+        training, and the tables stay sharded through every update step."""
+        import jax
+        from deeplearning4j_tpu.parallel.mesh import DeviceMesh
+        sents, animals, tech = _corpus()
+
+        def build(mesh):
+            return (Word2Vec.Builder()
+                    .minWordFrequency(2).layerSize(32).windowSize(3)
+                    .negativeSample(4).learningRate(0.3).epochs(4)
+                    .batchSize(128).seed(11)
+                    .iterate(sents)
+                    .mesh(mesh)
+                    .build())
+
+        rep = build(None)
+        rep.fit()
+        mesh = DeviceMesh.create(data=1, model=8)
+        shd = build(mesh)
+        shd.fit()
+        # tables remained dim-sharded across the training steps
+        assert len({s.device for s in shd.syn0.addressable_shards}) == 8
+        np.testing.assert_allclose(np.asarray(shd.syn0), np.asarray(rep.syn0),
+                                   rtol=2e-4, atol=1e-5)
+        s_rep = rep.similarity("cat", "dog")
+        s_shd = shd.similarity("cat", "dog")
+        np.testing.assert_allclose(s_shd, s_rep, rtol=1e-3)
+
 
 class TestParagraphVectors:
     def test_doc_vectors_cluster_by_topic(self):

@@ -9,6 +9,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.quick
+
 from deeplearning4j_tpu.ops import pallas_kernels as pk
 from deeplearning4j_tpu.ops import registry
 

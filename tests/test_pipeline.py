@@ -10,9 +10,6 @@ import pytest
 from deeplearning4j_tpu.data.pipeline import (MultiWorkerImageIterator,
                                               _decode_one)
 
-pytestmark = pytest.mark.quick
-
-
 @pytest.fixture(scope="module")
 def image_root(tmp_path_factory):
     """37 tiny JPEGs across 3 class dirs (non-divisible by batch size)."""
@@ -42,6 +39,7 @@ def _reference_pairs(root, h, w):
 
 
 class TestMultiWorkerPipeline:
+    @pytest.mark.quick
     def test_full_epoch_matches_single_thread(self, image_root):
         it = MultiWorkerImageIterator(image_root, 16, 16, batch_size=8,
                                       workers=2, drop_last=False)
@@ -116,6 +114,7 @@ class TestMultiWorkerPipeline:
         finally:
             it.close()
 
+    @pytest.mark.quick
     def test_uint8_batches_train_end_to_end(self, image_root):
         """uint8 features cast on device inside the jitted step
         (nn/layers.policy_cast) — both fp32 and bf16 policies."""

@@ -11,6 +11,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.quick
+
 from deeplearning4j_tpu.autodiff import SameDiff, TrainingConfig
 from deeplearning4j_tpu.train import updaters
 

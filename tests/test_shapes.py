@@ -3,6 +3,8 @@ calculateOutputShape; SURVEY.md §2.1, VERDICT r3 #4)."""
 
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.quick
 import jax.numpy as jnp
 
 from deeplearning4j_tpu.ops import shapes as S
