@@ -856,6 +856,7 @@ def _b_matrix_set_diag(p):
 
 _BUILDERS["MatrixSetDiagV3"] = _BUILDERS["MatrixSetDiag"]
 _BUILDERS["MatrixDiagPartV3"] = _BUILDERS["MatrixDiagPart"]
+_BUILDERS["MatrixDiagV3"] = _BUILDERS["MatrixDiag"]
 
 
 @_b("BroadcastArgs")
@@ -1098,6 +1099,26 @@ def _m_diag_part_v3(ctx, node, ins):
     return {}, ins[:1], 1
 
 
+def _m_matrix_diag_v3(ctx, node, ins):
+    # inputs: (diagonal, k, num_rows, num_cols, padding_value) — main
+    # diagonal with default sizing/padding only; anything else must fail
+    # loudly rather than silently dropping the sizing inputs
+    if len(ins) > 1 and int(np.atleast_1d(ctx.const_of(ins[1]))[0]) != 0:
+        raise TFImportError("MatrixDiagV3 with k != 0 does not import")
+    if len(ins) > 2:
+        nr = int(np.atleast_1d(ctx.const_of(ins[2]))[0])
+        nc = int(np.atleast_1d(ctx.const_of(ins[3]))[0]) if len(ins) > 3 \
+            else -1
+        if nr != -1 or nc != -1:
+            raise TFImportError(
+                "MatrixDiagV3 with explicit num_rows/num_cols does not "
+                "import (square main-diagonal form only)")
+    if len(ins) > 4 and float(np.atleast_1d(ctx.const_of(ins[4]))[0]) != 0.0:
+        raise TFImportError(
+            "MatrixDiagV3 with non-zero padding_value does not import")
+    return {}, ins[:1], 1
+
+
 def _m_batch_to_space(ctx, node, ins):
     bs = [int(v) for v in np.atleast_1d(ctx.const_of(ins[1]))]
     crops = [[int(v) for v in row] for row in ctx.const_of(ins[2])]
@@ -1194,6 +1215,7 @@ _MAPPERS_R4 = {
     "MatrixBandPart": _m_band_part,
     "MatrixSetDiagV3": _m_set_diag_v3,
     "MatrixDiagPartV3": _m_diag_part_v3,
+    "MatrixDiagV3": _m_matrix_diag_v3,
     "BroadcastArgs": _passthrough(2),
     "DenseBincount": _m_bincount,
     "ScatterNd": _m_scatter_nd,

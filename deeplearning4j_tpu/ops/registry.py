@@ -898,6 +898,7 @@ def _mirror_pad(x, paddings, mode: str = "REFLECT"):
 # extension families (scatter_nd, ctc, updater ops, image extras, ...)
 # registered for side effects — keeps this module the single entry point
 from deeplearning4j_tpu.ops import registry_ext as _ext  # noqa: E402,F401
+from deeplearning4j_tpu.ops import registry_r5 as _r5  # noqa: E402,F401
 
 
 # meta info

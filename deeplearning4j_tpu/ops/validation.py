@@ -856,7 +856,9 @@ def _build_nn_cases() -> List[OpCase]:
 
 def all_cases() -> List[OpCase]:
     from deeplearning4j_tpu.ops.validation_ext import build_ext_cases
-    return _build_cases() + _build_nn_cases() + build_ext_cases()
+    from deeplearning4j_tpu.ops.validation_r5 import build_r5_cases
+    return _build_cases() + _build_nn_cases() + build_ext_cases() \
+        + build_r5_cases()
 
 
 # --------------------------------------------------------------------------

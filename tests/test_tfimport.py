@@ -690,3 +690,193 @@ class TestImportedGraphFinetune:
         losses = hist.lossCurve()
         assert losses[-1] < losses[0] * 0.7, losses[::10]
         assert np.isfinite(losses[-1])
+
+
+def _op_corpus():
+    """~65 single-op conformance graphs (VERDICT r4 #3: grow the stored
+    corpus toward the reference's golden-graph volume). Each entry:
+    (name, fn, specs, feeds)."""
+    rng = np.random.RandomState(99)
+    f32 = lambda *s: rng.randn(*s).astype(np.float32)
+    pos = lambda *s: (rng.rand(*s).astype(np.float32) + 0.5)
+    i32 = lambda lo, hi, *s: rng.randint(lo, hi, s).astype(np.int32)
+    S = tf.TensorSpec
+    C = []
+
+    def add(name, fn, specs, feeds):
+        C.append((name, fn, specs, feeds))
+
+    x34 = f32(3, 4)
+    for nm, tfn in [
+            ("abs", tf.abs), ("acos", lambda x: tf.acos(x * 0.3)),
+            ("acosh", lambda x: tf.acosh(x + 2.0)), ("asin", lambda x: tf.asin(x * 0.3)),
+            ("asinh", tf.asinh), ("atan", tf.atan), ("atanh", lambda x: tf.atanh(x * 0.3)),
+            ("ceil", tf.math.ceil), ("cos", tf.cos), ("cosh", tf.cosh),
+            ("digamma", lambda x: tf.math.digamma(tf.abs(x) + 1.0)),
+            ("erf", tf.math.erf), ("erfc", tf.math.erfc),
+            ("expm1", tf.math.expm1), ("floor", tf.floor),
+            ("inv", tf.math.reciprocal),
+            ("is_finite", lambda x: tf.cast(tf.math.is_finite(x), tf.float32)),
+            ("lgamma", lambda x: tf.math.lgamma(tf.abs(x) + 1.0)),
+            ("log1p", tf.math.log1p), ("neg", tf.negative),
+            ("rint", tf.math.rint), ("round", tf.round),
+            ("rsqrt", lambda x: tf.math.rsqrt(tf.abs(x) + 0.5)),
+            ("sign", tf.sign), ("sin", tf.sin), ("sinh", tf.sinh),
+            ("softplus", tf.math.softplus), ("softsign", tf.math.softsign),
+            ("tan", tf.tan), ("selu", tf.nn.selu), ("elu", tf.nn.elu),
+            ("leaky_relu", lambda x: tf.nn.leaky_relu(x, 0.1)),
+            ("sigmoid", tf.sigmoid),
+    ]:
+        add(nm, (lambda t: lambda x: t(x))(tfn), [S([3, 4], tf.float32)],
+            [x34])
+
+    for nm, tfn in [
+            ("atan2", tf.atan2), ("xdivy", tf.math.xdivy),
+            ("xlogy", lambda a, b: tf.math.xlogy(a, tf.abs(b) + 0.5)),
+            ("xlog1py", lambda a, b: tf.math.xlog1py(a, tf.abs(b))),
+            ("squared_difference", tf.math.squared_difference),
+            ("floordiv", lambda a, b: tf.math.floordiv(a, tf.abs(b) + 0.5)),
+            ("truncatemod", lambda a, b: tf.math.mod(tf.abs(a), tf.abs(b) + 0.5)),
+            ("div_no_nan", tf.math.divide_no_nan),
+            ("pow", lambda a, b: tf.pow(tf.abs(a) + 0.5, b)),
+            ("maximum", tf.maximum), ("minimum", tf.minimum),
+    ]:
+        add(nm, (lambda t: lambda a, b: t(a, b))(tfn),
+            [S([3, 4], tf.float32), S([3, 4], tf.float32)],
+            [f32(3, 4), f32(3, 4)])
+
+    for nm, tfn in [
+            ("igamma", tf.math.igamma), ("igammac", tf.math.igammac),
+            ("polygamma", lambda a, x: tf.math.polygamma(
+                tf.ones_like(a), tf.abs(x) + 0.5)),
+            ("zeta", lambda a, x: tf.math.zeta(tf.abs(a) + 2.0,
+                                               tf.abs(x) + 1.0)),
+    ]:
+        add(nm, (lambda t: lambda a, b: t(a, b))(tfn),
+            [S([3, 3], tf.float32), S([3, 3], tf.float32)],
+            [pos(3, 3), pos(3, 3)])
+
+    # reductions / argminmax / logic
+    add("reduce_all_any", lambda x: (
+        tf.cast(tf.reduce_all(x > -10.0, axis=1), tf.float32),
+        tf.cast(tf.reduce_any(x > 1.0, axis=1), tf.float32)),
+        [S([3, 4], tf.float32)], [x34])
+    add("argmax_argmin", lambda x: (tf.argmax(x, 1), tf.argmin(x, 1)),
+        [S([3, 4], tf.float32)], [x34])
+    add("reduce_prod_min_max", lambda x: (
+        tf.reduce_prod(x, 1), tf.reduce_min(x, 1), tf.reduce_max(x, 1)),
+        [S([3, 4], tf.float32)], [x34])
+    add("logical_ops", lambda x: tf.cast(
+        tf.logical_or(tf.logical_and(x > 0.0, x < 1.0),
+                      tf.logical_not(x > -1.0)), tf.float32),
+        [S([3, 4], tf.float32)], [x34])
+    add("cumsum_cumprod", lambda x: (tf.cumsum(x, 1),
+                                     tf.math.cumprod(x, 1)),
+        [S([3, 4], tf.float32)], [x34])
+    add("l2_loss", tf.nn.l2_loss, [S([3, 4], tf.float32)], [x34])
+
+    # shape / slicing / scatter
+    add("strided_slice", lambda x: x[1:, ::2], [S([3, 6], tf.float32)],
+        [f32(3, 6)])
+    add("slice_op", lambda x: tf.slice(x, [0, 1], [2, 3]),
+        [S([3, 6], tf.float32)], [f32(3, 6)])
+    add("tile_op", lambda x: tf.tile(x, [2, 3]), [S([2, 2], tf.float32)],
+        [f32(2, 2)])
+    add("reverse_v2", lambda x: tf.reverse(x, [1]), [S([3, 4], tf.float32)],
+        [x34])
+    add("roll_op", lambda x: tf.roll(x, 2, 1), [S([3, 6], tf.float32)],
+        [f32(3, 6)])
+    add("one_hot", lambda i: tf.one_hot(i, 5), [S([4], tf.int32)],
+        [i32(0, 5, 4)])
+    add("pack_unpack", lambda x: tf.stack(tf.unstack(x, axis=0)[::-1]),
+        [S([3, 4], tf.float32)], [x34])
+    add("split_concat", lambda x: tf.concat(tf.split(x, 2, axis=1)[::-1], 1),
+        [S([3, 4], tf.float32)], [x34])
+    add("gather_nd", lambda x: tf.gather_nd(x, [[0, 1], [2, 3]]),
+        [S([3, 4], tf.float32)], [x34])
+    add("tensor_scatter", lambda x: tf.tensor_scatter_nd_update(
+        x, [[0], [2]], tf.zeros([2, 4])), [S([3, 4], tf.float32)], [x34])
+    add("scatter_nd_op", lambda i: tf.scatter_nd(
+        tf.reshape(i, [-1, 1]), tf.ones([4, 2]), [6, 2]),
+        [S([4], tf.int32)], [i32(0, 6, 4)])
+    add("mirror_pad", lambda x: tf.pad(x, [[1, 1], [2, 2]], "REFLECT"),
+        [S([3, 4], tf.float32)], [x34])
+    add("pad_v2", lambda x: tf.pad(x, [[1, 0], [0, 2]],
+                                   constant_values=7.0),
+        [S([3, 4], tf.float32)], [x34])
+    add("sequence_ops", lambda x: tf.reverse_sequence(
+        x, [2, 3, 1], seq_axis=1), [S([3, 4], tf.float32)], [x34])
+    add("top_k", lambda x: tf.math.top_k(x, 2), [S([3, 6], tf.float32)],
+        [f32(3, 6)])
+    add("in_shape_ops", lambda x: (tf.reshape(
+        x, tf.concat([tf.shape(x)[:1], [-1]], 0)),
+        tf.cast(tf.size(x), tf.float32), tf.cast(tf.rank(x), tf.float32)),
+        [S([2, 3, 4], tf.float32)], [f32(2, 3, 4)])
+    add("broadcast_to_op", lambda x: tf.broadcast_to(x, [4, 3]),
+        [S([1, 3], tf.float32)], [f32(1, 3)])
+    add("invert_permutation", lambda p: tf.math.invert_permutation(p),
+        [S([5], tf.int32)], [np.asarray([2, 0, 1, 4, 3], np.int32)])
+
+    # segments
+    seg_ids = np.asarray([0, 0, 1, 2, 2], np.int32)
+    add("segment_sum_mean", lambda x: (
+        tf.math.segment_sum(x, seg_ids), tf.math.segment_mean(x, seg_ids)),
+        [S([5, 3], tf.float32)], [f32(5, 3)])
+    add("unsorted_segment", lambda x: tf.math.unsorted_segment_sum(
+        x, tf.constant([2, 0, 1, 0, 2]), 3),
+        [S([5, 3], tf.float32)], [f32(5, 3)])
+
+    # linalg
+    spd = f32(4, 4)
+    spd = spd @ spd.T + 4 * np.eye(4, dtype=np.float32)
+    add("cholesky_op", tf.linalg.cholesky, [S([4, 4], tf.float32)], [spd])
+    add("matrix_solve", lambda a: tf.linalg.solve(
+        tf.constant(spd), a), [S([4, 2], tf.float32)], [f32(4, 2)])
+    add("matrix_diag_ops", lambda x: (
+        tf.linalg.diag(x), tf.linalg.diag_part(tf.linalg.diag(x))),
+        [S([3], tf.float32)], [f32(3)])
+    add("band_part", lambda x: tf.linalg.band_part(x, 1, 1),
+        [S([4, 4], tf.float32)], [f32(4, 4)])
+    add("einsum_op", lambda a, b: tf.einsum("ij,jk->ik", a, b),
+        [S([3, 4], tf.float32), S([4, 5], tf.float32)],
+        [f32(3, 4), f32(4, 5)])
+
+    # nn
+    add("log_softmax", tf.nn.log_softmax, [S([3, 4], tf.float32)], [x34])
+    add("bias_add_nhwc", lambda x: tf.nn.bias_add(
+        x, tf.constant([1.0, -1.0], tf.float32)),
+        [S([2, 3, 3, 2], tf.float32)], [f32(2, 3, 3, 2)])
+    add("lrn_op", lambda x: tf.nn.local_response_normalization(
+        x, depth_radius=2), [S([1, 4, 4, 8], tf.float32)], [f32(1, 4, 4, 8)])
+    add("space_depth_ops", lambda x: tf.nn.depth_to_space(
+        tf.nn.space_to_depth(x, 2), 2), [S([1, 4, 4, 4], tf.float32)],
+        [f32(1, 4, 4, 4)])
+    add("dilation2d_op", lambda x: tf.nn.dilation2d(
+        x, tf.zeros([2, 2, 3]), [1, 1, 1, 1], "VALID", "NHWC",
+        [1, 1, 1, 1]), [S([1, 5, 5, 3], tf.float32)], [f32(1, 5, 5, 3)])
+    add("clip_by_value", lambda x: tf.clip_by_value(x, -0.5, 0.5),
+        [S([3, 4], tf.float32)], [x34])
+    add("select_v2", lambda x: tf.where(x > 0.0, x * 2.0, x - 1.0),
+        [S([3, 4], tf.float32)], [x34])
+    add("prevent_gradient_identity", lambda x: tf.identity(
+        tf.stop_gradient(x)) + 1.0, [S([3, 4], tf.float32)], [x34])
+
+    # image
+    add("adjust_contrast_v2_op", lambda x: tf.image.adjust_contrast(x, 1.7),
+        [S([1, 4, 4, 3], tf.float32)], [pos(1, 4, 4, 3)])
+    add("rgb_hsv_roundtrip", lambda x: tf.image.hsv_to_rgb(
+        tf.image.rgb_to_hsv(x)), [S([1, 4, 4, 3], tf.float32)],
+        [pos(1, 4, 4, 3) / 2.0])
+
+    # casts
+    add("cast_chain", lambda x: tf.cast(tf.cast(x, tf.int32), tf.float32),
+        [S([3, 4], tf.float32)], [x34 * 3.0])
+    return C
+
+
+class TestTFOpCorpus:
+    @pytest.mark.parametrize(
+        "name,fn,specs,feeds",
+        [pytest.param(*e, id=e[0]) for e in _op_corpus()])
+    def test_op_conformance(self, name, fn, specs, feeds):
+        _conform(fn, *specs, feeds=feeds, fixture=f"op_{name}")
