@@ -1,0 +1,110 @@
+"""Interop runtimes — run foreign graphs with their OWN engines.
+
+Reference parity: ``nd4j-tensorflow`` ``GraphRunner`` (executes a frozen
+TF GraphDef through libtensorflow) and ``nd4j-onnxruntime``
+``OnnxRuntimeRunner`` (SURVEY.md §2.2 "Interop runtimes" — the
+reference's escape hatch for graphs its importer cannot map, and the
+cross-check oracle its conformance tests lean on).
+
+TPU-native stance: the importer (``modelimport.tensorflow`` / ``.onnx``)
+is the primary path — it compiles the graph to XLA. These runners exist
+for (a) graphs with unmapped ops, (b) golden-value cross-checking
+against the source framework, matching how the reference uses them.
+Each runner is gated on its engine being importable and raises a clear
+error otherwise (onnxruntime is not in this image; TF is).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+class GraphRunnerError(RuntimeError):
+    pass
+
+
+class GraphRunner:
+    """Run a frozen TF GraphDef with TensorFlow itself
+    (ref: org.nd4j.tensorflow.conversion.graphrunner.GraphRunner).
+
+    ``run`` takes/returns numpy arrays keyed by tensor names — the same
+    contract as the reference (which moves INDArray <-> TF_Tensor)."""
+
+    def __init__(self, graph_def=None, path: str = None,
+                 input_names: Sequence[str] = None,
+                 output_names: Sequence[str] = None):
+        try:
+            import tensorflow as tf
+        except ImportError as e:
+            raise GraphRunnerError(
+                "GraphRunner needs tensorflow (the reference's "
+                "nd4j-tensorflow needs libtensorflow the same way); it is "
+                "not importable here") from e
+        self._tf = tf
+        if graph_def is None:
+            if path is None:
+                raise ValueError("need graph_def or path")
+            from tensorflow.core.framework import graph_pb2
+            gd = graph_pb2.GraphDef()
+            with open(path, "rb") as f:
+                gd.ParseFromString(f.read())
+            graph_def = gd
+        self.graph_def = graph_def
+        self.input_names = list(input_names) if input_names else \
+            [n.name for n in graph_def.node if n.op == "Placeholder"]
+        self.output_names = list(output_names) if output_names else None
+        # wrap the GraphDef into a callable concrete function
+        self._fn = None
+
+    def _build(self, out_names: Sequence[str]):
+        tf = self._tf
+        gd = self.graph_def
+
+        @tf.function
+        def runner(*args):
+            name_map = {f"{n}:0": a for n, a in zip(self.input_names, args)}
+            outs = tf.graph_util.import_graph_def(
+                gd, input_map=name_map,
+                return_elements=[f"{n}:0" for n in out_names])
+            return outs
+        return runner
+
+    def run(self, feeds: Dict[str, np.ndarray],
+            output_names: Sequence[str] = None) -> Dict[str, np.ndarray]:
+        out_names = list(output_names or self.output_names or [])
+        if not out_names:
+            raise ValueError("no output names given")
+        tf = self._tf
+        args = [tf.constant(feeds[n]) for n in self.input_names]
+        key = tuple(out_names)
+        if self._fn is None or self._fn[0] != key:
+            self._fn = (key, self._build(out_names))
+        res = self._fn[1](*args)
+        if not isinstance(res, (list, tuple)):
+            res = [res]
+        return {n: np.asarray(r) for n, r in zip(out_names, res)}
+
+
+class OnnxRuntimeRunner:
+    """Run an ONNX model through onnxruntime
+    (ref: org.nd4j.onnxruntime.runner.OnnxRuntimeRunner)."""
+
+    def __init__(self, path: str):
+        try:
+            import onnxruntime  # noqa: F401
+        except ImportError as e:
+            raise GraphRunnerError(
+                "OnnxRuntimeRunner needs the onnxruntime package, which is "
+                "not available in this environment — use "
+                "modelimport.onnx.importOnnxModel (the XLA-compiling "
+                "importer) instead") from e
+        import onnxruntime as ort
+        self._sess = ort.InferenceSession(path)
+
+    def run(self, feeds: Dict[str, np.ndarray],
+            output_names: Sequence[str] = None) -> Dict[str, np.ndarray]:
+        outs = self._sess.run(output_names, feeds)
+        names = output_names or [o.name for o in self._sess.get_outputs()]
+        return {n: np.asarray(r) for n, r in zip(names, outs)}

@@ -1329,8 +1329,20 @@ class TFGraphImport:
                    for f in graph_def.library.function} \
             if graph_def.HasField("library") else {}
         ctx = _Ctx(sd, library)
-        for node in graph_def.node:
-            _import_one(ctx, node, _var_name)
+        nodes = list(graph_def.node)
+        if any(n.op in _V1_CF_OPS for n in nodes):
+            nodes = _topo_sort(nodes)
+            skip, plans = _plan_deframe(nodes)
+            # frame-collapsed order: every frame imports as ONE unit, after
+            # all its outer inputs and before every consumer of its Exits
+            for item in _collapsed_order(nodes, plans):
+                if isinstance(item, str):
+                    _apply_deframe_plan(ctx, plans[item])
+                elif item.name not in skip:
+                    _import_one(ctx, item, _var_name)
+        else:
+            for node in nodes:
+                _import_one(ctx, node, _var_name)
         return sd
 
 
@@ -1438,6 +1450,285 @@ _BUILDERS["StatelessIf"] = lambda p: _sdmod._make_subcond_fn(
 _BUILDERS["If"] = _BUILDERS["StatelessIf"]
 _BUILDERS["PartitionedCall"] = lambda p: _sdmod._make_subcall_fn(p)
 _BUILDERS["StatefulPartitionedCall"] = _BUILDERS["PartitionedCall"]
+
+
+# ---------------------------------------------------- v1 frame deframing
+# The reference INTERPRETS Enter/Exit/Merge/Switch frames at runtime
+# (SURVEY.md §3.3). XLA cannot — TF's own XLA bridge refuses v1 frames —
+# so default-frozen graphs with loops are DEFRAMED here: each while frame
+# is reconstructed into functional cond/body subgraphs and imported
+# exactly like a StatelessWhile.
+
+_V1_CF_OPS = {"Enter", "Exit", "Merge", "Switch", "NextIteration",
+              "LoopCond"}
+
+
+def _topo_sort(nodes):
+    """Topological order by data edges (GraphDef order is NOT guaranteed
+    topological once the lowering pass has rewritten control flow; the
+    recorded SameDiff node order must be executable top-down). Merge's
+    NextIteration back-edge is ignored — it is the one legal cycle."""
+    by_name = {n.name: n for n in nodes}
+    indeg = {n.name: 0 for n in nodes}
+    consumers: Dict[str, List[str]] = {n.name: [] for n in nodes}
+    for n in nodes:
+        for ref in n.input:
+            if ref.startswith("^"):
+                continue
+            p = ref.split(":")[0]
+            if p in by_name and not (
+                    n.op == "Merge" and by_name[p].op == "NextIteration"):
+                indeg[n.name] += 1
+                consumers[p].append(n.name)
+    from collections import deque
+    q = deque(n.name for n in nodes if indeg[n.name] == 0)
+    out = []
+    while q:
+        name = q.popleft()
+        out.append(by_name[name])
+        for c in consumers[name]:
+            indeg[c] -= 1
+            if indeg[c] == 0:
+                q.append(c)
+    if len(out) != len(nodes):            # a real cycle: keep input order
+        return list(nodes)
+    return out
+
+
+def _collapsed_order(nodes, plans):
+    """Topological order with each frame collapsed to one super-node.
+    Yields NodeDefs and frame keys (strings)."""
+    member_of = {}
+    for key, plan in plans.items():
+        for m in plan["members"]:
+            member_of[m] = key
+    by_name = {n.name: n for n in nodes}
+    items = [n.name for n in nodes if n.name not in member_of] + list(plans)
+    indeg = {i: 0 for i in items}
+    consumers = {i: [] for i in items}
+
+    def item_of(name):
+        return member_of.get(name, name)
+
+    seen_edges = set()
+    for n in nodes:
+        dst = item_of(n.name)
+        for ref in n.input:
+            if ref.startswith("^"):      # control edges don't gate data
+                continue
+            p = ref.split(":")[0]
+            if p not in by_name:
+                continue
+            src = item_of(p)
+            if src == dst or (src, dst) in seen_edges:
+                continue
+            seen_edges.add((src, dst))
+            indeg[dst] += 1
+            consumers[src].append(dst)
+    from collections import deque
+    q = deque(i for i in items if indeg[i] == 0)
+    out = []
+    while q:
+        i = q.popleft()
+        out.append(i if i in plans else by_name[i])
+        for c in consumers[i]:
+            indeg[c] -= 1
+            if indeg[c] == 0:
+                q.append(c)
+    if len(out) != len(items):
+        raise TFImportError(
+            "cyclic dependency between v1 control-flow frames — re-export "
+            "with lower_control_flow=False")
+    return out
+
+
+def _plan_deframe(nodes):
+    """Group v1 control-flow nodes into while-frame plans.
+
+    Returns (skip: names the main loop must not import, plans: frame
+    key -> plan); the import loop runs frames via _collapsed_order."""
+    by_name = {n.name: n for n in nodes}
+    order = {n.name: i for i, n in enumerate(nodes)}
+
+    def producer(ref):
+        return by_name.get(ref.split(":")[0].lstrip("^"))
+
+    frames: Dict[str, List] = {}
+    for n in nodes:
+        if n.op == "Enter":
+            frames.setdefault(_attr(n, "frame_name"), []).append(n)
+    # Merge/Switch outside any while frame = the v1 tf.cond idiom
+    framed_merges = set()
+    for f, enters in frames.items():
+        for n in nodes:
+            if n.op == "Merge" and any(
+                    producer(i) in enters for i in n.input):
+                framed_merges.add(n.name)
+    framed_switches = set()
+    for f, enters in frames.items():
+        for n in nodes:
+            if n.op == "Switch" and any(
+                    producer(i) is not None
+                    and producer(i).name in framed_merges
+                    for i in n.input):
+                framed_switches.add(n.name)
+    for n in nodes:
+        if (n.op == "Merge" and n.name not in framed_merges) or (
+                n.op == "Switch" and n.name not in framed_switches):
+            raise TFImportError(
+                "v1 Switch/Merge conditional frames do not import "
+                "(XLA has no representation for them) — re-export "
+                "with lower_control_flow=False, which keeps "
+                "functional StatelessIf nodes")
+
+    skip, plans = set(), {}
+    for frame, enters in frames.items():
+        plan = _plan_one_frame(frame, enters, nodes, by_name, producer)
+        skip |= plan["members"]
+        plans[frame] = plan
+    return skip, plans
+
+
+def _plan_one_frame(frame, enters, nodes, by_name, producer):
+    merges = [n for n in nodes if n.op == "Merge"
+              and any(producer(i) in enters for i in n.input)]
+    loopconds = {producer(s.input[1]).name for s in nodes
+                 if s.op == "Switch"
+                 and producer(s.input[0]) in merges}
+    if len(loopconds) != 1:
+        raise TFImportError(
+            f"while frame '{frame}': expected one LoopCond, found "
+            f"{len(loopconds)} (nested/irregular frames do not import — "
+            f"re-export with lower_control_flow=False)")
+    loopcond = by_name[next(iter(loopconds))]
+
+    carries = []          # (enter, merge, switch, nextit, exit_or_None)
+    for m in merges:
+        enter = next(producer(i) for i in m.input
+                     if producer(i) in enters)
+        nextit = next((producer(i) for i in m.input
+                       if producer(i) is not None
+                       and producer(i).op == "NextIteration"), None)
+        switch = next((s for s in nodes if s.op == "Switch"
+                       and producer(s.input[0]) is m), None)
+        if nextit is None or switch is None:
+            raise TFImportError(
+                f"while frame '{frame}': irregular Merge "
+                f"'{m.name}' (no NextIteration/Switch pair)")
+        ex = next((e for e in nodes if e.op == "Exit"
+                   and producer(e.input[0]) is switch), None)
+        carries.append((enter, m, switch, nextit, ex))
+    const_enters = [e for e in enters if _attr(e, "is_constant", False)]
+
+    # interior sets: ancestors of the cond output / body outputs, stopping
+    # at the frame boundary (merges for cond, switch:1 for body)
+    def interior(seeds, stop_names):
+        seen, out = set(), set()
+        stack = [s.split(":")[0] for s in seeds]
+        while stack:
+            name = stack.pop()
+            if name in seen or name in stop_names:
+                continue
+            seen.add(name)
+            n = by_name.get(name)
+            if n is None:
+                continue
+            if n.op in _V1_CF_OPS:
+                if n in const_enters:
+                    continue          # invariant: resolved at build time
+                raise TFImportError(
+                    f"while frame '{frame}': nested v1 control flow does "
+                    f"not import — re-export with lower_control_flow=False")
+            out.add(name)
+            stack.extend(i.split(":")[0].lstrip("^") for i in n.input
+                         if not i.startswith("^"))
+        return out
+
+    merge_names = {c[1].name for c in carries}
+    switch_names = {c[2].name for c in carries}
+    cond_nodes = interior([loopcond.input[0]], merge_names)
+    body_nodes = interior([c[3].input[0] for c in carries], switch_names)
+    members = ({n.name for n in enters} | merge_names | switch_names
+               | {c[3].name for c in carries}
+               | {c[4].name for c in carries if c[4] is not None}
+               | {loopcond.name} | cond_nodes | body_nodes)
+    return {"frame": frame, "carries": carries, "loopcond": loopcond,
+            "cond_nodes": cond_nodes, "body_nodes": body_nodes,
+            "const_enters": const_enters, "members": members,
+            "nodes": nodes, "by_name": by_name}
+
+
+def _apply_deframe_plan(ctx: _Ctx, plan):
+    """Build cond/body subgraphs from the frame interior and record ONE
+    functional while node in place of the whole frame."""
+    carries = plan["carries"]
+    by_name = plan["by_name"]
+    base = f"{plan['frame']}_deframed"
+
+    # carry list: loop vars first, then invariants (is_constant Enters +
+    # any interior ref produced outside the frame) — same order in init/
+    # cond/body, with invariants carried through unchanged
+    invariants: List[str] = []          # outer refs, discovery order
+
+    def build_sub(node_names, boundary):
+        """Import a frame interior into a fresh subgraph. Invariant
+        placeholders are declared LATER (same order on both subs);
+        _record_fn only stores input names, so forward references to the
+        not-yet-declared ``inv{i}`` placeholders are fine."""
+        sub = SameDiff.create()
+        sctx = _Ctx(sub, ctx.library)
+        ph = {ref: f"carry{i}" for i, ref in enumerate(boundary)}
+        for i in range(len(boundary)):
+            sub.placeHolder(f"carry{i}", shape=None, dtype=np.float32)
+
+        def resolve(ref):
+            if ref in ph:
+                return ph[ref]
+            if ref.split(":")[0] in node_names:
+                return _var_name(ref)
+            # produced outside the frame: invariant carry
+            for e in plan["const_enters"]:
+                if ref.split(":")[0] == e.name:
+                    ref = e.input[0]
+                    break
+            if ref not in invariants:
+                invariants.append(ref)
+            return f"inv{invariants.index(ref)}"
+
+        ordered = [n for n in plan["nodes"] if n.name in node_names]
+        for n in ordered:
+            _import_one(sctx, n, resolve)
+        return sub, resolve
+
+    cond_boundary = [c[1].name for c in carries]
+    body_boundary = [f"{c[2].name}:1" for c in carries]
+    cond_sub, cond_resolve = build_sub(plan["cond_nodes"], cond_boundary)
+    cond_out = cond_resolve(plan["loopcond"].input[0])
+    body_sub, body_resolve = build_sub(plan["body_nodes"], body_boundary)
+    body_outs = [body_resolve(c[3].input[0]) for c in carries]
+
+    # invariants become trailing carries on BOTH subs, identical order
+    for i in range(len(invariants)):
+        iv = f"inv{i}"
+        cond_sub.placeHolder(iv, shape=None, dtype=np.float32)
+        body_sub.placeHolder(iv, shape=None, dtype=np.float32)
+        body_outs.append(iv)
+
+    params = {"cond": _sdmod.subgraph_spec(cond_sub, [cond_out]),
+              "body": _sdmod.subgraph_spec(body_sub, body_outs)}
+    init_refs = [_var_name(c[0].input[0]) for c in carries] \
+        + [_var_name(r) for r in invariants]
+    fn = _sdmod._make_subwhile_fn(params)
+    wrapped = (lambda _f: lambda *a, **kw: _f(*a))(fn)
+    n_out = len(init_refs)
+    ctx.sd._record_fn("tf.While", wrapped, init_refs, name=base,
+                      n_out=n_out, rebuild="tf",
+                      attrs={"tf_op": "While", "params": params})
+    # route each Exit node's name onto the matching while output
+    for i, c in enumerate(carries):
+        if c[4] is not None:
+            out_name = base if (i == 0 and n_out == 1) else f"{base}:{i}"
+            ctx.sd._rename(out_name, c[4].name)
 
 
 def _fold_output_size_ok(fn, ins: List[np.ndarray]) -> bool:

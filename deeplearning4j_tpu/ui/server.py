@@ -45,6 +45,9 @@ _PAGE = """<!DOCTYPE html>
 <div class="card"><h2>Score vs iteration</h2><svg id="score"></svg></div>
 <div class="card"><h2>Update:parameter ratio (log10) vs iteration</h2>
   <svg id="ratio"></svg></div>
+<div class="card"><h2>Parameter histograms (latest sampled iteration)</h2>
+  <div id="hists" class="meta">enable StatsListener(with_histograms=True)
+  to populate</div></div>
 <div class="card"><h2>Latest layer stats</h2><div id="layers"></div></div>
 <div class="card"><h2>Session</h2><div id="static" class="meta"></div></div>
 <script>
@@ -84,6 +87,24 @@ async function refresh(){
   const rser=Object.entries(mo.ratio_series).slice(0,8).map(([k,v])=>(
       {x:mo.iterations,y:v.map(r=>Math.log10(r+1e-12))}));
   line(rsvg,rser);
+  const hj=await (await fetch("/api/histograms?session="+encodeURIComponent(sess))).json();
+  const hd=document.getElementById("hists");
+  const hkeys=Object.keys(hj.hists).slice(0,6);
+  if(!hkeys.length){
+    hd.innerHTML="enable StatsListener(with_histograms=True) to populate";
+  } else {
+    hd.innerHTML=hkeys.map(k=>{
+      const h=hj.hists[k], W=280, H=80, n=h.counts.length;
+      const m=Math.max(...h.counts)||1;
+      const bars=h.counts.map((c,i)=>
+        `<rect x="${i*W/n}" y="${H-c/m*H}" width="${W/n-1}" `+
+        `height="${c/m*H}" fill="#1f77b4"/>`).join("");
+      return `<div style="display:inline-block;margin:4px">`+
+        `<div class="meta">${esc(k)} [${h.range[0].toPrecision(2)}, `+
+        `${h.range[1].toPrecision(2)}]</div>`+
+        `<svg viewBox="0 0 ${W} ${H}" style="width:${W}px;height:${H}px">`+
+        bars+`</svg></div>`;}).join("");
+  }
   let rows="<table><tr><th>layer/param</th><th>mean</th><th>std</th>"+
       "<th>norm</th><th>upd norm</th><th>upd ratio</th></tr>";
   for(const [k,v] of Object.entries(mo.latest))
@@ -179,6 +200,20 @@ class _Handler(BaseHTTPRequestHandler):
                 "ratio_series": ratio_series,
                 "latest": latest,
             })
+        if url.path == "/api/histograms":
+            # newest update carrying per-layer histograms (StatsListener
+            # with_histograms=True), ref: the reference UI's parameter /
+            # update histogram tab
+            ups = st.getAllUpdates(sid)
+            for u in reversed(ups):
+                layers = u.get("layers") or {}
+                hists = {k: {"counts": v["hist_counts"],
+                             "range": v["hist_range"]}
+                         for k, v in layers.items() if "hist_counts" in v}
+                if hists:
+                    return self._json({"iteration": u.get("iteration"),
+                                       "hists": hists})
+            return self._json({"iteration": None, "hists": {}})
         self._json({"error": "not found"}, 404)
 
 

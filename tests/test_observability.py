@@ -149,6 +149,26 @@ class TestUIServer:
         finally:
             server.stop()
 
+    def test_histograms_endpoint(self):
+        net, ds = _tiny_net_and_data()
+        st = InMemoryStatsStorage()
+        net.setListeners(StatsListener(st, frequency=1, session_id="ui2",
+                                       with_histograms=True, hist_bins=12))
+        net.fit(ds)
+        server = UIServer(port=0).attach(st)
+        try:
+            h = json.load(urllib.request.urlopen(
+                server.url + "api/histograms?session=ui2"))
+            assert h["iteration"] is not None and h["hists"]
+            first = next(iter(h["hists"].values()))
+            assert len(first["counts"]) == 12
+            assert len(first["range"]) == 2
+            # page renders the histogram card
+            page = urllib.request.urlopen(server.url).read().decode()
+            assert "Parameter histograms" in page
+        finally:
+            server.stop()
+
 
 class TestProfiling:
     def test_profiling_listener_writes_trace(self, tmp_path):

@@ -880,3 +880,107 @@ class TestTFOpCorpus:
         [pytest.param(*e, id=e[0]) for e in _op_corpus()])
     def test_op_conformance(self, name, fn, specs, feeds):
         _conform(fn, *specs, feeds=feeds, fixture=f"op_{name}")
+
+
+class TestTFv1FrameDeframing:
+    """Default-frozen graphs lower functional loops to v1 Enter/Exit/
+    Merge/Switch frames; the deframer reconstructs cond/body subgraphs
+    and imports them as one functional while (VERDICT r4 #2: 'the
+    frozen-graph Switch/Merge loop idiom')."""
+
+    def test_lowered_while_imports(self):
+        rng = np.random.RandomState(40)
+
+        def f(x):
+            return tf.while_loop(
+                lambda i, a: i < 3,
+                lambda i, a: (i + 1, a * 1.5 + tf.reduce_mean(a)),
+                [tf.constant(0), x])[1]
+        x = rng.randn(2, 2).astype(np.float32)
+        _conform(f, tf.TensorSpec([2, 2], tf.float32), feeds=[x],
+                 lower_cf=True)
+
+    def test_lowered_while_with_invariant_capture(self):
+        rng = np.random.RandomState(41)
+        w = tf.constant(rng.randn(3, 3).astype(np.float32) * 0.3)
+
+        def f(x):
+            # w enters the frame as a loop-invariant capture
+            return tf.while_loop(
+                lambda i, h: i < 4,
+                lambda i, h: (i + 1, tf.nn.tanh(tf.matmul(h, w))),
+                [tf.constant(0), x])[1]
+        x = rng.randn(2, 3).astype(np.float32)
+        _conform(f, tf.TensorSpec([2, 3], tf.float32), feeds=[x],
+                 lower_cf=True)
+
+    def test_lowered_while_roundtrips_save_load(self, tmp_path):
+        rng = np.random.RandomState(42)
+
+        def f(x):
+            return tf.while_loop(lambda i, a: i < 5,
+                                 lambda i, a: (i + 1, a * 1.1),
+                                 [tf.constant(0), x])[1]
+        conc = tf.function(f).get_concrete_function(
+            tf.TensorSpec([2, 2], tf.float32))
+        frozen = convert_variables_to_constants_v2(conc)
+        sd = importTensorflowGraph(frozen.graph.as_graph_def())
+        in_name = frozen.inputs[0].name.split(":")[0]
+        out_name = frozen.outputs[0].name.split(":")[0]
+        x = rng.randn(2, 2).astype(np.float32)
+        want = np.asarray(sd.output({in_name: x}, [out_name])[out_name])
+        from deeplearning4j_tpu.autodiff.samediff import SameDiff
+        p = str(tmp_path / "v1while.sdz")
+        sd.save(p)
+        got = np.asarray(SameDiff.load(p).output(
+            {in_name: x}, [out_name])[out_name])
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_v1_cond_still_rejected_with_guidance(self):
+        def f(x):
+            return tf.cond(tf.reduce_sum(x) > 0.0, lambda: x * 2.0,
+                           lambda: -x)
+        conc = tf.function(f).get_concrete_function(
+            tf.TensorSpec([2, 2], tf.float32))
+        frozen = convert_variables_to_constants_v2(conc)  # lowers the If
+        with pytest.raises(TFImportError, match="lower_control_flow=False"):
+            importTensorflowGraph(frozen.graph.as_graph_def())
+
+
+class TestGraphRunnerInterop:
+    """Interop runtime (SURVEY §2.2 row 'Interop runtimes'): run a frozen
+    GraphDef with TF itself, cross-checked against our XLA import — the
+    reference's GraphRunner usage pattern."""
+
+    def test_graph_runner_matches_import(self):
+        from deeplearning4j_tpu.modelimport.interop import GraphRunner
+        rng = np.random.RandomState(50)
+        w = tf.constant(rng.randn(4, 3).astype(np.float32))
+
+        def f(x):
+            return tf.nn.softmax(tf.matmul(tf.nn.relu(x), w))
+        conc = tf.function(f).get_concrete_function(
+            tf.TensorSpec([2, 4], tf.float32))
+        frozen = convert_variables_to_constants_v2(conc)
+        gd = frozen.graph.as_graph_def()
+        x = rng.randn(2, 4).astype(np.float32)
+        in_name = frozen.inputs[0].name.split(":")[0]
+        out_name = frozen.outputs[0].name.split(":")[0]
+
+        runner = GraphRunner(gd, input_names=[in_name])
+        via_tf = runner.run({in_name: x}, [out_name])[out_name]
+
+        sd = importTensorflowGraph(gd)
+        via_xla = np.asarray(sd.output({in_name: x}, [out_name])[out_name])
+        np.testing.assert_allclose(via_xla, via_tf, rtol=1e-4, atol=1e-5)
+
+    def test_onnxruntime_runner_gated(self):
+        from deeplearning4j_tpu.modelimport.interop import (
+            GraphRunnerError, OnnxRuntimeRunner)
+        try:
+            import onnxruntime  # noqa: F401
+            pytest.skip("onnxruntime present; gate not exercised")
+        except ImportError:
+            pass
+        with pytest.raises(GraphRunnerError, match="onnxruntime"):
+            OnnxRuntimeRunner("/nonexistent.onnx")
