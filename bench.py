@@ -342,6 +342,18 @@ class DataPipelineBench:
             _decode_one(f, self.hw, self.hw, 3)
         self.decode_ms = (time.perf_counter() - t0) / 64 * 1e3
         self.cores = os.cpu_count() or 1
+        # measured host->device bandwidth for a FRESH batch-sized uint8
+        # buffer (fresh each rep: re-putting one buffer measures a cache,
+        # not the link) — on tunneled backends this, not decode, can bind
+        rng0 = np.random.RandomState(1)
+        reps = 3
+        bufs = [rng0.randint(0, 255, (self.batch, 3, self.hw, self.hw),
+                             dtype=np.uint8) for _ in range(reps)]
+        t0 = time.perf_counter()
+        for buf in bufs:
+            jax.device_put(buf).block_until_ready()
+        dt = (time.perf_counter() - t0) / reps
+        self.h2d_mbps = self.batch * 3 * self.hw * self.hw / dt / 1e6
         self.net = zoo.ResNet50(num_classes=8,
                                 input_shape=(3, self.hw, self.hw),
                                 dtype="bfloat16").init()
@@ -362,11 +374,15 @@ class DataPipelineBench:
         float(self.net.score())      # device sync
         dt = time.perf_counter() - t0
         per_core = 1e3 / self.decode_ms
+        img_bytes = 3 * self.hw * self.hw
         return {"img_per_sec": round(n / dt, 2), "n_imgs": n,
                 "batch": self.batch, "hw": self.hw, "src_side": self.side,
                 "decode_ms_per_img_per_core": round(self.decode_ms, 3),
                 "host_cores": self.cores,
-                "host_bound_img_per_sec": round(per_core * self.cores, 1)}
+                "host_bound_img_per_sec": round(per_core * self.cores, 1),
+                "h2d_mb_per_sec": round(self.h2d_mbps, 1),
+                "h2d_bound_img_per_sec": round(
+                    self.h2d_mbps * 1e6 / img_bytes, 1)}
 
 
 def bench_dp_scaling(bert_1chip_samples_per_sec, quick: bool = False):

@@ -706,6 +706,34 @@ def _map_spatial_dropout(cfg) -> _Imported:
                      cfg["name"])
 
 
+def _map_group_norm(cfg) -> _Imported:
+    if cfg.get("axis", -1) not in (-1, 3):
+        raise KerasImportError(
+            f"GroupNormalization axis {cfg.get('axis')} unsupported "
+            f"(channels_last channel axis only)")
+    lay = L.GroupNorm(groups=int(cfg.get("groups", 32)),
+                      eps=float(cfg.get("epsilon", 1e-3)))
+
+    def fill(kw, pre_it):
+        n = lay.nIn
+        return {"gamma": jnp.asarray(kw.get("gamma",
+                                            np.ones(n, np.float32))),
+                "beta": jnp.asarray(kw.get("beta",
+                                           np.zeros(n, np.float32)))}, None
+    if not (cfg.get("center", True) or cfg.get("scale", True)):
+        fill = None      # weight-free layer: init gamma=1/beta=0 is exact
+    return _Imported(lay, cfg["name"], fill)
+
+
+def _map_unit_norm(cfg) -> _Imported:
+    ax = cfg.get("axis", -1)
+    if ax not in (-1,) and ax != [-1]:
+        raise KerasImportError(
+            f"UnitNormalization axis {ax} unsupported (last/channel axis "
+            f"only)")
+    return _Imported(L.UnitNormLayer(), cfg["name"])
+
+
 def _map_zero_padding3d(cfg) -> _Imported:
     return _Imported(L.ZeroPadding3DLayer(padding=cfg.get("padding", 1)),
                      cfg["name"])
@@ -736,6 +764,8 @@ _MAPPERS = {
     "GlobalMaxPooling3D": lambda c: _map_global_pool(c, "max"),
     "GlobalAveragePooling3D": lambda c: _map_global_pool(c, "avg"),
     "ActivityRegularization": _map_activity_regularization,
+    "GroupNormalization": _map_group_norm,
+    "UnitNormalization": _map_unit_norm,
     "Conv1D": _map_conv1d,
     "Conv2D": _map_conv2d,
     "DepthwiseConv2D": _map_depthwise_conv2d,

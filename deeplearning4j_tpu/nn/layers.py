@@ -1144,6 +1144,71 @@ class LayerNorm(Layer):
         return it
 
 
+class GroupNorm(Layer):
+    """Group normalization over channel groups (ref: the reference's
+    GroupNormalization keras-import target; layout [N, C, *spatial],
+    normalize within each of ``groups`` channel groups + spatial dims)."""
+
+    input_kind = None
+    has_params = True
+
+    def __init__(self, groups: int = 32, eps: float = 1e-3, **kw):
+        super().__init__(**kw)
+        self.groups = int(groups)
+        self.eps = eps
+
+    def infer_nin(self, it: InputType):
+        self.nIn = self.nOut = it.channels if it.kind in ("cnn", "cnn3d") \
+            else it.size if it.kind == "rnn" else it.arrayElementsPerExample()
+        if self.groups == -1:           # Keras shorthand: instance norm
+            self.groups = self.nIn
+        if self.groups < 1 or self.nIn % self.groups:
+            raise ValueError(f"GroupNorm: {self.nIn} channels not divisible "
+                             f"by {self.groups} groups")
+
+    def initialize(self, key):
+        return {"gamma": jnp.ones((self.nIn,), jnp.float32),
+                "beta": jnp.zeros((self.nIn,), jnp.float32)}, {}
+
+    def apply(self, params, state, x, train, key):
+        N, C = x.shape[0], x.shape[1]
+        G = self.groups
+        xg = x.reshape((N, G, C // G) + x.shape[2:]).astype(jnp.float32)
+        axes = tuple(range(2, xg.ndim))
+        m = jnp.mean(xg, axis=axes, keepdims=True)
+        v = jnp.mean(jnp.square(xg - m), axis=axes, keepdims=True)
+        y = ((xg - m) * jax.lax.rsqrt(v + self.eps)).reshape(x.shape)
+        shape = (1, C) + (1,) * (x.ndim - 2)
+        y = y * params["gamma"].reshape(shape) + params["beta"].reshape(shape)
+        return y.astype(x.dtype), state
+
+    def output_type(self, it: InputType) -> InputType:
+        return it
+
+
+class UnitNormLayer(Layer):
+    """L2-normalize the channel/feature axis (Keras UnitNormalization)."""
+
+    input_kind = None
+    has_params = False
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+
+    def infer_nin(self, it: InputType):
+        self.nIn = self.nOut = it.channels if it.kind in ("cnn", "cnn3d") \
+            else it.size if it.kind == "rnn" else it.arrayElementsPerExample()
+
+    def apply(self, params, state, x, train, key):
+        axis = 1 if x.ndim > 2 else -1
+        n = jnp.sqrt(jnp.sum(jnp.square(x.astype(jnp.float32)), axis=axis,
+                             keepdims=True))
+        return (x / jnp.maximum(n, 1e-12).astype(x.dtype)), state
+
+    def output_type(self, it: InputType) -> InputType:
+        return it
+
+
 class Permute(Layer):
     """ref: Keras Permute — reorder NON-batch axes (1-based dims)."""
 

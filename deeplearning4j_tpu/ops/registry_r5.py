@@ -489,3 +489,36 @@ def _pick_list(tl: TensorList, indices):
 @register("clone_list")
 def _clone_list(tl: TensorList):
     return TensorList(list(tl.arrays))
+
+
+# --------------------------------------------------- late-round-5 aliases
+# Remaining reference op NAMES that alias surfaces already implemented
+# (ref: libnd4j exposes these as distinct declarable-op names).
+
+register("biasadd", _get("bias_add"))
+register("norm1", _get("reduce_norm1"))
+register("norm2", _get("reduce_norm2"))
+register("normmax", _get("reduce_norm_max"))
+register("shift_bits", _get("left_shift"))
+register("rshift_bits", _get("right_shift"))
+register("solve_ls", _get("lstsq"))
+register("static_bidirectional_rnn", _get("bidirectional_rnn"))
+register("dynamic_bidirectional_rnn", _get("bidirectional_rnn"))
+register("softmax_cross_entropy_loss_with_logits",
+         _get("softmax_cross_entropy_loss"))
+register("sigmoid_cross_entropy_loss_with_logits",
+         _get("sigmoid_cross_entropy_loss"))
+
+
+@register("check_numerics")
+def _check_numerics(x, message: str = ""):
+    """ref/TF: CheckNumerics — identity that fails on NaN/Inf. Eager calls
+    raise; under tracing it is a pass-through (pair with the
+    DL4J_TPU_NAN_PANIC executioner mode for in-graph checking)."""
+    x = jnp.asarray(x)
+    if not isinstance(x, jax.core.Tracer) and jnp.issubdtype(
+            x.dtype, jnp.floating):
+        if not bool(jnp.all(jnp.isfinite(x))):
+            raise FloatingPointError(
+                f"check_numerics: NaN/Inf detected. {message}")
+    return x

@@ -318,4 +318,43 @@ def build_r5_cases() -> List[OpCase]:
                                      rng.randn(2, 4).astype(np.float32)))
     add("clone_list", lambda rng: (_mk_list(rng),))
 
+    # late-r5 aliases: pinned to their primary op's behavior with one
+    # direct case each (the primaries carry the full goldens)
+    add("biasadd", lambda rng: (rng.randn(3, 4).astype(np.float32),
+                                rng.randn(4).astype(np.float32)),
+        golden=lambda x, b: x + b)
+    add("norm1", _r(3, 4), golden=lambda x: np.abs(x).sum())
+    add("norm2", _r(3, 4), golden=lambda x: np.sqrt((x ** 2).sum()),
+        rtol=1e-3)
+    add("normmax", _r(3, 4), golden=lambda x: np.abs(x).max())
+    add("shift_bits", lambda rng: (np.asarray([1, 2], np.int32), 2),
+        golden=np.left_shift)
+    add("rshift_bits", lambda rng: (np.asarray([8, 16], np.int32), 2),
+        golden=np.right_shift)
+    add("solve_ls", lambda rng: (rng.randn(5, 3).astype(np.float32),
+                                 rng.randn(5, 2).astype(np.float32)),
+        golden=lambda a, b: np.linalg.lstsq(
+            a.astype(np.float64), b.astype(np.float64), rcond=None)[0],
+        rtol=1e-2, atol=1e-3)
+
+    def bidir_args(rng):
+        T, N, C, H = 3, 2, 3, 4
+        mk = lambda *s: rng.randn(*s).astype(np.float32) * 0.3
+        return (mk(T, N, C), mk(C, H), mk(H, H), np.zeros(H, np.float32),
+                mk(C, H), mk(H, H), np.zeros(H, np.float32))
+    add("static_bidirectional_rnn", bidir_args,
+        note="alias of bidirectional_rnn (goldens there)")
+    add("dynamic_bidirectional_rnn", bidir_args,
+        note="alias of bidirectional_rnn (goldens there)")
+    add("softmax_cross_entropy_loss_with_logits",
+        lambda rng: (np.eye(3, dtype=np.float32)[[0, 2]],
+                     rng.randn(2, 3).astype(np.float32)),
+        note="alias of softmax_cross_entropy_loss")
+    add("sigmoid_cross_entropy_loss_with_logits",
+        lambda rng: (rng.randint(0, 2, (2, 3)).astype(np.float32),
+                     rng.randn(2, 3).astype(np.float32)),
+        note="alias of sigmoid_cross_entropy_loss")
+
+    add("check_numerics", _r(3, 4), golden=lambda x: x)
+
     return C

@@ -529,3 +529,31 @@ class TestR5Mappers:
         net = importKerasModelAndWeights(_save(tmp_path, m))
         got = np.asarray(net.output(x))
         np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_group_and_unit_normalization_parity(self, tmp_path):
+        m = keras.Sequential([
+            keras.Input(shape=(6, 6, 8)),
+            KL.GroupNormalization(groups=4, name="gn"),
+            KL.Conv2D(4, 3, name="c"),
+            KL.GlobalAveragePooling2D(name="gp"),
+            KL.UnitNormalization(name="un"),
+        ])
+        x = np.random.RandomState(11).randn(2, 6, 6, 8).astype(np.float32)
+        want = m.predict(x, verbose=0)
+        net = importKerasSequentialModelAndWeights(_save(tmp_path, m))
+        got = np.asarray(net.output(_nchw(x)))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_group_norm_instance_and_weightfree_variants(self, tmp_path):
+        m = keras.Sequential([
+            keras.Input(shape=(5, 5, 6)),
+            KL.GroupNormalization(groups=-1, name="inst"),     # instance norm
+            KL.GroupNormalization(groups=3, center=False, scale=False,
+                                  name="nw"),
+            KL.GlobalAveragePooling2D(name="gp"),
+        ])
+        x = np.random.RandomState(12).randn(2, 5, 5, 6).astype(np.float32)
+        want = m.predict(x, verbose=0)
+        net = importKerasSequentialModelAndWeights(_save(tmp_path, m))
+        got = np.asarray(net.output(_nchw(x)))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
