@@ -727,7 +727,7 @@ def _map_group_norm(cfg) -> _Imported:
 
 def _map_unit_norm(cfg) -> _Imported:
     ax = cfg.get("axis", -1)
-    if ax not in (-1,) and ax != [-1]:
+    if ax not in (-1, 3) and ax not in ([-1], [3]):
         raise KerasImportError(
             f"UnitNormalization axis {ax} unsupported (last/channel axis "
             f"only)")

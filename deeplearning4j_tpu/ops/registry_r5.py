@@ -522,3 +522,69 @@ def _check_numerics(x, message: str = ""):
             raise FloatingPointError(
                 f"check_numerics: NaN/Inf detected. {message}")
     return x
+
+
+# ----------------------------------------- gradient compression (row 11)
+# ref: libnd4j encode_threshold/decode_threshold + encode_bitmap/
+# decode_bitmap — the reference's gradient-sharing wire codecs
+# (EncodedGradientsAccumulator). Sync SPMD replaces the async sharing
+# loop (SURVEY §2.3), but the codecs themselves are part of the op
+# surface; static-shape forms here (XLA: the encoded buffer is
+# fixed-capacity, count returned alongside).
+
+@register("encode_threshold")
+def _encode_threshold(x, threshold: float, max_elements: Optional[int] = None):
+    """Values with |v| >= threshold -> (indices [K], signs [K], count),
+    compacted to the front and -1/0 padded; the residual (x minus what
+    was encoded) is returned too, like the reference's in-place update."""
+    x = jnp.asarray(x)
+    flat = x.ravel()
+    K = min(int(max_elements), flat.size) if max_elements is not None \
+        else flat.size
+    hit = jnp.abs(flat) >= threshold
+    order = jnp.argsort(~hit, stable=True)
+    count = jnp.minimum(jnp.sum(hit), K)
+    take = order[:K]
+    valid = jnp.arange(K) < count
+    idx = jnp.where(valid, take, -1).astype(jnp.int32)
+    signs = jnp.where(valid, jnp.sign(flat[take]), 0.0).astype(jnp.float32)
+    encoded_vals = jnp.zeros_like(flat).at[take].add(
+        jnp.where(valid, jnp.sign(flat[take]) * threshold, 0.0))
+    residual = (flat - encoded_vals).reshape(x.shape)
+    return idx, signs, count, residual
+
+
+@register("decode_threshold")
+def _decode_threshold(idx, signs, threshold: float, shape):
+    """Inverse of encode_threshold: scatter sign*threshold into zeros."""
+    shape = tuple(int(s) for s in shape)
+    n = int(np.prod(shape))
+    idx = jnp.asarray(idx, jnp.int32)
+    safe = jnp.where(idx >= 0, idx, 0)
+    vals = jnp.where(idx >= 0, jnp.asarray(signs, jnp.float32) * threshold,
+                     0.0)
+    return jnp.zeros((n,), jnp.float32).at[safe].add(vals).reshape(shape)
+
+
+@register("encode_bitmap")
+def _encode_bitmap(x, threshold: float):
+    """2-bit-per-element bitmap codec (ref: encode_bitmap): code 1 where
+    v >= t, 2 where v <= -t, 0 otherwise; returns (codes uint8 [n],
+    residual). The reference packs 16 codes/int32 on the wire; the code
+    array here is the unpacked semantic form."""
+    x = jnp.asarray(x)
+    flat = x.ravel()
+    codes = jnp.where(flat >= threshold, 1,
+                      jnp.where(flat <= -threshold, 2, 0)).astype(jnp.uint8)
+    encoded = jnp.where(codes == 1, threshold,
+                        jnp.where(codes == 2, -threshold, 0.0))
+    residual = (flat - encoded).reshape(x.shape)
+    return codes, residual
+
+
+@register("decode_bitmap")
+def _decode_bitmap(codes, threshold: float, shape):
+    codes = jnp.asarray(codes)
+    out = jnp.where(codes == 1, threshold,
+                    jnp.where(codes == 2, -threshold, 0.0))
+    return out.reshape(tuple(int(s) for s in shape)).astype(jnp.float32)

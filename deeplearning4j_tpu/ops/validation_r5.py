@@ -357,4 +357,40 @@ def build_r5_cases() -> List[OpCase]:
 
     add("check_numerics", _r(3, 4), golden=lambda x: x)
 
+    # ---- gradient-compression codecs (ref: threshold/bitmap encoding) ----
+    def thresh_golden(x, t):
+        """Round-trip semantic golden: decode(encode(x)) + residual == x
+        and the count matches; returns the op's own outputs on success."""
+        idx, signs, count, residual = [np.asarray(v) for v in R.get(
+            "encode_threshold")(x, t)]
+        dec = np.asarray(R.get("decode_threshold")(idx, signs, t, x.shape))
+        np.testing.assert_allclose(dec + residual, x, rtol=1e-5, atol=1e-6)
+        assert count == (np.abs(x) >= t).sum()
+        return idx, signs, count, residual
+    add("encode_threshold",
+        lambda rng: (rng.randn(4, 5).astype(np.float32), 1.0),
+        golden=thresh_golden)
+    add("encode_threshold",
+        lambda rng: (rng.randn(2, 2).astype(np.float32), 0.5),
+        kwargs={"max_elements": 9},
+        golden=lambda x, t, max_elements=None: thresh_golden(x, t),
+        note="max_elements larger than the tensor clamps, not crashes")
+    add("decode_threshold",
+        lambda rng: tuple(np.asarray(v) for v in R.get("encode_threshold")(
+            rng.randn(4, 5).astype(np.float32), 1.0)[:2]) + (1.0, (4, 5)),
+        golden=None,
+        note="semantics pinned by thresh_golden on the encode cases")
+    add("encode_bitmap",
+        lambda rng: (rng.randn(4, 5).astype(np.float32), 0.7),
+        golden=lambda x, t: (
+            np.where(x.ravel() >= t, 1,
+                     np.where(x.ravel() <= -t, 2, 0)).astype(np.uint8),
+            (x.ravel() - np.where(x.ravel() >= t, t,
+                                  np.where(x.ravel() <= -t, -t, 0.0))
+             ).reshape(x.shape)))
+    add("decode_bitmap",
+        lambda rng: (np.asarray([0, 1, 2, 1], np.uint8), 0.5, (2, 2)),
+        golden=lambda c, t, s: np.asarray([[0.0, 0.5], [-0.5, 0.5]],
+                                          np.float32))
+
     return C

@@ -197,11 +197,11 @@ class TestSequentialImport:
 
     def test_unsupported_layer_reported(self, tmp_path):
         m = keras.Sequential([
-            keras.Input(shape=(4,)),
-            KL.Dense(4, name="d1"),
-            KL.UnitNormalization(name="weird"),
+            keras.Input(shape=(8, 8, 3)),
+            KL.RandomRotation(0.2, name="weird"),   # preprocessing layer
+            KL.Conv2D(4, 3, name="c"),
         ])
-        with pytest.raises(KerasImportError, match="UnitNormalization"):
+        with pytest.raises(KerasImportError, match="RandomRotation"):
             importKerasSequentialModelAndWeights(_save(tmp_path, m))
 
 
