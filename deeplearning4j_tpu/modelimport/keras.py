@@ -734,6 +734,33 @@ def _map_unit_norm(cfg) -> _Imported:
     return _Imported(L.UnitNormLayer(), cfg["name"])
 
 
+def _map_conv_lstm2d(cfg) -> _Imported:
+    if _act(cfg.get("activation", "tanh")) != "tanh" or \
+            str(cfg.get("recurrent_activation", "sigmoid")) != "sigmoid":
+        raise KerasImportError(
+            "ConvLSTM2D imports with the default tanh/sigmoid activations "
+            "only")
+    if float(cfg.get("dropout", 0.0)) or float(
+            cfg.get("recurrent_dropout", 0.0)):
+        raise KerasImportError("ConvLSTM2D dropout variants do not import")
+    mode, _pad0 = _conv_mode(cfg.get("padding", "valid"))
+    lay = L.ConvLSTM2D(
+        nOut=int(cfg["filters"]), kernelSize=_pair(cfg["kernel_size"]),
+        stride=_pair(cfg.get("strides", 1)), convolutionMode=mode,
+        returnSequences=bool(cfg.get("return_sequences", False)))
+
+    def fill(kw, pre_it):
+        # keras kernel [kh, kw, cIn, 4*out] -> ours [4*out, cIn, kh, kw];
+        # recurrent_kernel [kh, kw, out, 4*out] -> [4*out, out, kh, kw]
+        params = {"W": jnp.asarray(kw["kernel"].transpose(3, 2, 0, 1)),
+                  "RW": jnp.asarray(
+                      kw["recurrent_kernel"].transpose(3, 2, 0, 1))}
+        if "bias" in kw:
+            params["b"] = jnp.asarray(kw["bias"])
+        return params, None
+    return _Imported(lay, cfg["name"], fill)
+
+
 def _map_zero_padding3d(cfg) -> _Imported:
     return _Imported(L.ZeroPadding3DLayer(padding=cfg.get("padding", 1)),
                      cfg["name"])
@@ -766,6 +793,7 @@ _MAPPERS = {
     "ActivityRegularization": _map_activity_regularization,
     "GroupNormalization": _map_group_norm,
     "UnitNormalization": _map_unit_norm,
+    "ConvLSTM2D": _map_conv_lstm2d,
     "Conv1D": _map_conv1d,
     "Conv2D": _map_conv2d,
     "DepthwiseConv2D": _map_depthwise_conv2d,

@@ -692,6 +692,80 @@ class GRU(Layer):
         return InputType.recurrent(self.nOut, it.dims.get("timesteps", -1))
 
 
+class ConvLSTM2D(Layer):
+    """Convolutional LSTM over image sequences (ref: the reference's
+    KerasConvLSTM2D import target). Input [N, C, T, H, W] (cnn3d layout,
+    depth = time); output [N, nOut, H', W'] (last state) or
+    [N, nOut, T, H', W'] with ``returnSequences``. Input convs use the
+    configured padding/stride; recurrent convs are SAME-padded on the
+    state grid (Keras semantics). Gate order [i, f, g, o]."""
+
+    input_kind = "cnn3d"
+
+    def __init__(self, nOut=None, kernelSize=(3, 3), stride=(1, 1),
+                 convolutionMode: str = "truncate",
+                 returnSequences: bool = False,
+                 forgetGateBiasInit: float = 1.0, **kw):
+        super().__init__(nOut=nOut, **kw)
+        self.kernel = _pair(kernelSize)
+        self.stride = _pair(stride)
+        self.mode = convolutionMode
+        self.return_sequences = returnSequences
+        self.forget_bias = forgetGateBiasInit
+
+    def infer_nin(self, it: InputType):
+        self.nIn = it.channels
+
+    def initialize(self, key):
+        k1, k2 = jax.random.split(key)
+        H = self.nOut
+        b = np.zeros((4 * H,), np.float32)
+        b[H:2 * H] = self.forget_bias
+        params = {
+            "W": _initialize((4 * H, self.nIn) + self.kernel,
+                             self.weight_init, k1),
+            "RW": _initialize((4 * H, H) + self.kernel,
+                              self.weight_init, k2),
+            "b": jnp.asarray(b),
+        }
+        return params, {}
+
+    def apply(self, params, state, x, train, key):
+        H = self.nOut
+        x_t = jnp.moveaxis(x, 2, 0)              # [T, N, C, H, W]
+        # hoist the time-parallel input convs out of the recurrence
+        T, N = x_t.shape[0], x_t.shape[1]
+        xg = conv_ops.conv2d(
+            x_t.reshape((T * N,) + x_t.shape[2:]), params["W"], params["b"],
+            stride=self.stride, pad=(0, 0), mode=self.mode)
+        xg = xg.reshape((T, N) + xg.shape[1:])   # [T, N, 4H, H', W']
+        sp = xg.shape[3:]
+
+        def step(carry, g_in):
+            h, c = carry
+            gates = g_in + conv_ops.conv2d(h, params["RW"], None,
+                                           stride=(1, 1), mode="same")
+            i, f, g, o = jnp.split(gates, 4, axis=1)
+            c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+            h = jax.nn.sigmoid(o) * jnp.tanh(c)
+            return (h, c), h
+
+        h0 = jnp.zeros((N, H) + sp, xg.dtype)
+        (_, _), hs = jax.lax.scan(step, (h0, h0), xg)
+        if self.return_sequences:
+            return jnp.moveaxis(hs, 0, 2), state  # [N, H, T, H', W']
+        return hs[-1], state
+
+    def output_type(self, it: InputType) -> InputType:
+        h = conv_ops.conv_output_size(it.height, self.kernel[0],
+                                      self.stride[0], 0, 1, self.mode)
+        w = conv_ops.conv_output_size(it.width, self.kernel[1],
+                                      self.stride[1], 0, 1, self.mode)
+        if self.return_sequences:
+            return InputType.convolutional3D(it.depth, h, w, self.nOut)
+        return InputType.convolutional(h, w, self.nOut)
+
+
 class Convolution1D(Layer):
     """ref: layers.convolution.Convolution1DLayer — input [N, nIn, T]
     (NCW), W [nOut, nIn, k]; supports causal mode like the reference."""

@@ -557,3 +557,30 @@ class TestR5Mappers:
         net = importKerasSequentialModelAndWeights(_save(tmp_path, m))
         got = np.asarray(net.output(_nchw(x)))
         np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_conv_lstm2d_parity(self, tmp_path):
+        m = keras.Sequential([
+            keras.Input(shape=(4, 8, 8, 2)),      # [T, H, W, C]
+            KL.ConvLSTM2D(3, 3, padding="same", return_sequences=False,
+                          name="cl"),
+            KL.GlobalAveragePooling2D(name="gp"),
+        ])
+        x = np.random.RandomState(13).randn(2, 4, 8, 8, 2).astype(np.float32)
+        want = m.predict(x, verbose=0)
+        net = importKerasSequentialModelAndWeights(_save(tmp_path, m))
+        # keras [N, T, H, W, C] -> ours [N, C, T, H, W]
+        got = np.asarray(net.output(np.transpose(x, (0, 4, 1, 2, 3))))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_conv_lstm2d_sequences_valid_padding(self, tmp_path):
+        m = keras.Sequential([
+            keras.Input(shape=(3, 6, 6, 2)),
+            KL.ConvLSTM2D(2, 3, padding="valid", return_sequences=True,
+                          name="cl"),
+            KL.GlobalAveragePooling3D(name="gp"),
+        ])
+        x = np.random.RandomState(14).randn(2, 3, 6, 6, 2).astype(np.float32)
+        want = m.predict(x, verbose=0)
+        net = importKerasSequentialModelAndWeights(_save(tmp_path, m))
+        got = np.asarray(net.output(np.transpose(x, (0, 4, 1, 2, 3))))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
