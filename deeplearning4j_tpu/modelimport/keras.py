@@ -736,13 +736,21 @@ def _map_unit_norm(cfg) -> _Imported:
 
 def _map_conv_lstm2d(cfg) -> _Imported:
     if _act(cfg.get("activation", "tanh")) != "tanh" or \
-            str(cfg.get("recurrent_activation", "sigmoid")) != "sigmoid":
+            _act(cfg.get("recurrent_activation", "sigmoid")) != "sigmoid":
         raise KerasImportError(
             "ConvLSTM2D imports with the default tanh/sigmoid activations "
             "only")
     if float(cfg.get("dropout", 0.0)) or float(
             cfg.get("recurrent_dropout", 0.0)):
         raise KerasImportError("ConvLSTM2D dropout variants do not import")
+    if str(cfg.get("data_format", "channels_last")) == "channels_first":
+        raise KerasImportError("channels_first Keras convs are not "
+                               "supported; save the model channels_last")
+    if _pair(cfg.get("dilation_rate", 1)) != (1, 1):
+        raise KerasImportError("dilated ConvLSTM2D does not import")
+    if cfg.get("go_backwards") or cfg.get("stateful"):
+        raise KerasImportError(
+            "ConvLSTM2D go_backwards/stateful variants do not import")
     mode, _pad0 = _conv_mode(cfg.get("padding", "valid"))
     lay = L.ConvLSTM2D(
         nOut=int(cfg["filters"]), kernelSize=_pair(cfg["kernel_size"]),

@@ -741,6 +741,8 @@ class ConvLSTM2D(Layer):
         xg = xg.reshape((T, N) + xg.shape[1:])   # [T, N, 4H, H', W']
         sp = xg.shape[3:]
 
+        ret_seq = self.return_sequences
+
         def step(carry, g_in):
             h, c = carry
             gates = g_in + conv_ops.conv2d(h, params["RW"], None,
@@ -748,13 +750,15 @@ class ConvLSTM2D(Layer):
             i, f, g, o = jnp.split(gates, 4, axis=1)
             c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
             h = jax.nn.sigmoid(o) * jnp.tanh(c)
-            return (h, c), h
+            # only stack per-step outputs when the caller wants sequences
+            # (a [T, N, H, H', W'] stack is T x the necessary memory)
+            return (h, c), (h if ret_seq else None)
 
         h0 = jnp.zeros((N, H) + sp, xg.dtype)
-        (_, _), hs = jax.lax.scan(step, (h0, h0), xg)
-        if self.return_sequences:
+        (h_last, _), hs = jax.lax.scan(step, (h0, h0), xg)
+        if ret_seq:
             return jnp.moveaxis(hs, 0, 2), state  # [N, H, T, H', W']
-        return hs[-1], state
+        return h_last, state
 
     def output_type(self, it: InputType) -> InputType:
         h = conv_ops.conv_output_size(it.height, self.kernel[0],
