@@ -428,6 +428,26 @@ def bench_dp_scaling(bert_1chip_samples_per_sec, quick: bool = False):
             "scaling_efficiency": round(eff, 4)}
 
 
+def _with_retries(fn, tag, retries=2):
+    """Retry transient tunnel/relay failures (remote_compile connection
+    drops, deadline blips) — one flaky HTTP read must not void a whole
+    bench run. Real errors re-raise immediately."""
+    for attempt in range(retries + 1):
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 — filtered below
+            msg = str(e)
+            transient = ("remote_compile" in msg or "read body" in msg
+                         or "DEADLINE" in msg.upper()
+                         or "UNAVAILABLE" in msg.upper())
+            if attempt == retries or not transient:
+                raise
+            print(f"# transient backend error in {tag} "
+                  f"(attempt {attempt + 1}/{retries + 1}): {msg[:120]} — "
+                  f"retrying", file=sys.stderr)
+            time.sleep(5)
+
+
 def _aggregate(draws, primary):
     """Median draw by the primary field + {median,min,max,n} spread."""
     vals = [d[primary] for d in draws]
@@ -466,12 +486,13 @@ def main(argv):
     # the largest activation set, measured to fit a 16 GB v5e. On a smaller
     # chip run subsets via the --skip-* flags.
     for b in benches:
-        b.setup()
+        _with_retries(b.setup, f"{b.name}.setup")
     # interleaved draws: round-robin so slow tunnel drift decorrelates
     # from any single metric
     for _ in range(reps):
         for b in benches:
-            draws[b.name].append(b.measure())
+            draws[b.name].append(_with_retries(b.measure,
+                                               f"{b.name}.measure"))
     for b in benches:
         detail[b.name] = _aggregate(draws[b.name], b.primary)
 
