@@ -1083,3 +1083,111 @@ def leverage(self) -> "NDArray":
 @_extend(NDArray)
 def migrate(self) -> "NDArray":
     return self
+
+
+# -- round-5 surface completion (ref: the remaining INDArray names) --
+@_extend(NDArray)
+def negative(self) -> "NDArray":
+    return NDArray(-self._value)
+
+
+@_extend(NDArray)
+def negativei(self) -> "NDArray":
+    return self._set_value(-self._value)
+
+
+@_extend(NDArray)
+def asum(self, *dims):
+    """ref: INDArray.asum — sum of absolute values."""
+    return self.norm1(*dims)
+
+
+@_extend(NDArray)
+def normmax(self, *dims):
+    return self.normMax(*dims)
+
+
+@_extend(NDArray)
+def normmaxNumber(self) -> float:
+    return float(jnp.max(jnp.abs(self._value)))
+
+
+@_extend(NDArray)
+def percentileNumber(self, q: float) -> float:
+    """ref: INDArray.percentileNumber(Number) — linear interpolation."""
+    return float(jnp.percentile(self._value.astype(jnp.float32), q))
+
+
+@_extend(NDArray)
+def cosineSim(self, other) -> float:
+    """ref: Transforms.cosineSim companion on the array surface."""
+    a = self._value.ravel().astype(jnp.float32)
+    b = _unwrap(other).ravel().astype(jnp.float32)
+    return float(jnp.dot(a, b)
+                 / jnp.maximum(jnp.linalg.norm(a) * jnp.linalg.norm(b),
+                               1e-12))
+
+
+@_extend(NDArray)
+def eps(self, other, eps_val: float = 1e-5) -> "NDArray":
+    """ref: INDArray.eps — elementwise |a-b| < eps mask."""
+    return NDArray(jnp.abs(self._value - _unwrap(other)) < eps_val)
+
+
+@_extend(NDArray)
+def epsi(self, other, eps_val: float = 1e-5) -> "NDArray":
+    return self._set_value(
+        (jnp.abs(self._value - _unwrap(other)) < eps_val)
+        .astype(self._value.dtype))
+
+
+@_extend(NDArray)
+def slice(self, i: int, dim: int = 0) -> "NDArray":
+    """ref: INDArray.slice(i[, dim]) — one hyperplane along ``dim``
+    (a VIEW in the reference; a value here — write-back views come from
+    getRow/getColumn/subArray)."""
+    return NDArray(jnp.take(self._value, i, axis=dim))
+
+
+@_extend(NDArray)
+def subArray(self, offsets, shape) -> "NDArray":
+    """ref: INDArray.subArray(offsets, shape, strides=1)."""
+    import builtins
+    idx = tuple(builtins.slice(int(o), int(o) + int(s))
+                for o, s in zip(offsets, shape))
+    return NDArray(self._value[idx])
+
+
+@_extend(NDArray)
+def tensorsAlongDimension(self, *dims) -> int:
+    """ref: INDArray.tensorsAlongDimension — how many sub-tensors the
+    dimension set yields."""
+    keep = int(np.prod([self._value.shape[d] for d in dims]))
+    return int(self._value.size // max(keep, 1))
+
+
+@_extend(NDArray)
+def vectorsAlongDimension(self, dim: int) -> int:
+    return int(self._value.size // max(self._value.shape[dim], 1))
+
+
+@_extend(NDArray)
+def sumAlongDimension(self, *dims) -> "NDArray":
+    return self.sum(*dims)
+
+
+@_extend(NDArray)
+def meanAlongDimension(self, *dims) -> "NDArray":
+    return self.mean(*dims)
+
+
+@_extend(NDArray)
+def cond(self, condition) -> "NDArray":
+    """ref: INDArray.cond(Condition) — 1/0 mask of elements matching."""
+    return NDArray(condition.mask(self._value).astype(jnp.float32))
+
+
+@_extend(NDArray)
+def close(self):
+    """ref: INDArray.close — buffer release is XLA's job; parity no-op."""
+    return None

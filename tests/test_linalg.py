@@ -419,3 +419,37 @@ class TestR4Surface:
         }
         missing = core - meths
         assert not missing, f"missing INDArray methods: {sorted(missing)}"
+
+
+class TestR5SurfaceCompletion:
+    """The last INDArray names (ref surface ~300): slices, eps masks,
+    along-dimension reducers, cond, percentile, cosineSim, negatives."""
+
+    def test_new_methods(self):
+        from deeplearning4j_tpu.linalg.conditions import Conditions
+        a = nd.create(np.asarray([[1., -2., 3.], [4., -5., 6.]], np.float32))
+        assert float(np.asarray(a.asum().numpy())) == 21.0
+        assert a.normmaxNumber() == 6.0
+        assert abs(a.percentileNumber(50) - 2.0) < 1e-5
+        b = nd.create(np.asarray([[1., -2., 3.], [4., -5., 6.]], np.float32))
+        assert a.cosineSim(b) > 0.999
+        assert bool(np.asarray(a.eps(b).numpy()).all())
+        np.testing.assert_allclose(np.asarray(a.slice(1).numpy()),
+                                   [4., -5., 6.])
+        np.testing.assert_allclose(np.asarray(a.slice(0, dim=1).numpy()),
+                                   [1., 4.])
+        assert a.subArray((0, 1), (2, 2)).shape == (2, 2)
+        assert a.tensorsAlongDimension(1) == 2
+        assert a.vectorsAlongDimension(0) == 3
+        m = a.cond(Conditions.greaterThan(0))
+        assert float(np.asarray(m.numpy()).sum()) == 4.0
+        assert float(np.asarray(a.negative().numpy())[0, 0]) == -1.0
+        a2 = nd.create(np.ones((2, 2), np.float32))
+        a2.negativei()
+        assert float(np.asarray(a2.numpy())[0, 0]) == -1.0
+        assert a.close() is None
+        np.testing.assert_allclose(
+            np.asarray(a.sumAlongDimension(0).numpy()), [5., -7., 9.])
+        np.testing.assert_allclose(
+            np.asarray(a.meanAlongDimension(1).numpy()),
+            [2 / 3, 5 / 3], rtol=1e-5)
