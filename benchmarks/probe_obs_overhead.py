@@ -1,0 +1,118 @@
+"""Probe: fit-loop overhead of the profiler subsystem (OFF vs BASIC).
+
+The profiler's contract is "near-zero cost when disabled" (ISSUE 1
+acceptance: <5% fit-loop overhead with profiling OFF vs the pre-profiler
+seed, proxied here by OFF vs BASIC+tracing on the same binary). The probe
+trains a tiny LeNet for a fixed number of iterations three ways:
+
+  off    — ProfilingMode.OFF, tracing disabled (the default ship state)
+  basic  — ProfilingMode.BASIC + span tracing: per-iteration step/data-wait
+           histograms and spans (what a perf investigation turns on)
+
+and prints ONE JSON line so BENCH rounds can track instrumentation cost
+over time:
+
+  {"probe": "obs_overhead", "off_sec_per_iter": ..., "basic_sec_per_iter":
+   ..., "overhead_ratio": ...}
+
+``overhead_ratio`` = basic/off - 1. The interesting regression signal is
+this ratio growing, not the absolute numbers (CPU-backend step times are
+not TPU step times).
+
+Run: python benchmarks/probe_obs_overhead.py [--iters N] [--warmup N]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def build():
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.models import zoo
+    net = zoo.LeNet(num_classes=3, input_shape=(1, 16, 16)).init()
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 16 * 16).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 8)]
+    return net, DataSet(x, y)
+
+
+def _set_mode(basic: bool):
+    from deeplearning4j_tpu import profiler
+    if basic:
+        profiler.set_profiling_mode(profiler.ProfilingMode.BASIC)
+        profiler.enable_tracing()
+    else:
+        profiler.set_profiling_mode(profiler.ProfilingMode.OFF)
+        profiler.disable_tracing()
+
+
+def _block(net, ds, iters: int) -> float:
+    net.score()                   # sync before starting the clock
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        net.fit(ds)
+    net.score()                   # sync before stopping it
+    return (time.perf_counter() - t0) / iters
+
+
+def run(iters: int, warmup: int, blocks: int) -> dict:
+    """Alternate OFF/BASIC measurement blocks on the same warm nets and
+    take the per-mode MEDIAN of block times: shared-host scheduler noise
+    swamps any back-to-back A/B comparison, and alternating short blocks
+    exposes both modes to the same noise distribution."""
+    from deeplearning4j_tpu import profiler
+    net_off, ds = build()
+    net_basic, _ = build()
+    try:
+        _set_mode(False)
+        for _ in range(warmup):
+            net_off.fit(ds)
+        _set_mode(True)
+        for _ in range(warmup):
+            net_basic.fit(ds)
+        per = max(1, iters // blocks)
+        t_off, t_basic = [], []
+        for _ in range(blocks):
+            _set_mode(False)
+            t_off.append(_block(net_off, ds, per))
+            _set_mode(True)
+            t_basic.append(_block(net_basic, ds, per))
+        t_off.sort()
+        t_basic.sort()
+        return {"off": t_off[len(t_off) // 2],
+                "basic": t_basic[len(t_basic) // 2]}
+    finally:
+        profiler.set_profiling_mode(None)
+        profiler.disable_tracing()
+        profiler.get_tracer().clear()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=300,
+                    help="total measured iterations per mode")
+    ap.add_argument("--warmup", type=int, default=20)
+    ap.add_argument("--blocks", type=int, default=10)
+    args = ap.parse_args()
+
+    res = run(args.iters, args.warmup, args.blocks)
+    off, basic = res["off"], res["basic"]
+    print(json.dumps({
+        "probe": "obs_overhead",
+        "iters": args.iters,
+        "off_sec_per_iter": round(off, 6),
+        "basic_sec_per_iter": round(basic, 6),
+        "overhead_ratio": round(basic / off - 1.0, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

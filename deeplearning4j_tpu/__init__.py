@@ -20,6 +20,10 @@ Top-level layout (mirrors the reference's layer map, SURVEY.md §1):
 - ``models``      — model zoo (ref: deeplearning4j-zoo)
 - ``modelimport`` — Keras h5 import (ref: deeplearning4j-modelimport)
 - ``ui``          — stats listeners/storage (ref: deeplearning4j-ui-parent)
+- ``profiler``    — span tracer (Chrome trace) + metrics registry
+                    (Prometheus) + ProfilingMode (ref: OpProfiler /
+                    OpExecutioner.ProfilingMode; served by ui at
+                    ``GET /trace`` and ``GET /metrics``)
 - ``utils``       — env/flag registry, common helpers (ref: nd4j-common)
 """
 

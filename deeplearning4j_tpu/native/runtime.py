@@ -23,9 +23,36 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
+import time
 from typing import List, Optional, Sequence
 
 import numpy as np
+
+from deeplearning4j_tpu import profiler as _prof
+
+# Registered at import so GET /metrics always exposes the compile-cache
+# and transfer counters (zero until the native path runs) — scrapers and
+# the bench harness can rely on the series existing.
+_REG = _prof.get_registry()
+_M_CACHE_HITS = _REG.counter(
+    "dl4j_native_compile_cache_hits_total",
+    "Native runtime executable-cache hits (dl4j_compile)")
+_M_CACHE_MISSES = _REG.counter(
+    "dl4j_native_compile_cache_misses_total",
+    "Native runtime executable-cache misses (fresh PJRT compilations)")
+_M_COMPILE_SECONDS = _REG.histogram(
+    "dl4j_native_compile_seconds",
+    "StableHLO -> PJRT LoadedExecutable compile latency",
+    buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0))
+_M_H2D_BYTES = _REG.counter(
+    "dl4j_native_h2d_bytes_total",
+    "Host->device bytes staged through dl4j_execute inputs")
+_M_D2H_BYTES = _REG.counter(
+    "dl4j_native_d2h_bytes_total",
+    "Device->host bytes returned from dl4j_execute outputs")
+_M_EXECUTE_SECONDS = _REG.histogram(
+    "dl4j_native_execute_seconds",
+    "Synchronous dl4j_execute round-trip latency (H2D + run + D2H)")
 
 _THIS_DIR = os.path.dirname(os.path.abspath(__file__))
 _LIB_PATH = os.path.join(_THIS_DIR, "libdl4j_tpu_native.so")
@@ -175,6 +202,8 @@ class NativeExecutable:
     def execute(self, *inputs, device: int = 0) -> List[np.ndarray]:
         arrs = [np.ascontiguousarray(np.asarray(a)) for a in inputs]
         n = len(arrs)
+        _t0 = time.perf_counter()
+        _M_H2D_BYTES.inc(sum(a.nbytes for a in arrs))
         data = (ctypes.c_void_p * n)(
             *[a.ctypes.data_as(ctypes.c_void_p) for a in arrs])
         dts = (ctypes.c_int32 * n)(*[_DTYPE_TO_PJRT[a.dtype] for a in arrs])
@@ -202,6 +231,14 @@ class NativeExecutable:
             results.append(np.frombuffer(buf, dtype=dt)[:int(np.prod(shape)) if shape else 1]
                            .reshape(shape).copy())
         _lib().dl4j_free_outputs(outs, rc)
+        _M_D2H_BYTES.inc(sum(r.nbytes for r in results))
+        dt = time.perf_counter() - _t0
+        _M_EXECUTE_SECONDS.observe(dt)
+        if _prof.tracing_enabled():
+            from deeplearning4j_tpu.profiler.tracer import _now_us
+            _prof.get_tracer().add_event(
+                "native:execute", _now_us() - dt * 1e6, dt * 1e6,
+                {"n_inputs": n, "n_outputs": rc})
         return results
 
     __call__ = execute
@@ -298,11 +335,20 @@ class NativeRuntime:
             else _default_compile_options()
         hit = ctypes.c_int(0)
         err = ctypes.create_string_buffer(4096)
-        h = _lib().dl4j_compile(self._h, program, len(program), fmt.encode(),
-                                opts, len(opts), ctypes.byref(hit), err,
-                                len(err))
+        with _prof.trace_span("native:compile", fmt=fmt,
+                              program_bytes=len(program)):
+            t0 = time.perf_counter()
+            h = _lib().dl4j_compile(self._h, program, len(program),
+                                    fmt.encode(), opts, len(opts),
+                                    ctypes.byref(hit), err, len(err))
+            dt = time.perf_counter() - t0
         if not h:
             raise NativeRuntimeError(err.value.decode() or "compile failed")
+        if hit.value:
+            _M_CACHE_HITS.inc()
+        else:
+            _M_CACHE_MISSES.inc()
+            _M_COMPILE_SECONDS.observe(dt)
         return NativeExecutable(self, h, bool(hit.value))
 
     def close(self):

@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu import profiler as _prof
 from deeplearning4j_tpu.data.dataset import DataSet, DataSetIterator, MultiDataSet
 from deeplearning4j_tpu.evaluation.evaluation import Evaluation
 from deeplearning4j_tpu.nn import layers as L
@@ -578,8 +579,10 @@ class ComputationGraph:
                 yield DataSet(np.asarray(data), np.asarray(labels))
 
         for _ in range(epochs):
-            for ds in batches():
-                self._fit_one(ds)
+            with _prof.trace_span("train:epoch", epoch=self._epoch):
+                # data-wait vs compute split (see MultiLayerNetwork.fit)
+                for ds in _prof.iter_with_data_wait(batches()):
+                    self._fit_one(ds)
             self._epoch += 1
             for lst in self._listeners:
                 if hasattr(lst, "onEpochEnd"):
@@ -607,9 +610,14 @@ class ComputationGraph:
                 # 1-based, matching iterationDone: hook pair refers to the
                 # same step number
                 lst.onIterationStart(self, self._iteration + 1)
-        self._params, self._states, self._opt_state, self._t_dev, loss = step(
-            self._params, self._states, self._opt_state, self._ensure_clock(),
-            ins, labels, lmasks if lmasks is not None else dummy)
+        with _prof.timed_region(
+                "train:step", "dl4j_train_step_seconds",
+                "Compiled train-step dispatch time per iteration",
+                iteration=self._iteration + 1):
+            self._params, self._states, self._opt_state, self._t_dev, loss = \
+                step(self._params, self._states, self._opt_state,
+                     self._ensure_clock(), ins, labels,
+                     lmasks if lmasks is not None else dummy)
         # on-device; score() converts lazily (per-step host sync is ~20x the
         # step cost through a high-latency device link)
         self._score = loss

@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu import profiler as _prof
 from deeplearning4j_tpu.data.dataset import DataSet, DataSetIterator
 from deeplearning4j_tpu.evaluation.evaluation import Evaluation, RegressionEvaluation
 from deeplearning4j_tpu.nn import layers as L
@@ -261,8 +262,12 @@ class MultiLayerNetwork:
                 yield DataSet(np.asarray(data), np.asarray(labels))
 
         for _ in range(epochs):
-            for ds in batches():
-                self._fit_one(ds)
+            with _prof.trace_span("train:epoch", epoch=self._epoch):
+                # data-wait vs compute split: time spent pulling the next
+                # batch from the (possibly async) iterator is the input
+                # pipeline's bill, not the device's
+                for ds in _prof.iter_with_data_wait(batches()):
+                    self._fit_one(ds)
             self._epoch += 1
             for lst in self._listeners:
                 if hasattr(lst, "onEpochEnd"):
@@ -287,11 +292,19 @@ class MultiLayerNetwork:
                 # 1-based, matching iterationDone: hook pair refers to the
                 # same step number
                 lst.onIterationStart(self, self._iteration + 1)
-        self._params, self._states, self._opt_state, self._t_dev, loss = step(
-            self._params, self._states, self._opt_state, self._ensure_clock(),
-            x, y,
-            fmask if fmask is not None else dummy,
-            lmask if lmask is not None else dummy)
+        # dispatch time of the compiled step (the loss stays on device;
+        # async backends overlap the actual compute with the next host
+        # iteration — the data_wait/step split still shows which side of
+        # the pipeline is the bottleneck)
+        with _prof.timed_region(
+                "train:step", "dl4j_train_step_seconds",
+                "Compiled train-step dispatch time per iteration",
+                iteration=self._iteration + 1):
+            self._params, self._states, self._opt_state, self._t_dev, loss = \
+                step(self._params, self._states, self._opt_state,
+                     self._ensure_clock(), x, y,
+                     fmask if fmask is not None else dummy,
+                     lmask if lmask is not None else dummy)
         # keep the loss on-device: a float() here would block on the whole
         # step through the (high-latency) host<->device link every iteration;
         # score() converts lazily when someone actually asks

@@ -21,11 +21,14 @@ The registry serves three purposes:
 
 from __future__ import annotations
 
+import time
 from typing import Callable, Dict, Optional
 
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+from deeplearning4j_tpu import profiler as _prof
 
 from deeplearning4j_tpu.ops import activations as _act
 from deeplearning4j_tpu.ops import attention as _attn
@@ -86,8 +89,69 @@ def all_ops():
 def exec_op(name: str, *args, **kwargs):
     """Eager single-op execution (ref: ``Nd4j.exec(DynamicCustomOp)`` →
     OpExecutioner → execCustomOp2). jax caches the per-shape compiled
-    program, so repeated eager calls don't recompile."""
-    return get(name)(*args, **kwargs)
+    program, so repeated eager calls don't recompile.
+
+    Dispatch is profiled per :class:`profiler.ProfilingMode` (ref:
+    OpExecutioner.ProfilingMode): BASIC adds per-op dispatch counts and
+    timing to the metrics registry (and a span when tracing is on);
+    NAN_PANIC/INF_PANIC additionally sync the outputs and raise
+    :class:`~deeplearning4j_tpu.utils.environment.NumericsPanicError` on
+    non-finite values. OFF is a single enum read over the bare call."""
+    fn = get(name)
+    mode = _prof.get_profiling_mode()
+    if mode is _prof.ProfilingMode.OFF and not _prof.tracing_enabled():
+        return fn(*args, **kwargs)
+    return _exec_instrumented(name, fn, mode, args, kwargs)
+
+
+def _op_dispatch_metrics():
+    reg = _prof.get_registry()
+    return (reg.counter("dl4j_op_dispatch_total",
+                        "Eager op dispatches through the registry",
+                        labelnames=("op",)),
+            reg.histogram("dl4j_op_dispatch_seconds",
+                          "Host-side dispatch latency per eager op "
+                          "(async backends: enqueue time, not device time)",
+                          labelnames=("op",)))
+
+
+def _exec_instrumented(name, fn, mode, args, kwargs):
+    tracer = _prof.get_tracer() if _prof.tracing_enabled() else None
+    token = tracer.begin(f"op:{name}") if tracer is not None else None
+    t0 = time.perf_counter()
+    try:
+        out = fn(*args, **kwargs)
+    finally:
+        dt = time.perf_counter() - t0
+        if token is not None:
+            tracer.end(token)
+    if mode is not _prof.ProfilingMode.OFF:
+        counts, lat = _op_dispatch_metrics()
+        counts.labels(op=name).inc()
+        lat.labels(op=name).observe(dt)
+    if mode in (_prof.ProfilingMode.NAN_PANIC, _prof.ProfilingMode.INF_PANIC):
+        _panic_scan(name, out, mode)
+    return out
+
+
+def _panic_scan(name, out, mode):
+    """Numerics gate on op outputs (ref: OpExecutioner NAN_PANIC/INF_PANIC).
+    Syncs each output to host — debug-mode semantics, off by default."""
+    import numpy as np
+    from deeplearning4j_tpu.utils.environment import NumericsPanicError
+    for leaf in jax.tree_util.tree_leaves(out):
+        try:
+            v = np.asarray(leaf)
+        except Exception:
+            continue
+        if not np.issubdtype(v.dtype, np.floating):
+            continue
+        if mode is _prof.ProfilingMode.NAN_PANIC and np.isnan(v).any():
+            raise NumericsPanicError(
+                f"NAN_PANIC: NaN detected in output of op '{name}'")
+        if mode is _prof.ProfilingMode.INF_PANIC and np.isinf(v).any():
+            raise NumericsPanicError(
+                f"INF_PANIC: Inf detected in output of op '{name}'")
 
 
 # ---------------------------------------------------------------------------

@@ -17,7 +17,15 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from deeplearning4j_tpu import profiler as _prof
 from deeplearning4j_tpu.data.dataset import DataSet, DataSetIterator
+
+# single family shared by every host->mesh staging site (wrapper batch
+# sharding, multi-host global views) — labelled by site
+SHARD_BYTES = _prof.get_registry().counter(
+    "dl4j_shard_transfer_bytes_total",
+    "Bytes staged host->mesh by batch sharding",
+    labelnames=("site",))
 
 
 class ShardedDataSetIterator(DataSetIterator):
@@ -54,11 +62,13 @@ class ShardedDataSetIterator(DataSetIterator):
         per = n // self.process_count
         lo = self.process_index * per
         hi = lo + per   # tail remainder dropped symmetrically on every rank
-        return self._apply_pre(DataSet(
-            self._slice(ds.features, lo, hi),
-            self._slice(ds.labels, lo, hi),
-            self._slice(ds.features_mask, lo, hi),
-            self._slice(ds.labels_mask, lo, hi)))
+        with _prof.trace_span("parallel:process_shard", rank=self.process_index,
+                              rows=per):
+            return self._apply_pre(DataSet(
+                self._slice(ds.features, lo, hi),
+                self._slice(ds.labels, lo, hi),
+                self._slice(ds.features_mask, lo, hi),
+                self._slice(ds.labels_mask, lo, hi)))
 
     def hasNext(self) -> bool:
         self._advance()
@@ -83,4 +93,9 @@ def make_global_view(local_array, mesh: Mesh, spec: P = None):
         spec = P("data")
     local = np.asarray(local_array)
     sharding = NamedSharding(mesh, spec)
+    if _prof.instrumentation_active():
+        SHARD_BYTES.labels(site="global_view").inc(local.nbytes)
+        with _prof.trace_span("parallel:make_global_view",
+                              bytes=int(local.nbytes)):
+            return jax.make_array_from_process_local_data(sharding, local)
     return jax.make_array_from_process_local_data(sharding, local)

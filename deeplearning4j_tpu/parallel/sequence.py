@@ -20,7 +20,10 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+try:                                    # jax <= 0.4.x
+    from jax.experimental.shard_map import shard_map
+except ImportError:                     # newer jax: promoted to top level
+    from jax import shard_map
 
 
 def _block_attend(q, kb, vb, q_off, k_off, is_causal, m, l, acc, scale):

@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu import profiler as _prof
 from deeplearning4j_tpu.data.dataset import (AsyncDataSetIterator, DataSet,
                                              DataSetIterator)
 from deeplearning4j_tpu.parallel.mesh import DeviceMesh
@@ -55,9 +56,11 @@ class ParallelWrapper:
         # replicate params/opt state once; batches are sharded per step
         with self.mesh:
             model._ensure_opt_state()
-            model._params = self.mesh.replicate(model._params)
-            model._states = self.mesh.replicate(model._states)
-            model._opt_state = self.mesh.replicate(model._opt_state)
+            with _prof.trace_span("collective:replicate_params",
+                                  devices=self.mesh.size("data")):
+                model._params = self.mesh.replicate(model._params)
+                model._states = self.mesh.replicate(model._states)
+                model._opt_state = self.mesh.replicate(model._opt_state)
             # reset the device-resident clock: a _t_dev committed to a single
             # device by a previous non-mesh fit() would make the jitted step
             # see incompatible devices; _ensure_clock rebuilds it (fresh,
@@ -74,6 +77,17 @@ class ParallelWrapper:
         return model
 
     def _shard(self, ds: DataSet) -> DataSet:
+        if _prof.instrumentation_active():
+            from deeplearning4j_tpu.parallel.data import SHARD_BYTES
+            nbytes = sum(int(np.asarray(a).nbytes)
+                         for a in (ds.features, ds.labels) if a is not None)
+            SHARD_BYTES.labels(site="wrapper").inc(nbytes)
+            with _prof.trace_span("parallel:shard_batch", bytes=nbytes,
+                                  devices=self.mesh.size("data")):
+                return self._shard_impl(ds)
+        return self._shard_impl(ds)
+
+    def _shard_impl(self, ds: DataSet) -> DataSet:
         n = self.mesh.size("data")
         b = ds.features.shape[0]
         if b % n != 0:

@@ -1,0 +1,120 @@
+"""Unified tracing + metrics subsystem.
+
+Two halves (SURVEY.md §5 "Tracing/profiling", "Metrics/logging"; the
+per-op timeline + counter-registry discipline of TensorFlow (Abadi et
+al., 2016) and TVM (Chen et al., 2018)):
+
+- :mod:`tracer` — a thread-safe span tracer: ``with trace_span("op:x")``
+  (also usable as a decorator), nestable, ring-buffer retention, Chrome
+  Trace Event Format export (Perfetto-loadable). Serves ``GET /trace``.
+- :mod:`metrics` — named counters / gauges / fixed-bucket histograms
+  with label support and Prometheus text exposition. Serves
+  ``GET /metrics``.
+
+Plus :mod:`modes` — the OpExecutioner-style :class:`ProfilingMode`
+(OFF/BASIC/NAN_PANIC/INF_PANIC) that gates per-op instrumentation and
+unifies the Environment numerics-panic knobs.
+
+Instrumented seams: ``ops.registry`` dispatch, ``native.runtime``
+(compile cache, H2D/D2H), ``parallel.{wrapper,data}`` (replication /
+shard transfers), the ``nn.{multilayer,graph}`` fit loops (step time,
+data-wait vs compute), and the listener bus (``MetricsListener``,
+``PerformanceListener``).
+
+Everything is near-zero-cost when disabled: one module-level flag / enum
+read before any span or sample is allocated.
+"""
+
+import time as _time
+
+from deeplearning4j_tpu.profiler.metrics import (Counter, Gauge, Histogram,
+                                                 MetricsRegistry,
+                                                 get_registry)
+from deeplearning4j_tpu.profiler.modes import (ProfilingMode,
+                                               get_profiling_mode,
+                                               set_profiling_mode)
+from deeplearning4j_tpu.profiler.tracer import (SpanTracer, disable_tracing,
+                                                enable_tracing, get_tracer,
+                                                now_us, trace_span,
+                                                tracing_enabled)
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_registry",
+    "ProfilingMode", "get_profiling_mode", "set_profiling_mode",
+    "SpanTracer", "trace_span", "get_tracer", "enable_tracing",
+    "disable_tracing", "tracing_enabled", "instrumentation_active",
+    "now_us", "observe_region", "timed_region", "iter_with_data_wait",
+]
+
+
+def instrumentation_active() -> bool:
+    """True when any framework instrumentation should record: tracing is
+    on or the profiling mode is not OFF. The fit loops check this once
+    per iteration so a disabled profiler costs one boolean + enum read."""
+    return tracing_enabled() or get_profiling_mode() is not ProfilingMode.OFF
+
+
+def observe_region(span_name: str, metric_name: str, help_text: str,
+                   started_us: float, seconds: float, **args) -> None:
+    """Record one already-measured region: a histogram sample in the
+    registry plus (when tracing) a span on the tracer timeline. The fit
+    loops use this for regions they time with a bare perf_counter so the
+    un-instrumented path stays allocation-free."""
+    get_registry().histogram(metric_name, help_text).observe(seconds)
+    if tracing_enabled():
+        get_tracer().add_event(span_name, started_us, seconds * 1e6,
+                               args or None)
+
+
+class timed_region:
+    """Context manager: time a region and feed it to :func:`observe_region`
+    (histogram sample + optional span). No-ops entirely when
+    instrumentation is inactive — the shared shape of the fit loops'
+    step-timing blocks."""
+
+    __slots__ = ("span_name", "metric_name", "help_text", "args", "_t0",
+                 "_t0u")
+
+    def __init__(self, span_name: str, metric_name: str, help_text: str,
+                 **args):
+        self.span_name = span_name
+        self.metric_name = metric_name
+        self.help_text = help_text
+        self.args = args
+        self._t0 = None
+
+    def __enter__(self):
+        if instrumentation_active():
+            self._t0u, self._t0 = now_us(), _time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if self._t0 is not None:
+            observe_region(self.span_name, self.metric_name, self.help_text,
+                           self._t0u, _time.perf_counter() - self._t0,
+                           **self.args)
+            self._t0 = None
+        return False
+
+
+_SENTINEL = object()
+
+
+def iter_with_data_wait(batches):
+    """Yield from ``batches`` measuring each pull as ``train:data_wait``
+    (histogram + span) — the data-wait half of the data-wait-vs-compute
+    split both fit loops report. The terminal pull (StopIteration) is not
+    recorded: it measures exhaustion, not a batch wait."""
+    it = iter(batches)
+    while True:
+        active = instrumentation_active()
+        if active:
+            t0u, t0 = now_us(), _time.perf_counter()
+        ds = next(it, _SENTINEL)
+        if ds is _SENTINEL:
+            return
+        if active:
+            observe_region("train:data_wait", "dl4j_train_data_wait_seconds",
+                           "Host wait for the next training batch", t0u,
+                           _time.perf_counter() - t0)
+        yield ds

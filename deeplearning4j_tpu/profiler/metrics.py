@@ -1,0 +1,315 @@
+"""Metrics registry: counters, gauges, fixed-bucket histograms with label
+support and Prometheus text-format exposition.
+
+Reference parity: the counter registries that TensorFlow and TVM treat as
+load-bearing runtime infrastructure (per-op counts, cache hit rates,
+transfer volumes) — the reference framework has no equivalent; its
+observability stops at the listener bus. Here every subsystem (op
+dispatch, native runtime, parallel, the fit loop) reports into ONE
+process-wide registry, and ``UIServer`` exposes it at ``GET /metrics`` in
+Prometheus text exposition format (v0.0.4) so the dashboard, the bench
+harness, and any external scraper agree on a single source of truth.
+
+Semantics follow prometheus_client (not imported — the environment is
+egress-free and the dependency is unnecessary):
+
+- ``Counter``: monotonically increasing; ``inc(v)`` with v >= 0.
+- ``Gauge``: ``set``/``inc``/``dec``.
+- ``Histogram``: fixed cumulative buckets chosen at creation, plus
+  ``_sum``/``_count`` series; ``observe(v)``.
+- Labels: declare ``labelnames`` at creation, then ``m.labels(op="add")``
+  returns (creating on first use) the child to operate on. A metric with
+  labelnames cannot be operated on directly; one without them can.
+
+All operations are thread-safe; hot-path cost is one lock + dict/float
+update.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from bisect import bisect_left
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+# latency-shaped default: 100us .. 10s (seconds)
+DEFAULT_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                   0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+def _escape_label(v: str) -> str:
+    return (str(v).replace("\\", r"\\").replace("\n", r"\n")
+            .replace('"', r'\"'))
+
+
+def _format_value(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    if v == -math.inf:
+        return "-Inf"
+    if isinstance(v, int) or (isinstance(v, float) and v.is_integer()
+                              and abs(v) < 1e15):
+        return str(int(v))
+    return repr(float(v))
+
+
+def _labels_str(names: Sequence[str], values: Sequence[str]) -> str:
+    if not names:
+        return ""
+    inner = ",".join(f'{n}="{_escape_label(v)}"'
+                     for n, v in zip(names, values))
+    return "{" + inner + "}"
+
+
+class _Metric:
+    """Base: a named family, optionally labelled (children per label set)."""
+
+    typ = "untyped"
+
+    def __init__(self, name: str, help: str = "",
+                 labelnames: Sequence[str] = ()):
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._lock = threading.Lock()
+        self._children: Dict[Tuple[str, ...], "_Metric"] = {}
+        if not self.labelnames:
+            self._init_value()
+
+    def _init_value(self):
+        raise NotImplementedError
+
+    def _child(self) -> "_Metric":
+        c = type(self)(self.name, self.help)
+        return c
+
+    def labels(self, *values, **kv) -> "_Metric":
+        if kv:
+            if values:
+                raise ValueError("pass label values positionally OR by name")
+            if set(kv) != set(self.labelnames):
+                raise ValueError(
+                    f"{self.name}: expected labels {self.labelnames}, "
+                    f"got {tuple(kv)}")
+            values = tuple(str(kv[n]) for n in self.labelnames)
+        else:
+            values = tuple(str(v) for v in values)
+        if len(values) != len(self.labelnames):
+            raise ValueError(
+                f"{self.name}: expected labels {self.labelnames}, "
+                f"got {values}")
+        with self._lock:
+            c = self._children.get(values)
+            if c is None:
+                c = self._children[values] = self._child()
+            return c
+
+    def _check_unlabelled(self):
+        if self.labelnames:
+            raise ValueError(
+                f"{self.name} declares labels {self.labelnames}; "
+                "use .labels(...) first")
+
+    def _samples(self) -> List[Tuple[str, Dict[str, str], float]]:
+        """[(suffix, extra_labels, value)] for one (child) metric — a
+        consistent snapshot taken under the metric's own lock (a scrape
+        racing observe() must never emit non-monotone histogram buckets)."""
+        raise NotImplementedError
+
+    def expose(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} {self.typ}"]
+        with self._lock:
+            items = (list(self._children.items()) if self.labelnames
+                     else [((), self)])
+        # child _samples() acquire their own lock — called OUTSIDE the
+        # family lock above (for an unlabelled family, child IS self)
+        for lvals, child in items:
+            for suffix, extra, value in child._samples():
+                names = list(self.labelnames) + list(extra)
+                vals = list(lvals) + [extra[k] for k in extra]
+                lines.append(f"{self.name}{suffix}"
+                             f"{_labels_str(names, vals)} "
+                             f"{_format_value(value)}")
+        return "\n".join(lines)
+
+
+class Counter(_Metric):
+    """Monotonic counter (ref: prometheus counter semantics)."""
+
+    typ = "counter"
+
+    def _init_value(self):
+        self._value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._check_unlabelled()
+        if amount < 0:
+            raise ValueError("counters can only increase")
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        self._check_unlabelled()
+        with self._lock:
+            return self._value
+
+    def _samples(self):
+        with self._lock:
+            return [("", {}, self._value)]
+
+
+class Gauge(_Metric):
+    """Settable instantaneous value."""
+
+    typ = "gauge"
+
+    def _init_value(self):
+        self._value = 0.0
+
+    def set(self, value: float) -> None:
+        self._check_unlabelled()
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._check_unlabelled()
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.inc(-amount)
+
+    @property
+    def value(self) -> float:
+        self._check_unlabelled()
+        with self._lock:
+            return self._value
+
+    def _samples(self):
+        with self._lock:
+            return [("", {}, self._value)]
+
+
+class Histogram(_Metric):
+    """Fixed-bucket cumulative histogram (+Inf bucket implicit)."""
+
+    typ = "histogram"
+
+    def __init__(self, name: str, help: str = "",
+                 labelnames: Sequence[str] = (),
+                 buckets: Sequence[float] = DEFAULT_BUCKETS):
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        if not self.buckets:
+            raise ValueError("histogram needs at least one bucket bound")
+        super().__init__(name, help, labelnames)
+
+    def _init_value(self):
+        self._counts = [0] * (len(self.buckets) + 1)   # last = +Inf
+        self._sum = 0.0
+        self._count = 0
+
+    def _child(self):
+        return Histogram(self.name, self.help, (), self.buckets)
+
+    def observe(self, value: float) -> None:
+        self._check_unlabelled()
+        value = float(value)
+        idx = bisect_left(self.buckets, value)
+        with self._lock:
+            self._counts[idx] += 1
+            self._sum += value
+            self._count += 1
+
+    @property
+    def count(self) -> int:
+        self._check_unlabelled()
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        self._check_unlabelled()
+        with self._lock:
+            return self._sum
+
+    def _samples(self):
+        with self._lock:
+            counts = list(self._counts)
+            total, s = self._count, self._sum
+        out = []
+        cum = 0
+        for bound, c in zip(self.buckets, counts):
+            cum += c
+            out.append(("_bucket", {"le": _format_value(bound)}, cum))
+        out.append(("_bucket", {"le": "+Inf"}, total))
+        out.append(("_sum", {}, s))
+        out.append(("_count", {}, total))
+        return out
+
+
+class MetricsRegistry:
+    """Named metric families with get-or-create semantics.
+
+    ``registry.counter(name, ...)`` returns the existing family when the
+    name is already registered (validating the type matches), so every
+    call site can declare the metrics it needs without coordination —
+    the same pattern as prometheus_client's default REGISTRY.
+    """
+
+    def __init__(self):
+        self._metrics: Dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+
+    def _get_or_create(self, cls, name, help, labelnames, **kw) -> _Metric:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if not isinstance(m, cls):
+                    raise ValueError(
+                        f"metric {name} already registered as {m.typ}")
+                return m
+            m = cls(name, help, labelnames, **kw)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name: str, help: str = "",
+                labelnames: Sequence[str] = ()) -> Counter:
+        return self._get_or_create(Counter, name, help, labelnames)
+
+    def gauge(self, name: str, help: str = "",
+              labelnames: Sequence[str] = ()) -> Gauge:
+        return self._get_or_create(Gauge, name, help, labelnames)
+
+    def histogram(self, name: str, help: str = "",
+                  labelnames: Sequence[str] = (),
+                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+        return self._get_or_create(Histogram, name, help, labelnames,
+                                   buckets=buckets)
+
+    def get(self, name: str) -> Optional[_Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._metrics)
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._metrics.pop(name, None)
+
+    def exposition(self) -> str:
+        """Prometheus text exposition format 0.0.4 (what /metrics serves)."""
+        with self._lock:
+            metrics = [self._metrics[n] for n in sorted(self._metrics)]
+        return "\n".join(m.expose() for m in metrics) + "\n" if metrics else ""
+
+
+_REGISTRY = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    """Process-wide registry singleton (what ``GET /metrics`` serves)."""
+    return _REGISTRY

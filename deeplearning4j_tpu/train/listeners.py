@@ -68,6 +68,17 @@ class PerformanceListener(TrainingListener):
                 if self.report_batch and self._samples:
                     msg += f", {self.samples_per_sec:.1f} samples/sec"
                 self.out(msg)
+                # one source of truth: the dashboard and GET /metrics see
+                # the same throughput numbers (profiler metrics registry)
+                from deeplearning4j_tpu.profiler import get_registry
+                reg = get_registry()
+                reg.gauge("dl4j_throughput_batches_per_sec",
+                          "Training throughput (PerformanceListener)"
+                          ).set(iters / dt)
+                if self._samples:
+                    reg.gauge("dl4j_throughput_samples_per_sec",
+                              "Training throughput (PerformanceListener)"
+                              ).set(self.samples_per_sec)
             self._last_time = now
             self._last_iter = iteration
             self._samples = 0
@@ -262,9 +273,68 @@ class StatsListener(TrainingListener):
         self._static_sent = True
 
 
+class MetricsListener(TrainingListener):
+    """Bridge the listener bus into the profiler metrics registry
+    (SURVEY.md §5: the listener bus is the single observability seam —
+    this listener makes the same signals scrapeable at ``GET /metrics``).
+
+    Per iteration: increments ``dl4j_train_iterations_total``, sets the
+    ``dl4j_train_score`` gauge, observes ``dl4j_train_iteration_seconds``
+    (wall time between the start/done hooks — includes the host sync the
+    score read forces, making it the honest end-to-end iteration cost).
+    Per epoch: increments ``dl4j_train_epochs_total``.
+
+    ``sync_score=False`` skips the ``model.score()`` host sync for
+    dispatch-bound training where a per-iteration blocking read is too
+    expensive; the score gauge then keeps its last value.
+    """
+
+    def __init__(self, registry=None, sync_score: bool = True):
+        from deeplearning4j_tpu.profiler import get_registry
+        reg = registry or get_registry()
+        self.registry = reg
+        self.sync_score = sync_score
+        self._c_iters = reg.counter(
+            "dl4j_train_iterations_total",
+            "Training iterations seen by MetricsListener")
+        self._c_epochs = reg.counter(
+            "dl4j_train_epochs_total",
+            "Training epochs seen by MetricsListener")
+        self._g_score = reg.gauge(
+            "dl4j_train_score", "Last minibatch score (loss)")
+        self._g_epoch = reg.gauge(
+            "dl4j_train_epoch", "Current epoch number")
+        self._h_iter = reg.histogram(
+            "dl4j_train_iteration_seconds",
+            "Wall time per iteration incl. listener-forced host sync")
+        self._t0 = None
+
+    def onIterationStart(self, model, iteration):
+        self._t0 = time.perf_counter()
+
+    def iterationDone(self, model, iteration, epoch):
+        self._c_iters.inc()
+        self._g_epoch.set(epoch)
+        if self.sync_score:
+            score = model.score()
+            if score == score:      # skip NaN: gauges keep last real value
+                self._g_score.set(float(score))
+        if self._t0 is not None:
+            self._h_iter.observe(time.perf_counter() - self._t0)
+            self._t0 = None
+
+    def onEpochEnd(self, model):
+        self._c_epochs.inc()
+
+
 class ProfilingListener(TrainingListener):
     """Chrome-trace profiling of training iterations (ref:
     ProfilingListener / OpProfiler, SURVEY.md §5 "Tracing/profiling").
+
+    This captures the XLA/device side via ``jax.profiler``; for the
+    framework-side timeline (dispatch, data-wait, transfers) use the
+    in-process span tracer (``deeplearning4j_tpu.profiler.trace_span``),
+    which supersedes ad-hoc trace writing here and serves ``GET /trace``.
 
     TPU-native: delegates to ``jax.profiler`` — the trace captures XLA
     device ops, host dispatch, and transfers; view in Perfetto/TensorBoard.

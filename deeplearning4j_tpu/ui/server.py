@@ -15,6 +15,15 @@ JSON endpoints mirror the dashboard's needs:
 - ``GET /api/overview?session=S``           -> score + timing series
 - ``GET /api/model?session=S``              -> per-layer stats series
 - ``GET /``                                 -> dashboard page
+
+Profiler subsystem exposure (the two machine-readable seams every later
+perf PR cites — see ``deeplearning4j_tpu.profiler``):
+
+- ``GET /metrics``  -> Prometheus text exposition (v0.0.4) of the global
+  metrics registry: op-dispatch counters, compile-cache hits/misses,
+  H2D/D2H bytes, train step / data-wait histograms, throughput gauges.
+- ``GET /trace``    -> Chrome Trace Event Format JSON of the global span
+  tracer (open in ui.perfetto.dev or chrome://tracing).
 """
 
 from __future__ import annotations
@@ -25,6 +34,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
 
+from deeplearning4j_tpu import profiler as _prof
 from deeplearning4j_tpu.ui.stats import StatsStorage
 
 _PAGE = """<!DOCTYPE html>
@@ -156,9 +166,12 @@ class _Handler(BaseHTTPRequestHandler):
         # bare NaN/Infinity tokens are invalid JSON for browsers; map
         # non-finite floats (e.g. a NaN score) to null so the dashboard
         # keeps rendering exactly when diagnostics matter most
-        body = json.dumps(_sanitize(payload)).encode()
+        self._body(json.dumps(_sanitize(payload)).encode(),
+                   "application/json", code)
+
+    def _body(self, body: bytes, ctype: str, code: int = 200):
         self.send_response(code)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
@@ -167,14 +180,22 @@ class _Handler(BaseHTTPRequestHandler):
         url = urlparse(self.path)
         q = {k: v[0] for k, v in parse_qs(url.query).items()}
         st = self.storage
+        if url.path == "/metrics":
+            # make sure always-present metric families are registered even
+            # if their subsystem hasn't been touched yet this process
+            try:
+                import deeplearning4j_tpu.native.runtime  # noqa: F401
+            except Exception:
+                pass
+            return self._body(
+                _prof.get_registry().exposition().encode(),
+                "text/plain; version=0.0.4; charset=utf-8")
+        if url.path == "/trace":
+            return self._body(
+                _prof.get_tracer().export_chrome_trace().encode(),
+                "application/json")
         if url.path == "/":
-            body = _PAGE.encode()
-            self.send_response(200)
-            self.send_header("Content-Type", "text/html")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
-            return
+            return self._body(_PAGE.encode(), "text/html")
         if url.path == "/api/sessions":
             return self._json(st.listSessionIDs())
         sid = q.get("session", "")

@@ -106,16 +106,24 @@ class NumericsPanicError(ArithmeticError):
 
 
 def panic_check(value, context: str = "loss"):
-    """Debug-mode numerics gate: when ``nan_panic``/``inf_panic`` is set,
-    synchronously pull ``value`` and raise on NaN/Inf with the training
-    context. Costs a host sync per call — a DEBUG mode, matching the
-    reference's profiling-mode semantics (off by default)."""
-    env = Environment.get()
-    if not (env.nan_panic or env.inf_panic):
+    """Debug-mode numerics gate: under ``ProfilingMode.NAN_PANIC`` /
+    ``INF_PANIC`` (set via ``profiler.set_profiling_mode`` or the
+    ``DL4J_TPU_{NAN,INF}_PANIC`` env knobs — one unified mode, ref:
+    OpExecutioner.ProfilingMode), synchronously pull ``value`` and raise
+    on NaN/Inf with the training context. Costs a host sync per call — a
+    DEBUG mode, off by default."""
+    from deeplearning4j_tpu.profiler.modes import (ProfilingMode,
+                                                   get_profiling_mode)
+    # the unified mode is the single gate: an explicit
+    # set_profiling_mode(...) override wins over the env knobs
+    mode = get_profiling_mode()
+    check_nan = mode is ProfilingMode.NAN_PANIC
+    check_inf = mode is ProfilingMode.INF_PANIC
+    if not (check_nan or check_inf):
         return
     import numpy as _np
     v = _np.asarray(value)
-    if env.nan_panic and _np.isnan(v).any():
+    if check_nan and _np.isnan(v).any():
         raise NumericsPanicError(f"NAN_PANIC: NaN detected in {context}")
-    if env.inf_panic and _np.isinf(v).any():
+    if check_inf and _np.isinf(v).any():
         raise NumericsPanicError(f"INF_PANIC: Inf detected in {context}")
