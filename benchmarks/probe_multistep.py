@@ -1,0 +1,120 @@
+"""Probe: per-step host dispatch time vs steps_per_dispatch (K).
+
+ISSUE 2 acceptance: the K-step lax.scan megastep amortizes per-step host
+dispatch — at K=16 the host-side dispatch bill per update step must be
+measurably below K=1. Measured via the ``dl4j_train_step_seconds``
+histogram (the fit loops' dispatch-time seam): per_step = Δsum / Δsteps,
+where a K-step dispatch contributes ONE sample covering K steps.
+
+Workloads: the synthetic MLP and CNN(LeNet) fit loops the other probes
+use. Prints ONE JSON line:
+
+  {"probe": "multistep", "mlp": {"k1": ..., "k4": ..., "k16": ...,
+   "speedup_k16": ...}, "cnn": {...}}
+
+``k*`` = host dispatch seconds per update step; ``speedup_k16`` =
+k1 / k16. Absolute numbers are CPU-backend dispatch times, not TPU step
+times — the regression signal is the ratio shrinking toward 1.
+
+Run: python benchmarks/probe_multistep.py [--batches N] [--reps N]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def mlp_workload(n_batches):
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.train import updaters
+
+    def build():
+        conf = (NeuralNetConfiguration.Builder().seed(42)
+                .updater(updaters.Adam(0.01)).list()
+                .layer(DenseLayer(nOut=64, activation="relu"))
+                .layer(DenseLayer(nOut=64, activation="relu"))
+                .layer(OutputLayer(nOut=10, lossFunction="mcxent",
+                                   activation="softmax"))
+                .setInputType(InputType.feedForward(32))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    rng = np.random.RandomState(0)
+    batches = [DataSet(rng.randn(32, 32).astype(np.float32),
+                       np.eye(10, dtype=np.float32)[rng.randint(0, 10, 32)])
+               for _ in range(n_batches)]
+    return build, batches
+
+
+def cnn_workload(n_batches):
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.models import zoo
+
+    def build():
+        return zoo.LeNet(num_classes=3, input_shape=(1, 16, 16)).init()
+
+    rng = np.random.RandomState(0)
+    batches = [DataSet(rng.randn(8, 16 * 16).astype(np.float32),
+                       np.eye(3, dtype=np.float32)[rng.randint(0, 3, 8)])
+               for _ in range(n_batches)]
+    return build, batches
+
+
+def measure(build, batches, k, reps):
+    """Per-update-step host dispatch seconds at steps_per_dispatch=k."""
+    from deeplearning4j_tpu import profiler
+    reg = profiler.get_registry()
+    h = reg.histogram("dl4j_train_step_seconds",
+                      "Compiled train-step dispatch time per iteration")
+    net = build()
+    net.fit(batches, steps_per_dispatch=k)   # warmup: compile + prefetch spin-up
+    net.score()
+    s0, it0 = h.sum, net.getIterationCount()
+    for _ in range(reps):
+        net.fit(batches, steps_per_dispatch=k)
+    net.score()                              # drain the dispatch pipeline
+    steps = net.getIterationCount() - it0
+    return (h.sum - s0) / max(steps, 1)
+
+
+def run_workload(build, batches, ks, reps):
+    out = {}
+    for k in ks:
+        out[f"k{k}"] = round(measure(build, batches, k, reps), 7)
+    out["speedup_k16"] = round(out[f"k{ks[0]}"] / max(out[f"k{ks[-1]}"], 1e-12), 2)
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batches", type=int, default=32,
+                    help="minibatches per fit pass (divisible by 16)")
+    ap.add_argument("--reps", type=int, default=5)
+    args = ap.parse_args()
+
+    from deeplearning4j_tpu import profiler
+    profiler.set_profiling_mode(profiler.ProfilingMode.BASIC)
+    ks = (1, 4, 16)
+    try:
+        result = {
+            "probe": "multistep",
+            "batches": args.batches,
+            "mlp": run_workload(*mlp_workload(args.batches), ks, args.reps),
+            "cnn": run_workload(*cnn_workload(args.batches), ks, args.reps),
+        }
+    finally:
+        profiler.set_profiling_mode(None)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

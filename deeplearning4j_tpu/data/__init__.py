@@ -4,7 +4,9 @@ from deeplearning4j_tpu.data.dataset import (  # noqa: F401
     AsyncDataSetIterator,
     DataSet,
     DataSetIterator,
+    DevicePrefetcher,
     ImagePreProcessingScaler,
+    IterableDataSetIterator,
     ListDataSetIterator,
     MultiDataSet,
     NormalizerMinMaxScaler,

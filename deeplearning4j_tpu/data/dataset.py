@@ -17,10 +17,28 @@ from __future__ import annotations
 
 import queue
 import threading
-from typing import Iterator, List, Optional, Sequence
+from typing import Callable, Iterable, Iterator, List, Optional, Sequence
 
 import jax
 import numpy as np
+
+from deeplearning4j_tpu import profiler as _prof
+
+# Registered at import so GET /metrics always exposes the input-pipeline
+# series (zero until a prefetching iterator runs) — a flat-zero
+# queue-depth gauge under a slow fit loop is the data-starvation signal.
+_REG = _prof.get_registry()
+_ASYNC_QUEUE_DEPTH = _REG.gauge(
+    "dl4j_async_iterator_queue_depth",
+    "Batches currently buffered by AsyncDataSetIterator (0 under load "
+    "means the consumer is data-starved)")
+_PREFETCH_QUEUE_DEPTH = _REG.gauge(
+    "dl4j_prefetch_queue_depth",
+    "Staged megabatches currently buffered by DevicePrefetcher")
+_PREFETCH_H2D_BYTES = _REG.counter(
+    "dl4j_prefetch_h2d_bytes_total",
+    "Host bytes staged onto the device by DevicePrefetcher while prior "
+    "dispatches compute (H2D/compute overlap)")
 
 
 def _as_batch_array(a):
@@ -199,6 +217,20 @@ class ListDataSetIterator(DataSetIterator):
         return int(np.prod(self.data.features.shape[1:]))
 
 
+def _offer_until_stopped(q, item, stop) -> bool:
+    """Blocking queue put that aborts when ``stop`` is set — the shared
+    worker->consumer handoff of AsyncDataSetIterator and
+    DevicePrefetcher (items, failure wrappers, and END sentinels all go
+    through it, so shutdown semantics cannot drift between the two)."""
+    while not stop.is_set():
+        try:
+            q.put(item, timeout=0.1)
+            return True
+        except queue.Full:
+            continue
+    return False
+
+
 class AsyncDataSetIterator(DataSetIterator):
     """Background prefetch wrapper (ref: AsyncDataSetIterator — the
     process-internal thread boundary in SURVEY.md §3.1)."""
@@ -217,25 +249,20 @@ class AsyncDataSetIterator(DataSetIterator):
     def _worker(self, q, stop):
         try:
             while not stop.is_set() and self.base.hasNext():
-                item = self.base.next()
-                while not stop.is_set():
-                    try:
-                        q.put(item, timeout=0.1)
-                        break
-                    except queue.Full:
-                        continue
+                if not _offer_until_stopped(q, self.base.next(), stop):
+                    return
+        except BaseException as e:
+            # surface on the consumer thread: letting the exception kill
+            # the worker would enqueue _END and silently truncate the
+            # stream (e.g. an evaluation quietly computed on 2 of 100
+            # batches)
+            _offer_until_stopped(q, _PrefetchFailure(e), stop)
         finally:
             # block-put the END sentinel with the same stop-checked retry as
             # real items — dropping it deadlocks the consumer on the last batch
-            while True:
-                try:
-                    q.put(self._END, timeout=0.1)
-                    break
-                except queue.Full:
-                    if stop.is_set():
-                        break
+            _offer_until_stopped(q, self._END, stop)
 
-    def reset(self):
+    def _shutdown_worker(self):
         # stop + drain the previous worker before touching self.base, or two
         # threads race on the underlying iterator and drop batches
         if self._thread is not None and self._thread.is_alive():
@@ -246,6 +273,10 @@ class AsyncDataSetIterator(DataSetIterator):
                 except queue.Empty:
                     pass
                 self._thread.join(timeout=0.05)
+        self._thread = None
+
+    def reset(self):
+        self._shutdown_worker()
         self.base.reset()
         self._stop = threading.Event()
         self._queue = queue.Queue(maxsize=self.prefetch)
@@ -255,16 +286,229 @@ class AsyncDataSetIterator(DataSetIterator):
         self._thread.start()
         self._next_item = self._queue.get()
 
+    def close(self):
+        """Stop the prefetch thread and drop buffered batches. Safe to
+        call repeatedly; the iterator reads as exhausted afterwards (a
+        later reset() restarts it). Before this existed the reset() drain
+        loop was the only shutdown path."""
+        self._shutdown_worker()
+        self._next_item = self._END
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close()
+        return False
+
     def hasNext(self):
         return self._next_item is not self._END
 
     def next(self):
         item = self._next_item
+        if isinstance(item, _PrefetchFailure):
+            self._next_item = self._END
+            raise item.error
         self._next_item = self._queue.get()
+        if _prof.instrumentation_active():
+            _ASYNC_QUEUE_DEPTH.set(self._queue.qsize())
         return item
 
     def batch(self):
         return self.base.batch()
+
+
+class IterableDataSetIterator(DataSetIterator):
+    """Adapter: any python iterable of DataSets -> DataSetIterator.
+
+    Lets evaluate()/AsyncDataSetIterator accept plain lists or generators.
+    reset() re-iterates the source — exact for restartable iterables
+    (lists, tuples); a one-shot generator supports a single pass."""
+
+    _DONE = object()
+
+    def __init__(self, iterable: Iterable):
+        self._iterable = iterable
+        # a one-shot iterator IS its own iter(); re-iterating it on reset()
+        # would silently drop the element buffered for hasNext()
+        self._one_shot = iter(iterable) is iterable
+        self._it = iter(iterable)
+        self._nxt = next(self._it, self._DONE)
+
+    def reset(self):
+        if self._one_shot:
+            return  # single pass: keep position (and the buffered item)
+        self._it = iter(self._iterable)
+        self._nxt = next(self._it, self._DONE)
+
+    def hasNext(self):
+        return self._nxt is not self._DONE
+
+    def next(self):
+        if self._nxt is self._DONE:
+            raise StopIteration
+        item = self._nxt
+        self._nxt = next(self._it, self._DONE)
+        return self._apply_pre(item)
+
+    def batch(self):
+        return -1
+
+
+class DevicePrefetcher:
+    """Thread + ``jax.device_put`` double buffer: stage the NEXT
+    (mega)batch onto the device while the current one computes.
+
+    Builds on AsyncDataSetIterator's worker/queue shape, but the worker
+    also *places* each batch on the device (``jax.device_put`` returns
+    immediately; the transfer overlaps prior dispatched compute), and the
+    stream is grouped into ``steps_per_dispatch``-sized
+    :class:`~deeplearning4j_tpu.train.stepping.MegaBatch` items first.
+    With the default ``prefetch=2`` the queue holds the in-flight staged
+    megabatch plus one more — a classic double buffer.
+
+    ``placement`` customizes device placement: a callable
+    ``(array, mega: bool) -> staged array`` (``mega`` is True for stacked
+    ``[K, B, ...]`` arrays) — ParallelWrapper passes a mesh-sharding
+    placement. Default: ``jax.device_put`` onto the default device.
+
+    Iterable; also a context manager (``close()`` stops the worker).
+    """
+
+    _END = object()
+
+    def __init__(self, batches: Iterable, steps_per_dispatch: int = 1,
+                 prefetch: int = 2, placement: Callable = None):
+        from deeplearning4j_tpu.train.stepping import group_into_megabatches
+        self._placement = placement
+        self._queue = queue.Queue(maxsize=max(1, prefetch))
+        self._stop = threading.Event()
+        self._src = group_into_megabatches(batches, steps_per_dispatch)
+        self._done = False
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------- staging
+    def _stage(self, item):
+        return stage_item(item, self._placement)
+
+    # -------------------------------------------------------------- worker
+    def _offer(self, item) -> bool:
+        if not _offer_until_stopped(self._queue, item, self._stop):
+            return False
+        if _prof.instrumentation_active():
+            _PREFETCH_QUEUE_DEPTH.set(self._queue.qsize())
+        return True
+
+    def _worker(self):
+        try:
+            for item in self._src:
+                if self._stop.is_set():
+                    return
+                if not self._offer(self._stage(item)):
+                    return
+        except BaseException as e:  # surface in the consumer, not the log
+            self._offer(_PrefetchFailure(e))
+        finally:
+            self._offer(self._END)
+
+    # ------------------------------------------------------------ consumer
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._done:
+            raise StopIteration
+        item = self._queue.get()
+        if _prof.instrumentation_active():
+            _PREFETCH_QUEUE_DEPTH.set(self._queue.qsize())
+        if item is self._END:
+            self._done = True
+            raise StopIteration
+        if isinstance(item, _PrefetchFailure):
+            self._done = True
+            raise item.error
+        return item
+
+    def close(self):
+        """Stop the worker and drop staged batches. Idempotent."""
+        self._stop.set()
+        while self._thread is not None and self._thread.is_alive():
+            try:
+                self._queue.get_nowait()
+            except queue.Empty:
+                pass
+            self._thread.join(timeout=0.05)
+        self._thread = None
+        self._done = True
+        _PREFETCH_QUEUE_DEPTH.set(0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close()
+        return False
+
+
+class _PrefetchFailure:
+    """Wraps a worker-thread exception for re-raise on the consumer side."""
+
+    __slots__ = ("error",)
+
+    def __init__(self, error: BaseException):
+        self.error = error
+
+
+def _stage_array(a, mega: bool, placement: Callable):
+    if a is None:
+        return None
+    if placement is not None:
+        staged = placement(a, mega)
+    else:
+        staged = jax.device_put(a)
+    if not isinstance(a, jax.Array) and _prof.instrumentation_active():
+        _PREFETCH_H2D_BYTES.inc(int(getattr(a, "nbytes", 0)))
+    return staged
+
+
+def stage_item(item, placement: Callable = None):
+    """Place one DataSet/MultiDataSet/MegaBatch's arrays onto the device
+    (``placement(array, mega)`` override, else default ``device_put``) —
+    the staging step DevicePrefetcher runs in its worker and the
+    synchronous (prefetch<=0) multi-step path runs inline."""
+    from deeplearning4j_tpu.train.stepping import MegaBatch
+    if isinstance(item, MegaBatch):
+        put = lambda a: _stage_array(a, True, placement)
+        lput = lambda xs: [put(a) for a in xs] if xs is not None else None
+        if item.multi:
+            item.features = lput(item.features)
+            item.labels = lput(item.labels)
+            item.features_mask = lput(item.features_mask)
+            item.labels_mask = lput(item.labels_mask)
+        else:
+            item.features = put(item.features)
+            item.labels = put(item.labels)
+            item.features_mask = put(item.features_mask)
+            item.labels_mask = put(item.labels_mask)
+        return item
+    put = lambda a: _stage_array(a, False, placement)
+    if isinstance(item, MultiDataSet):
+        out = MultiDataSet.__new__(MultiDataSet)
+        lput = lambda xs: [put(a) for a in xs] if xs is not None else None
+        out.features = lput(item.features)
+        out.labels = lput(item.labels)
+        out.features_masks = lput(item.features_masks)
+        out.labels_masks = lput(item.labels_masks)
+        return out
+    if isinstance(item, DataSet):
+        out = DataSet.__new__(DataSet)
+        out.features = put(item.features)
+        out.labels = put(item.labels)
+        out.features_mask = put(item.features_mask)
+        out.labels_mask = put(item.labels_mask)
+        return out
+    return item
 
 
 # ------------------------------------------------------------------ normalizers

@@ -227,8 +227,21 @@ class NativeExecutable:
                 _lib().dl4j_free_outputs(outs, rc)
                 raise NativeRuntimeError(f"unmapped output dtype {hb.dtype}")
             shape = tuple(hb.dims[d] for d in range(hb.ndim))
-            buf = ctypes.string_at(hb.data, hb.nbytes)
-            results.append(np.frombuffer(buf, dtype=dt)[:int(np.prod(shape)) if shape else 1]
+            n_elems = int(np.prod(shape)) if shape else 1
+            if n_elems == 0:
+                results.append(np.zeros(shape, dt))
+                continue
+            if hb.nbytes == 0 or not hb.data:
+                _lib().dl4j_free_outputs(outs, rc)
+                raise NativeRuntimeError(
+                    f"output {i} has empty buffer for non-empty shape {shape}")
+            # ONE host memcpy: view the runtime-owned buffer in place and
+            # copy once before dl4j_free_outputs releases it (string_at +
+            # frombuffer(...).copy() materialized every output twice)
+            src = np.ctypeslib.as_array(
+                ctypes.cast(hb.data, ctypes.POINTER(ctypes.c_uint8)),
+                shape=(int(hb.nbytes),))
+            results.append(src[:n_elems * dt.itemsize].view(dt)
                            .reshape(shape).copy())
         _lib().dl4j_free_outputs(outs, rc)
         _M_D2H_BYTES.inc(sum(r.nbytes for r in results))

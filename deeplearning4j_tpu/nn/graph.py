@@ -28,7 +28,9 @@ from deeplearning4j_tpu.nn import layers as L
 from deeplearning4j_tpu.nn import preprocessors as pp
 from deeplearning4j_tpu.nn.config import InputType, NeuralNetConfiguration
 from deeplearning4j_tpu.nn.multilayer import (_maybe_attach_env_profiler,
+                                              _predict_batches,
                                               _process_and_apply_grads)
+from deeplearning4j_tpu.train import stepping as _stepping
 from deeplearning4j_tpu.train import updaters as upd
 from deeplearning4j_tpu.utils import environment as _environment
 
@@ -377,6 +379,7 @@ class ComputationGraph:
         self._score = float("nan")
         self._listeners: List[Any] = []
         self._train_step_cache = {}
+        self._megastep_cache = {}
         self._fwd_cache = None
         self._initialized = False
 
@@ -392,6 +395,7 @@ class ComputationGraph:
                 self._states[node.name] = s
         self._opt_state = None
         self._train_step_cache = {}
+        self._megastep_cache = {}
         self._fwd_cache = None
         self._initialized = True
         return self
@@ -519,7 +523,10 @@ class ComputationGraph:
         return loss + reg, new_states
 
     # ------------------------------------------------------------------- fit
-    def _make_train_step(self, with_lmasks: bool):
+    def _make_train_step(self, with_lmasks: bool, steps: int = 1):
+        """Compile the train step; ``steps=K`` wraps the SAME body in one
+        lax.scan program doing K update steps per dispatch (see
+        MultiLayerNetwork._make_train_step)."""
         base = self.conf.base
         updater = base.updater
 
@@ -541,6 +548,9 @@ class ComputationGraph:
         # donate params/states/opt_state/t: the step consumes and replaces
         # them, halving peak HBM for the update and letting dependent
         # dispatches pipeline on relayed TPU backends
+        if steps > 1:
+            return jax.jit(_stepping.scan_megastep(step, 4),
+                           donate_argnums=(0, 1, 2, 3))
         return jax.jit(step, donate_argnums=(0, 1, 2, 3))
 
     def _ensure_opt_state(self):
@@ -558,8 +568,12 @@ class ComputationGraph:
             self._t_dev = jnp.asarray(self._iteration, jnp.int32)
         return self._t_dev
 
-    def fit(self, data, labels=None, epochs: int = 1):
-        """Accepts a DataSetIterator, DataSet, MultiDataSet, or arrays."""
+    def fit(self, data, labels=None, epochs: int = 1,
+            steps_per_dispatch: int = 1, prefetch: int = 2):
+        """Accepts a DataSetIterator, DataSet, MultiDataSet, or arrays.
+        ``steps_per_dispatch=K`` runs K update steps per compiled dispatch
+        with double-buffered device prefetch (``prefetch=0`` = synchronous
+        consumption on the calling thread) — see MultiLayerNetwork.fit."""
         if not self._initialized:
             self.init()
         self._ensure_opt_state()
@@ -581,8 +595,12 @@ class ComputationGraph:
         for _ in range(epochs):
             with _prof.trace_span("train:epoch", epoch=self._epoch):
                 # data-wait vs compute split (see MultiLayerNetwork.fit)
-                for ds in _prof.iter_with_data_wait(batches()):
-                    self._fit_one(ds)
+                if steps_per_dispatch > 1:
+                    _stepping.fit_epoch_multistep(self, batches(),
+                                                  steps_per_dispatch, prefetch)
+                else:
+                    for ds in _prof.iter_with_data_wait(batches()):
+                        self._fit_one(ds)
             self._epoch += 1
             for lst in self._listeners:
                 if hasattr(lst, "onEpochEnd"):
@@ -610,6 +628,11 @@ class ComputationGraph:
                 # 1-based, matching iterationDone: hook pair refers to the
                 # same step number
                 lst.onIterationStart(self, self._iteration + 1)
+        if _prof.instrumentation_active():
+            # keep the amortization-factor gauge consistent with the
+            # histogram samples this block records
+            _stepping.STEPS_PER_DISPATCH.set(1)
+            _stepping.TRAIN_ITERATIONS.inc()
         with _prof.timed_region(
                 "train:step", "dl4j_train_step_seconds",
                 "Compiled train-step dispatch time per iteration",
@@ -628,6 +651,42 @@ class ComputationGraph:
             if hasattr(lst, "iterationDone"):
                 lst.iterationDone(self, self._iteration, self._epoch)
 
+    def _fit_mega(self, mb):
+        """One multi-step dispatch over K stacked batches — the graph
+        counterpart of MultiLayerNetwork._fit_mega."""
+        if not self._initialized:
+            self.init()
+        self._ensure_opt_state()
+        k = mb.steps
+        if mb.multi:
+            ins = {name: jnp.asarray(a)
+                   for name, a in zip(self.conf.graph_inputs, mb.features)}
+            labels = [jnp.asarray(a) for a in mb.labels]
+            lmasks = [jnp.asarray(m) for m in mb.labels_mask] \
+                if mb.labels_mask else None
+        else:
+            ins = {self.conf.graph_inputs[0]: jnp.asarray(mb.features)}
+            labels = [jnp.asarray(mb.labels)]
+            lmasks = [jnp.asarray(mb.labels_mask)] \
+                if mb.labels_mask is not None else None
+        sig = lmasks is not None
+        if (sig, k) not in self._megastep_cache:
+            self._megastep_cache[(sig, k)] = self._make_train_step(sig, steps=k)
+        step = self._megastep_cache[(sig, k)]
+        dummy = [jnp.zeros((k, 1))] * len(labels)
+        if _prof.instrumentation_active():
+            _stepping.STEPS_PER_DISPATCH.set(k)
+        with _prof.timed_region(
+                "train:megastep", "dl4j_train_step_seconds",
+                "Compiled train-step dispatch time per iteration",
+                iteration=self._iteration + 1, steps=k):
+            self._params, self._states, self._opt_state, self._t_dev, losses = \
+                step(self._params, self._states, self._opt_state,
+                     self._ensure_clock(), ins, labels,
+                     lmasks if lmasks is not None else dummy)
+        _stepping.record_megastep(self, losses, k,
+                                  int(next(iter(ins.values())).shape[1]))
+
     # ------------------------------------------------------------- utilities
     def score(self, ds=None) -> float:
         if ds is None:
@@ -644,13 +703,19 @@ class ComputationGraph:
                                      False, jax.random.PRNGKey(0), None, None)
         return float(loss)
 
-    def evaluate(self, iterator: DataSetIterator, evaluation=None) -> Evaluation:
+    def evaluate(self, iterator, evaluation=None, pull_chunk: int = None,
+                 prefetch: bool = True) -> Evaluation:
+        """Accepts a DataSetIterator or any iterable of DataSets; forwards
+        dispatch per batch, predictions pulled D2H in chunked bulk
+        device_gets (see nn.multilayer._predict_batches; ``pull_chunk``
+        bounds on-device prediction residency, ``prefetch=False`` keeps
+        consumption on the calling thread)."""
+        from deeplearning4j_tpu.nn.multilayer import _EVAL_PULL_CHUNK
         ev = evaluation or Evaluation()
-        iterator.reset()
-        while iterator.hasNext():
-            ds = iterator.next()
-            preds = self.output(ds.features)
-            ev.eval(ds.labels, np.asarray(preds), mask=ds.labels_mask)
+        for labels, preds, mask in _predict_batches(
+                self.output, iterator, pull_chunk or _EVAL_PULL_CHUNK,
+                prefetch):
+            ev.eval(labels, preds, mask=mask)
         return ev
 
     def params(self) -> jnp.ndarray:

@@ -25,16 +25,77 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu import profiler as _prof
-from deeplearning4j_tpu.data.dataset import DataSet, DataSetIterator
+from deeplearning4j_tpu.data.dataset import (AsyncDataSetIterator, DataSet,
+                                             DataSetIterator,
+                                             IterableDataSetIterator)
 from deeplearning4j_tpu.evaluation.evaluation import Evaluation, RegressionEvaluation
 from deeplearning4j_tpu.nn import layers as L
 from deeplearning4j_tpu.nn.config import MultiLayerConfiguration
+from deeplearning4j_tpu.train import stepping as _stepping
 from deeplearning4j_tpu.train import updaters as upd
 from deeplearning4j_tpu.utils import environment as _environment
 
 _MASK_AWARE = (L.LSTM, L.SimpleRnn, L.Bidirectional, L.LastTimeStep,
                L.GlobalPoolingLayer, L.SelfAttentionLayer,
                L.RecurrentAttentionLayer)
+
+
+_EVAL_PULL_CHUNK = 64  # batches of on-device predictions held at once
+
+
+def _predict_batches(output_fn, iterator, chunk: int = _EVAL_PULL_CHUNK,
+                     prefetch: bool = True):
+    """Dispatch ``output_fn`` for every batch WITHOUT pulling each result:
+    predictions stay on device and come back in bulk jax.device_get pulls
+    of up to ``chunk`` batches — a per-batch np.asarray would block the
+    whole link round trip every batch, while an unbounded accumulation
+    would hold the entire dataset's predictions in device memory. Plain
+    (non-async) iterators are wrapped in AsyncDataSetIterator so host
+    batch prep overlaps the dispatched forwards. A generator: yields
+    (labels, preds, labels_mask) per batch, preds as host numpy — at
+    most ``chunk`` batches live on either side of the link at once.
+    ``prefetch=False`` consumes the iterator synchronously on the calling
+    thread (thread-affine data sources)."""
+    it, owns = _ensure_eval_iterator(iterator, prefetch)
+    pending = []
+
+    def drain():
+        preds = jax.device_get([p for _, p, _ in pending])
+        out = [(labels, np.asarray(p), mask)
+               for (labels, _, mask), p in zip(pending, preds)]
+        pending.clear()
+        return out
+
+    try:
+        if not owns:
+            it.reset()
+        while it.hasNext():
+            ds = it.next()
+            pending.append((ds.labels, output_fn(ds.features),
+                            ds.labels_mask))
+            if len(pending) >= chunk:
+                yield from drain()
+        if pending:
+            yield from drain()
+    finally:
+        if owns:
+            it.close()
+
+
+def _ensure_eval_iterator(iterator, prefetch: bool = True):
+    """evaluate()'s input adapter: plain DataSetIterators (and any python
+    iterable of DataSets) are wrapped in AsyncDataSetIterator so batch
+    prep overlaps the forward dispatches — unless ``prefetch=False``,
+    which keeps consumption on the calling thread. Returns (iterator,
+    owns) — ``owns`` means we created an async wrapper and must close()
+    it."""
+    if isinstance(iterator, AsyncDataSetIterator):
+        return iterator, False
+    base = iterator if isinstance(iterator, DataSetIterator) \
+        else IterableDataSetIterator(iterator)
+    if not prefetch:
+        return base, False
+    return AsyncDataSetIterator(base), True
 
 
 def _maybe_attach_env_profiler(model):
@@ -92,6 +153,7 @@ class MultiLayerNetwork:
         self._epoch = 0
         self._listeners: List[Any] = []
         self._train_step_cache = {}
+        self._megastep_cache = {}
         self._tbptt_step_cache = {}
         self._fwd_cache = None
         self._score = float("nan")
@@ -110,6 +172,7 @@ class MultiLayerNetwork:
             self._states.append(s)
         self._opt_state = None
         self._train_step_cache = {}
+        self._megastep_cache = {}
         self._tbptt_step_cache = {}
         self._fwd_cache = None
         self._initialized = True
@@ -188,7 +251,12 @@ class MultiLayerNetwork:
         return loss + reg, new_states
 
     # ------------------------------------------------------------------- fit
-    def _make_train_step(self, with_fmask: bool, with_lmask: bool):
+    def _make_train_step(self, with_fmask: bool, with_lmask: bool,
+                         steps: int = 1):
+        """Compile the train step. ``steps=1``: the classic one-dispatch-
+        per-step program. ``steps=K``: ONE lax.scan program performing K
+        full update steps over ``[K, B, ...]`` stacked batches — the SAME
+        ``step`` body, so the two are numerically equivalent."""
         base = self.conf.base
         updater = base.updater
 
@@ -223,6 +291,9 @@ class MultiLayerNetwork:
         # donate params/states/opt_state/t: consumed and replaced each step;
         # donation also lets dependent dispatches pipeline instead of
         # round-tripping per step on relayed TPU backends
+        if steps > 1:
+            return jax.jit(_stepping.scan_megastep(step, 4),
+                           donate_argnums=(0, 1, 2, 3))
         return jax.jit(step, donate_argnums=(0, 1, 2, 3))
 
     def _ensure_opt_state(self):
@@ -241,9 +312,21 @@ class MultiLayerNetwork:
             self._t_dev = jnp.asarray(self._iteration, jnp.int32)
         return self._t_dev
 
-    def fit(self, data, labels=None, epochs: int = 1):
+    def fit(self, data, labels=None, epochs: int = 1,
+            steps_per_dispatch: int = 1, prefetch: int = 2):
         """ref: MultiLayerNetwork.fit(DataSetIterator) — accepts an
-        iterator, a DataSet, or (features, labels) arrays."""
+        iterator, a DataSet, or (features, labels) arrays.
+
+        ``steps_per_dispatch=K`` batches K consecutive same-signature
+        minibatches into ONE compiled ``lax.scan`` program performing K
+        full update steps per host dispatch, with the next megabatch
+        staged onto the device by a background DevicePrefetcher while the
+        current one computes (``prefetch`` = staging queue depth;
+        ``prefetch=0`` keeps iterator consumption and staging synchronous
+        on the calling thread — required for thread-affine data sources
+        like sqlite cursors). Numerically equivalent to K single-step
+        fits; listeners observe the K per-step losses after each
+        dispatch."""
         if not self._initialized:
             self.init()
         self._ensure_opt_state()
@@ -266,8 +349,12 @@ class MultiLayerNetwork:
                 # data-wait vs compute split: time spent pulling the next
                 # batch from the (possibly async) iterator is the input
                 # pipeline's bill, not the device's
-                for ds in _prof.iter_with_data_wait(batches()):
-                    self._fit_one(ds)
+                if steps_per_dispatch > 1:
+                    _stepping.fit_epoch_multistep(self, batches(),
+                                                  steps_per_dispatch, prefetch)
+                else:
+                    for ds in _prof.iter_with_data_wait(batches()):
+                        self._fit_one(ds)
             self._epoch += 1
             for lst in self._listeners:
                 if hasattr(lst, "onEpochEnd"):
@@ -296,6 +383,12 @@ class MultiLayerNetwork:
         # async backends overlap the actual compute with the next host
         # iteration — the data_wait/step split still shows which side of
         # the pipeline is the bottleneck)
+        if _prof.instrumentation_active():
+            # keep the amortization-factor gauge consistent with the
+            # histogram samples this block records (a megastep may have
+            # left it at K)
+            _stepping.STEPS_PER_DISPATCH.set(1)
+            _stepping.TRAIN_ITERATIONS.inc()
         with _prof.timed_region(
                 "train:step", "dl4j_train_step_seconds",
                 "Compiled train-step dispatch time per iteration",
@@ -316,6 +409,38 @@ class MultiLayerNetwork:
             if hasattr(lst, "iterationDone"):
                 lst.iterationDone(self, self._iteration, self._epoch)
 
+    def _fit_mega(self, mb):
+        """One multi-step dispatch (ISSUE 2 tentpole): K stacked batches
+        through the compiled lax.scan megastep. Host bookkeeping runs once
+        per dispatch — listeners see the K per-step losses AFTER it (the
+        losses return as one device vector; each remains lazy until a
+        listener actually converts)."""
+        if not self._initialized:
+            self.init()
+        self._ensure_opt_state()
+        k = mb.steps
+        x = jnp.asarray(mb.features)
+        y = jnp.asarray(mb.labels)
+        fmask = jnp.asarray(mb.features_mask) if mb.features_mask is not None else None
+        lmask = jnp.asarray(mb.labels_mask) if mb.labels_mask is not None else None
+        sig = (fmask is not None, lmask is not None)
+        if (sig, k) not in self._megastep_cache:
+            self._megastep_cache[(sig, k)] = self._make_train_step(*sig, steps=k)
+        step = self._megastep_cache[(sig, k)]
+        dummy = jnp.zeros((k, 1))
+        if _prof.instrumentation_active():
+            _stepping.STEPS_PER_DISPATCH.set(k)
+        with _prof.timed_region(
+                "train:megastep", "dl4j_train_step_seconds",
+                "Compiled train-step dispatch time per iteration",
+                iteration=self._iteration + 1, steps=k):
+            self._params, self._states, self._opt_state, self._t_dev, losses = \
+                step(self._params, self._states, self._opt_state,
+                     self._ensure_clock(), x, y,
+                     fmask if fmask is not None else dummy,
+                     lmask if lmask is not None else dummy)
+        _stepping.record_megastep(self, losses, k, int(x.shape[1]))
+
     # ----------------------------------------------------------------- score
     def score(self, ds: DataSet = None) -> float:
         """Last minibatch score, or score of a given DataSet (ref: score())."""
@@ -331,23 +456,28 @@ class MultiLayerNetwork:
         return float(loss)
 
     # ------------------------------------------------------------- evaluation
-    def evaluate(self, iterator: DataSetIterator, evaluation=None) -> Evaluation:
-        """ref: MultiLayerNetwork.evaluate(DataSetIterator)."""
+    def evaluate(self, iterator, evaluation=None,
+                 pull_chunk: int = _EVAL_PULL_CHUNK,
+                 prefetch: bool = True) -> Evaluation:
+        """ref: MultiLayerNetwork.evaluate(DataSetIterator); also accepts
+        any plain iterable of DataSets. ``pull_chunk`` bounds how many
+        batches of predictions stay on device between bulk D2H pulls —
+        lower it for very large per-batch outputs. ``prefetch=False``
+        keeps iterator consumption on the calling thread (thread-affine
+        data sources)."""
         ev = evaluation or Evaluation()
-        iterator.reset()
-        while iterator.hasNext():
-            ds = iterator.next()
-            preds = self.output(ds.features)
-            ev.eval(ds.labels, np.asarray(preds), mask=ds.labels_mask)
+        for labels, preds, mask in _predict_batches(self.output, iterator,
+                                                    pull_chunk, prefetch):
+            ev.eval(labels, preds, mask=mask)
         return ev
 
-    def evaluateRegression(self, iterator: DataSetIterator) -> RegressionEvaluation:
+    def evaluateRegression(self, iterator,
+                           pull_chunk: int = _EVAL_PULL_CHUNK,
+                           prefetch: bool = True) -> RegressionEvaluation:
         ev = RegressionEvaluation()
-        iterator.reset()
-        while iterator.hasNext():
-            ds = iterator.next()
-            preds = self.output(ds.features)
-            ev.eval(ds.labels, np.asarray(preds), mask=ds.labels_mask)
+        for labels, preds, mask in _predict_batches(self.output, iterator,
+                                                    pull_chunk, prefetch):
+            ev.eval(labels, preds, mask=mask)
         return ev
 
     # ------------------------------------------------------------ param views

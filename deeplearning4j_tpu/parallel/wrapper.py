@@ -44,13 +44,22 @@ class ParallelWrapper:
         self.mesh = mesh or DeviceMesh.data_parallel()
         self.prefetch = prefetch_buffer
 
-    def fit(self, iterator: DataSetIterator, epochs: int = 1):
+    def fit(self, iterator: DataSetIterator, epochs: int = 1,
+            steps_per_dispatch: int = 1):
+        """``steps_per_dispatch=K`` composes the data-parallel path with
+        the K-step lax.scan megastep: each megabatch is staged as
+        ``[K, B, ...]`` arrays batch-sharded over the mesh's ``data`` axis
+        (axis 1) by a DevicePrefetcher, so ONE dispatch per K sharded
+        update steps."""
         model = self.model
         if not model._initialized:
             model.init()
+        k = int(steps_per_dispatch)
         fresh = False
-        if self.prefetch and not isinstance(iterator, AsyncDataSetIterator):
-            # the wrapper's constructor resets the base and starts prefetching
+        if k <= 1 and self.prefetch and not isinstance(iterator, AsyncDataSetIterator):
+            # the wrapper's constructor resets the base and starts
+            # prefetching (the K-step path prefetches via DevicePrefetcher
+            # instead — its worker already pulls the base iterator)
             iterator = AsyncDataSetIterator(iterator, prefetch=self.prefetch)
             fresh = True
         # replicate params/opt state once; batches are sharded per step
@@ -69,12 +78,40 @@ class ParallelWrapper:
             for e in range(epochs):
                 if e or not fresh:
                     iterator.reset()
-                while iterator.hasNext():
-                    ds = iterator.next()
-                    ds = self._shard(ds)
-                    model._fit_one(ds)
+                if k > 1:
+                    self._fit_epoch_multistep(model, iterator, k)
+                else:
+                    while iterator.hasNext():
+                        ds = iterator.next()
+                        ds = self._shard(ds)
+                        model._fit_one(ds)
                 model._epoch += 1
         return model
+
+    def _fit_epoch_multistep(self, model, iterator, k: int):
+        from deeplearning4j_tpu.train import stepping as _stepping
+
+        def padded():
+            while iterator.hasNext():
+                yield self._pad(iterator.next())
+
+        # honor prefetch_buffer exactly: 0 keeps the base iterator on the
+        # calling thread (thread-affine data sources) with inline staging,
+        # N bounds staged megabatches in device memory to N — each is K
+        # minibatches, so the user's bound is a real memory bound
+        _stepping.fit_epoch_multistep(
+            model, padded(), k, prefetch=self.prefetch or 0,
+            placement=self._mesh_placement)
+
+    def _mesh_placement(self, a, mega: bool):
+        """DevicePrefetcher placement hook: megabatch arrays [K, B, ...]
+        shard axis 1 over ``data``; leftover single batches shard axis 0
+        (same as _shard_impl)."""
+        ndim = np.ndim(a)
+        if not mega:
+            return jax.device_put(a, self.mesh.batch_sharding(ndim))
+        return jax.device_put(
+            a, self.mesh.sharding(None, "data", *([None] * (ndim - 2))))
 
     def _shard(self, ds: DataSet) -> DataSet:
         if _prof.instrumentation_active():
@@ -88,6 +125,17 @@ class ParallelWrapper:
         return self._shard_impl(ds)
 
     def _shard_impl(self, ds: DataSet) -> DataSet:
+        ds = self._pad(ds)
+        out = DataSet.__new__(DataSet)
+        put = lambda a: jax.device_put(
+            a, self.mesh.batch_sharding(np.ndim(a))) if a is not None else None
+        out.features = put(ds.features)
+        out.labels = put(ds.labels)
+        out.features_mask = put(ds.features_mask)
+        out.labels_mask = put(ds.labels_mask)
+        return out
+
+    def _pad(self, ds: DataSet) -> DataSet:
         n = self.mesh.size("data")
         b = ds.features.shape[0]
         if b % n != 0:
@@ -109,14 +157,7 @@ class ParallelWrapper:
                                                     lmask.dtype)])
             ds = DataSet(rep(ds.features), rep(ds.labels),
                          rep(ds.features_mask), lmask)
-        out = DataSet.__new__(DataSet)
-        put = lambda a: jax.device_put(
-            a, self.mesh.batch_sharding(np.ndim(a))) if a is not None else None
-        out.features = put(ds.features)
-        out.labels = put(ds.labels)
-        out.features_mask = put(ds.features_mask)
-        out.labels_mask = put(ds.labels_mask)
-        return out
+        return ds
 
     def averagingFrequency(self, n):
         # API-parity shim: sync SPMD allreduces inside ONE XLA program every

@@ -18,8 +18,11 @@ unifies the Environment numerics-panic knobs.
 Instrumented seams: ``ops.registry`` dispatch, ``native.runtime``
 (compile cache, H2D/D2H), ``parallel.{wrapper,data}`` (replication /
 shard transfers), the ``nn.{multilayer,graph}`` fit loops (step time,
-data-wait vs compute), and the listener bus (``MetricsListener``,
-``PerformanceListener``).
+data-wait vs compute, ``train:megastep`` spans +
+``dl4j_steps_per_dispatch`` for multi-step dispatch), the input
+pipeline (``dl4j_{async_iterator,prefetch}_queue_depth``,
+``dl4j_prefetch_h2d_bytes_total``), and the listener bus
+(``MetricsListener``, ``PerformanceListener``).
 
 Everything is near-zero-cost when disabled: one module-level flag / enum
 read before any span or sample is allocated.

@@ -2,6 +2,7 @@
 (ref: org.nd4j.linalg.learning + org.deeplearning4j.optimize — SURVEY.md §2.2)."""
 
 from deeplearning4j_tpu.train import schedules, updaters  # noqa: F401
+from deeplearning4j_tpu.train import stepping  # noqa: F401  (multi-step dispatch)
 from deeplearning4j_tpu.train.listeners import (  # noqa: F401
     CheckpointListener,
     EvaluativeListener,

@@ -1,0 +1,226 @@
+"""Multi-step on-device training dispatch (megasteps).
+
+The paper's core claim amortizes the reference's hundreds of JNI
+crossings per training step down to ~1 dispatch per step (SURVEY.md
+§3.1) — but on a high-latency device link one dispatch per step is
+still the ceiling. This module batches K same-signature minibatches
+into ONE compiled ``lax.scan`` program that performs K full update
+steps (forward + loss + backward + clip + updater + frozen-layer
+gating) per dispatch, the same move CUDA Graphs makes for kernel-launch
+overhead and TensorFlow makes with in-graph loops (Abadi et al., 2016):
+per-step host dispatch, listener bookkeeping, and link round trips all
+drop by ~K×.
+
+Pieces:
+
+- :class:`MegaBatch` — K stacked batches, ``[K, B, ...]`` per array.
+- :func:`group_into_megabatches` — signature-aware grouping of a batch
+  stream; signature changes and epoch tails fall back to single-step
+  fits, so ``fit(steps_per_dispatch=K)`` is ALWAYS numerically
+  equivalent to K single-step fits (the hard guarantee the tests pin).
+- :func:`scan_megastep` — wraps a single-step body into the scanned
+  K-step program; the body is byte-for-byte the one the single-step
+  path jits, so the per-iteration RNG (``fold_in(base, t)``), updater
+  math, and frozen-layer gating are identical by construction.
+- :func:`fit_epoch_multistep` — the epoch driver both
+  ``MultiLayerNetwork.fit`` and ``ComputationGraph.fit`` delegate to:
+  megabatch grouping behind a :class:`~deeplearning4j_tpu.data.dataset.
+  DevicePrefetcher` (megabatch K+1 stages H2D while K computes), then
+  ``model._fit_mega`` / ``model._fit_one`` per item.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Iterator, List, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu import profiler as _prof
+from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.utils import environment as _environment
+
+# How many update steps the most recent compiled dispatch performed.
+STEPS_PER_DISPATCH = _prof.get_registry().gauge(
+    "dl4j_steps_per_dispatch",
+    "Update steps performed by the most recent compiled train dispatch "
+    "(1 = classic per-step dispatch, K = lax.scan megastep)")
+# Total update steps, advanced by K per megastep dispatch. A
+# dl4j_train_step_seconds sample covers ONE dispatch (1 or K steps), so
+# per-step host dispatch time under mixed K is
+# rate(dl4j_train_step_seconds_sum) / rate(dl4j_train_iterations_total)
+# — NOT sum/count, which a megastep/tail-fallback mix would skew.
+TRAIN_ITERATIONS = _prof.get_registry().counter(
+    "dl4j_train_iterations_total",
+    "Update steps performed by compiled train dispatches (a K-step "
+    "megastep advances this by K)")
+
+
+class MegaBatch:
+    """K same-signature training batches stacked along a leading axis.
+
+    ``features``/``labels``/masks are ``[K, B, ...]`` arrays (or lists of
+    them when ``multi`` — the MultiDataSet/ComputationGraph container);
+    ``steps`` is K. Masks are None when absent from every stacked batch.
+    """
+
+    __slots__ = ("features", "labels", "features_mask", "labels_mask",
+                 "steps", "multi")
+
+    def numExamples(self) -> int:
+        a = self.features[0] if self.multi else self.features
+        return int(a.shape[0] * a.shape[1])
+
+
+def batch_signature(ds):
+    """Grouping key: two batches may share a compiled megastep iff their
+    array shapes/dtypes and mask arities all match (the same condition
+    under which the single-step jit cache would reuse one program)."""
+    def sig(a):
+        return None if a is None else (tuple(a.shape), str(a.dtype))
+    if isinstance(ds, MultiDataSet):
+        return ("multi",
+                tuple(sig(a) for a in ds.features),
+                tuple(sig(a) for a in ds.labels),
+                tuple(sig(a) for a in (ds.features_masks or ())),
+                tuple(sig(a) for a in (ds.labels_masks or ())))
+    return ("single", sig(ds.features), sig(ds.labels),
+            sig(ds.features_mask), sig(ds.labels_mask))
+
+
+def _stack(arrs):
+    if arrs[0] is None:
+        return None
+    if any(isinstance(a, jax.Array) for a in arrs):
+        return jnp.stack(arrs)
+    return np.stack(arrs)
+
+
+def stack_megabatch(group: List[Union[DataSet, MultiDataSet]]) -> MegaBatch:
+    """Stack K same-signature batches into one MegaBatch (host-side
+    np.stack unless inputs are already device-resident)."""
+    first = group[0]
+    mb = MegaBatch()
+    mb.steps = len(group)
+    if isinstance(first, MultiDataSet):
+        mb.multi = True
+        mb.features = [_stack([d.features[i] for d in group])
+                       for i in range(len(first.features))]
+        mb.labels = [_stack([d.labels[i] for d in group])
+                     for i in range(len(first.labels))]
+        mb.features_mask = (
+            [_stack([d.features_masks[i] for d in group])
+             for i in range(len(first.features_masks))]
+            if first.features_masks else None)
+        mb.labels_mask = (
+            [_stack([d.labels_masks[i] for d in group])
+             for i in range(len(first.labels_masks))]
+            if first.labels_masks else None)
+    else:
+        mb.multi = False
+        mb.features = _stack([d.features for d in group])
+        mb.labels = _stack([d.labels for d in group])
+        mb.features_mask = _stack([d.features_mask for d in group])
+        mb.labels_mask = _stack([d.labels_mask for d in group])
+    return mb
+
+
+def group_into_megabatches(batches: Iterable, steps: int) -> Iterator:
+    """Yield MegaBatches of ``steps`` consecutive same-signature batches;
+    batches stranded by a signature change or the epoch tail are yielded
+    as plain DataSets (single-step fits) — equivalence over cleverness."""
+    if steps <= 1:
+        yield from batches
+        return
+    pending, sig = [], None
+    for ds in batches:
+        s = batch_signature(ds)
+        if pending and s != sig:
+            yield from pending
+            pending = []
+        sig = s
+        pending.append(ds)
+        if len(pending) == steps:
+            yield stack_megabatch(pending)
+            pending = []
+    yield from pending
+
+
+def scan_megastep(body, num_carry: int):
+    """Wrap a single-step ``body(*carry, *xs) -> (*new_carry, loss)`` into
+    a K-step program: carry threads (params, states, opt_state, t), every
+    xs leaf gains a leading K axis, and the K per-step losses come back as
+    ONE device vector. The body is the exact function the single-step path
+    jits, so K scanned steps == K single-step fits numerically."""
+    def megastep(*args):
+        carry, xs = args[:num_carry], args[num_carry:]
+
+        def scan_body(c, x):
+            out = body(*c, *x)
+            return tuple(out[:-1]), out[-1]
+
+        carry, losses = jax.lax.scan(scan_body, tuple(carry), tuple(xs))
+        return (*carry, losses)
+    return megastep
+
+
+def record_megastep(model, losses, steps: int, batch_size: int) -> None:
+    """Shared post-dispatch bookkeeping for ``_fit_mega`` (both network
+    classes): numerics panic gate over the K-loss vector, then per-step
+    listener delivery — each ``losses[j]`` stays a lazy device scalar
+    unless a listener actually pulls ``score()``.
+
+    Listener semantics under megasteps: all K callback pairs fire AFTER
+    the dispatch, so a listener that inspects model state (params,
+    checkpoints) at iteration N observes the END-OF-DISPATCH state, not
+    iteration N's. Iteration-indexed side effects (CheckpointListener
+    intervals, EvaluativeListener) should use an interval K divides — or
+    choose K to divide the interval — so callbacks land on dispatch
+    boundaries where state and iteration number agree."""
+    _environment.panic_check(
+        losses, f"megastep losses at iterations "
+                f"{model._iteration + 1}..{model._iteration + steps}")
+    if _prof.instrumentation_active():
+        TRAIN_ITERATIONS.inc(steps)
+    model._last_batch_size = batch_size
+    if not model._listeners:
+        # no one consumes per-step losses: ONE lazy slice for score()
+        # instead of K tiny indexing dispatches per megastep
+        model._iteration += steps
+        model._score = losses[steps - 1]
+        return
+    for j in range(steps):
+        model._score = losses[j]
+        model._iteration += 1
+        for lst in model._listeners:
+            if hasattr(lst, "onIterationStart"):
+                lst.onIterationStart(model, model._iteration)
+            if hasattr(lst, "iterationDone"):
+                lst.iterationDone(model, model._iteration, model._epoch)
+
+
+def fit_epoch_multistep(model, batches: Iterable, steps: int,
+                        prefetch: int = 2, placement=None) -> None:
+    """One epoch of multi-step dispatch: group the batch stream into
+    megabatches and stage each onto the device from a background thread
+    (double buffer — megabatch K+1 transfers while K computes), then run
+    each through the model's compiled megastep. ``prefetch <= 0`` runs
+    the whole pipeline synchronously on the calling thread (no worker
+    thread; for iterators backed by thread-affine resources)."""
+    from deeplearning4j_tpu.data.dataset import DevicePrefetcher, stage_item
+
+    def drive(items):
+        for item in _prof.iter_with_data_wait(items):
+            if isinstance(item, MegaBatch):
+                model._fit_mega(item)
+            else:
+                model._fit_one(item)
+
+    if prefetch and prefetch > 0:
+        with DevicePrefetcher(batches, steps_per_dispatch=steps,
+                              prefetch=prefetch, placement=placement) as pf:
+            drive(pf)
+    else:
+        drive(stage_item(item, placement)
+              for item in group_into_megabatches(batches, steps))
