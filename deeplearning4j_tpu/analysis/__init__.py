@@ -1,0 +1,44 @@
+"""Static model linter + runtime recompile-churn detector.
+
+Catch misconfiguration BEFORE it burns an XLA compile (TVM-style whole-
+graph analysis ahead of codegen; TensorFlow's pre-session graph
+validation is the same shape of tool):
+
+- :mod:`analyzer` — walks MultiLayerConfiguration /
+  ComputationGraphConfiguration without touching jax, propagating
+  InputType shapes layer-by-layer and vertex-by-vertex into structured
+  ``Diagnostic(code, severity, location, message, fix_hint)`` findings
+  (``DL4J-E001`` nIn mismatch, ``E002`` cycle, ``E003`` dangling vertex,
+  ``E004`` duplicate name, ``E005`` missing CNN->Dense flatten, ``E006``
+  merge-shape conflict, ``E007`` shape-inference failure, ``E008``
+  missing loss head, ``W001`` loss/activation pairing, ``W002`` TBPTT
+  without recurrence, ``W003`` frozen layers + stateful updater).
+- :mod:`layout` — TPU layout lints: ``W101`` MXU tile-padding waste,
+  ``W102`` non-native dtypes, ``W103`` batch vs. data-mesh divisibility.
+- :mod:`churn` — runtime detector behind the fit/compile dispatch seams:
+  ``dl4j_recompiles_total{site=...}`` in the profiler registry plus a
+  ``W201`` diagnostic when one site crosses the signature threshold.
+
+Entry points: ``config.validate()`` / ``model.validate()``,
+``init(strict=True)`` (raises :class:`ModelValidationError` on E-codes),
+and ``python -m deeplearning4j_tpu.analysis [--zoo | <model-or-module>]``.
+
+The package imports no jax at module scope (pinned by a test) — analysis
+is pure-static and runs anywhere the configs import.
+"""
+
+from deeplearning4j_tpu.analysis.analyzer import analyze
+from deeplearning4j_tpu.analysis.churn import (RecompileChurnDetector,
+                                               array_fingerprint,
+                                               get_churn_detector)
+from deeplearning4j_tpu.analysis.diagnostics import (DIAGNOSTIC_CODES,
+                                                     Diagnostic,
+                                                     ModelValidationError,
+                                                     Severity,
+                                                     ValidationReport)
+
+__all__ = [
+    "analyze", "Diagnostic", "Severity", "ValidationReport",
+    "ModelValidationError", "DIAGNOSTIC_CODES", "RecompileChurnDetector",
+    "get_churn_detector", "array_fingerprint",
+]

@@ -1,0 +1,129 @@
+"""CLI: lint zoo models / user modules ahead of any compile.
+
+Usage::
+
+    python -m deeplearning4j_tpu.analysis --zoo            # every zoo model
+    python -m deeplearning4j_tpu.analysis LeNet ResNet50   # named zoo models
+    python -m deeplearning4j_tpu.analysis my.module        # module attrs
+    python -m deeplearning4j_tpu.analysis my.module:build  # one attribute
+
+A module target is scanned for ZooModel subclasses, configurations, and
+networks; a ``module:attr`` target names one such object (callables are
+called with no args first). Exit status is 0 only when every target is
+clean — warnings count as failures unless ``--warnings-ok``.
+
+Building zoo configs imports the layer stack (and therefore jax), but no
+program is traced or compiled — the analysis itself stays static.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import sys
+from typing import List, Tuple
+
+from deeplearning4j_tpu.analysis.analyzer import analyze
+from deeplearning4j_tpu.analysis.diagnostics import ValidationReport
+
+
+def _zoo_registry():
+    from deeplearning4j_tpu.models import zoo
+    return zoo.ZOO_MODELS
+
+
+def _coerce_target(name: str, obj) -> List[Tuple[str, object]]:
+    """Turn one resolved object into [(label, analyzable)] pairs."""
+    if isinstance(obj, type):
+        from deeplearning4j_tpu.models.zoo import ZooModel
+        if issubclass(obj, ZooModel):
+            return [(name, obj().conf_builder())]
+        obj = obj()
+    if callable(obj) and not hasattr(obj, "conf") \
+            and not hasattr(obj, "layers") and not hasattr(obj, "nodes"):
+        obj = obj()
+    return [(name, obj)]
+
+
+def _resolve(target: str) -> List[Tuple[str, object]]:
+    registry = _zoo_registry()
+    if target in registry:
+        return _coerce_target(target, registry[target])
+    mod_name, _, attr = target.partition(":")
+    try:
+        module = importlib.import_module(mod_name)
+    except ImportError:
+        # maybe a dotted attribute path: pkg.mod.Attr
+        if not attr and "." in target:
+            mod_name, _, attr = target.rpartition(".")
+            module = importlib.import_module(mod_name)
+        else:
+            raise
+    if attr:
+        return _coerce_target(target, getattr(module, attr))
+    from deeplearning4j_tpu.models.zoo import ZooModel
+    found = []
+    for aname in sorted(vars(module)):
+        obj = vars(module)[aname]
+        if isinstance(obj, type) and issubclass(obj, ZooModel) \
+                and obj is not ZooModel \
+                and obj.__module__ == module.__name__:
+            found.extend(_coerce_target(f"{target}:{aname}", obj))
+        elif hasattr(obj, "layers") and hasattr(obj, "base") \
+                or hasattr(obj, "nodes") and hasattr(obj, "graph_inputs"):
+            found.extend(_coerce_target(f"{target}:{aname}", obj))
+    if not found:
+        raise SystemExit(f"no zoo models or configurations found in "
+                         f"{target!r}")
+    return found
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m deeplearning4j_tpu.analysis",
+        description="Static model linter: shape/dtype propagation, graph "
+                    "diagnostics, and TPU layout lints — no compile, no "
+                    "device.")
+    ap.add_argument("targets", nargs="*",
+                    help="zoo model name (e.g. LeNet), module, or "
+                         "module:attr")
+    ap.add_argument("--zoo", action="store_true",
+                    help="lint every model-zoo architecture")
+    ap.add_argument("--batch-size", type=int, default=None,
+                    help="planned global batch size (enables the W103 "
+                         "mesh-divisibility lint)")
+    ap.add_argument("--devices", type=int, default=None,
+                    help="data-parallel mesh axis size for W103")
+    ap.add_argument("--warnings-ok", action="store_true",
+                    help="exit 0 even when warnings (W-codes) were found")
+    args = ap.parse_args(argv)
+
+    targets: List[Tuple[str, object]] = []
+    if args.zoo:
+        targets.extend((name, cls().conf_builder())
+                       for name, cls in _zoo_registry().items())
+    for t in args.targets:
+        targets.extend(_resolve(t))
+    if not targets:
+        ap.print_usage()
+        print("nothing to lint: pass --zoo and/or target names")
+        return 2
+
+    failed = 0
+    total = ValidationReport()
+    for name, obj in targets:
+        report = analyze(obj, batch_size=args.batch_size,
+                         data_devices=args.devices)
+        report.subject = name
+        total.extend(report.diagnostics)
+        print(report.format())
+        if not report.ok(warnings_as_errors=not args.warnings_ok):
+            failed += 1
+    print(f"\n{len(targets)} model(s) linted: {len(targets) - failed} clean, "
+          f"{failed} with findings ({len(total.errors())} error(s), "
+          f"{len(total.warnings())} warning(s))")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,161 @@
+"""Diagnostic model for the static analyzer.
+
+Reference parity: the pre-init validation DL4J scatters through
+``MultiLayerConfiguration.Builder.build`` / ``ComputationGraphConfiguration
+.validate`` (nIn/nOut checks, duplicate-name checks, dangling-vertex
+checks) — unified here into one structured diagnostic stream the way
+TVM's relay type-checker and TensorFlow's pre-session graph validation
+report: every finding is a ``Diagnostic(code, severity, location,
+message, fix_hint)`` instead of whichever exception happens to fire
+first deep inside a trace.
+
+IMPORTANT: this module (like the whole ``analysis`` package) must not
+import jax at module scope — the linter runs ahead of any compile and is
+usable from environments where no accelerator stack is importable
+(verified by ``tests/test_analysis.py``).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Iterable, List, Optional
+
+
+class Severity(enum.IntEnum):
+    """Ordered so reports can sort most-severe first."""
+
+    INFO = 0
+    WARNING = 1
+    ERROR = 2
+
+
+#: The documented diagnostic codes (the README table is generated from
+#: the same source). E### = configuration errors (init(strict=True)
+#: raises), W0## = training-semantics warnings, W1## = TPU layout lints,
+#: W2## = runtime recompile-churn findings.
+DIAGNOSTIC_CODES = {
+    "DL4J-E001": "nIn mismatch: a layer's declared nIn disagrees with the "
+                 "propagated input size (or nIn is unresolvable because no "
+                 "InputType was set)",
+    "DL4J-E002": "cycle: the computation graph contains a dependency cycle",
+    "DL4J-E003": "dangling/unreachable vertex: a node references an "
+                 "undefined input, or does not lie on any input->output "
+                 "path",
+    "DL4J-E004": "duplicate name: two layers/vertices share an explicit "
+                 "name",
+    "DL4J-E005": "missing CNN->Dense preprocessor: a 4-D feature map feeds "
+                 "a dense layer with no flatten step in between",
+    "DL4J-E006": "merge-shape conflict: Merge/ElementWise vertex inputs "
+                 "have incompatible shapes or kinds",
+    "DL4J-E007": "shape inference failure: missing nOut, spatial underflow "
+                 "(kernel larger than input), or an invalid layer geometry",
+    "DL4J-E008": "missing loss head: the last layer / a graph output is "
+                 "not an output or loss layer, so fit() cannot compute a "
+                 "loss",
+    "DL4J-W001": "loss/activation pairing: softmax with a regression loss, "
+                 "or sigmoid with a multiclass cross-entropy",
+    "DL4J-W002": "TBPTT configured on a network with no recurrent layers",
+    "DL4J-W003": "frozen layers with a stateful updater (updater state is "
+                 "allocated and carried for params that never update)",
+    "DL4J-W101": "MXU padding waste: a matmul lane dim is far from the "
+                 "next multiple of 128 (tiles pad to 8x128 on the MXU)",
+    "DL4J-W102": "non-TPU-native dtype: float64/float16 force emulation or "
+                 "silent f32 upcasts on TPU",
+    "DL4J-W103": "batch size does not divide the data-parallel mesh axis, "
+                 "so per-device batches would be ragged",
+    "DL4J-W201": "recompile churn: one dispatch site compiled more than N "
+                 "distinct jit signatures (shifting shapes/dtypes)",
+}
+
+
+class Diagnostic:
+    """One structured finding from the analyzer or the churn detector."""
+
+    __slots__ = ("code", "severity", "location", "message", "fix_hint")
+
+    def __init__(self, code: str, severity: Severity, location: str,
+                 message: str, fix_hint: Optional[str] = None):
+        if code not in DIAGNOSTIC_CODES:
+            raise ValueError(f"undocumented diagnostic code {code!r}")
+        self.code = code
+        self.severity = Severity(severity)
+        self.location = location
+        self.message = message
+        self.fix_hint = fix_hint
+
+    def format(self) -> str:
+        line = (f"{self.code} {self.severity.name.lower():<7} "
+                f"[{self.location}] {self.message}")
+        if self.fix_hint:
+            line += f"\n    fix: {self.fix_hint}"
+        return line
+
+    def __repr__(self):
+        return (f"Diagnostic({self.code}, {self.severity.name}, "
+                f"{self.location!r}, {self.message!r})")
+
+
+class ValidationReport:
+    """Ordered collection of diagnostics with severity accessors."""
+
+    def __init__(self, diagnostics: Iterable[Diagnostic] = (),
+                 subject: str = ""):
+        self.subject = subject
+        self.diagnostics: List[Diagnostic] = list(diagnostics)
+
+    def add(self, diag: Diagnostic) -> None:
+        self.diagnostics.append(diag)
+
+    def extend(self, diags: Iterable[Diagnostic]) -> None:
+        self.diagnostics.extend(diags)
+
+    def errors(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity is Severity.ERROR]
+
+    def warnings(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity is Severity.WARNING]
+
+    def codes(self) -> List[str]:
+        return [d.code for d in self.diagnostics]
+
+    def ok(self, warnings_as_errors: bool = False) -> bool:
+        if self.errors():
+            return False
+        return not (warnings_as_errors and self.warnings())
+
+    def raise_if_errors(self) -> "ValidationReport":
+        if self.errors():
+            raise ModelValidationError(self)
+        return self
+
+    def format(self) -> str:
+        head = self.subject or "model"
+        if not self.diagnostics:
+            return f"{head}: clean (0 errors, 0 warnings)"
+        lines = [f"{head}: {len(self.errors())} error(s), "
+                 f"{len(self.warnings())} warning(s)"]
+        for d in sorted(self.diagnostics, key=lambda d: -int(d.severity)):
+            lines.append("  " + d.format().replace("\n", "\n  "))
+        return "\n".join(lines)
+
+    def __iter__(self):
+        return iter(self.diagnostics)
+
+    def __len__(self):
+        return len(self.diagnostics)
+
+    def __str__(self):
+        return self.format()
+
+    def __repr__(self):
+        return (f"ValidationReport({self.subject!r}, "
+                f"errors={len(self.errors())}, "
+                f"warnings={len(self.warnings())})")
+
+
+class ModelValidationError(ValueError):
+    """Raised by ``init(strict=True)`` / ``raise_if_errors`` on E-codes."""
+
+    def __init__(self, report: ValidationReport):
+        self.report = report
+        super().__init__(report.format())

@@ -1,0 +1,105 @@
+"""TPU layout lints (W1xx) — static checks against MXU/mesh geometry.
+
+The MXU processes 8x128 tiles: a matmul whose lane (minor-most) dim sits
+just past a multiple of 128 pads the whole tile and burns the remainder
+as dead FLOPs — e.g. nOut=300 executes as 384 lanes, 22% of every MAC
+wasted. Same story for dtypes (f64 is emulated, f16 upcasts through f32
+on the MXU — bf16/f32 are the native pair) and for the data-parallel
+mesh (a global batch that does not divide the ``parallel/`` data axis
+leaves ragged per-device shards).
+
+These lints read only declared config shapes — no jax import, no trace.
+Thresholds are deliberately conservative (dim >= 256 and > 20% padding
+waste) so realistic published architectures (NASNet's 44-filter cells,
+Xception's 728) stay clean while genuinely wasteful layouts get flagged.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from deeplearning4j_tpu.analysis.diagnostics import Diagnostic, Severity
+
+MXU_LANES = 128        # minor-most tile dim
+MXU_SUBLANES = 8       # second-minor tile dim
+#: Only lint lane dims at least this large — below it the whole operand
+#: fits one tile and alignment is noise next to dispatch overhead.
+MIN_LINT_DIM = 256
+#: Padding-waste fraction above which W101 fires.
+WASTE_THRESHOLD = 0.20
+
+#: dtypes that are not MXU-native: f64 is software-emulated, f16 round
+#: trips through f32. (bf16 + f32 are the native pair.)
+NON_NATIVE_DTYPES = {"float64", "double", "f64", "float16", "half", "f16"}
+
+
+def padding_waste(dim: int, tile: int = MXU_LANES) -> float:
+    """Fraction of a padded tile row that is dead: (ceil-pad - dim)/pad."""
+    padded = ((int(dim) + tile - 1) // tile) * tile
+    return (padded - dim) / padded
+
+
+def lint_lane_dim(dim: int, location: str) -> Optional[Diagnostic]:
+    """W101 when a single matmul lane dim pads wastefully on the MXU."""
+    if not dim or dim < MIN_LINT_DIM or dim % MXU_LANES == 0:
+        return None
+    waste = padding_waste(dim)
+    if waste <= WASTE_THRESHOLD:
+        return None
+    padded = ((dim + MXU_LANES - 1) // MXU_LANES) * MXU_LANES
+    return Diagnostic(
+        "DL4J-W101", Severity.WARNING, location,
+        f"lane dim {dim} pads to {padded} on the {MXU_SUBLANES}x{MXU_LANES} "
+        f"MXU tile grid — {waste:.0%} of every MAC in this matmul is dead "
+        f"padding",
+        fix_hint=f"round the feature/channel count to a multiple of "
+                 f"{MXU_LANES} (e.g. {padded} or "
+                 f"{max(MXU_LANES, padded - MXU_LANES)})")
+
+
+def lint_layers(located_layers) -> List[Diagnostic]:
+    """W101 over ``(location, layer)`` pairs using each layer's
+    ``mxu_lane_dims()`` declared-shape hook."""
+    diags = []
+    for location, layer in located_layers:
+        dims = getattr(layer, "mxu_lane_dims", None)
+        if dims is None:
+            continue
+        for d in dims():
+            diag = lint_lane_dim(d, location)
+            if diag is not None:
+                diags.append(diag)
+    return diags
+
+
+def lint_dtype(dtype, location: str = "config") -> List[Diagnostic]:
+    """W102 for dtypes the MXU cannot execute natively."""
+    if dtype is None:
+        return []
+    name = str(dtype).lower()
+    if name not in NON_NATIVE_DTYPES:
+        return []
+    kind = "software-emulated" if "64" in name or name == "double" \
+        else "upcast to float32 on the MXU"
+    return [Diagnostic(
+        "DL4J-W102", Severity.WARNING, location,
+        f"dtype {dtype!r} is not TPU-native and is silently {kind}",
+        fix_hint="use float32 (or dataType('bfloat16') for the "
+                 "mixed-precision policy) — bf16/f32 are the MXU-native "
+                 "pair")]
+
+
+def lint_batch_mesh(batch_size: Optional[int], data_devices: Optional[int],
+                    location: str = "config") -> List[Diagnostic]:
+    """W103 when the global batch does not divide the data-mesh axis."""
+    if not batch_size or not data_devices or data_devices <= 1:
+        return []
+    if batch_size % data_devices == 0:
+        return []
+    return [Diagnostic(
+        "DL4J-W103", Severity.WARNING, location,
+        f"batch size {batch_size} does not divide the data-parallel mesh "
+        f"axis ({data_devices} devices) — per-device shards would be "
+        f"ragged and the sharded dispatch will pad or fail",
+        fix_hint=f"use a global batch that is a multiple of {data_devices} "
+                 f"(e.g. {((batch_size // data_devices) + 1) * data_devices})")]

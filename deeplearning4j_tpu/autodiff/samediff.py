@@ -33,14 +33,13 @@ import base64
 import json
 import os
 import zipfile
-from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.ops import registry as op_registry
-from deeplearning4j_tpu.ops import losses as loss_ops
 from deeplearning4j_tpu.train import updaters as upd
 from deeplearning4j_tpu.train.updaters import IUpdater
 

@@ -16,7 +16,7 @@ the compiled train step consumes them. Layout is NCHW float32 to match
 from __future__ import annotations
 
 import os
-from typing import Callable, List, Optional, Sequence, Tuple
+from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 

@@ -25,7 +25,7 @@ from typing import Optional, Tuple
 
 import numpy as np
 
-from deeplearning4j_tpu.data.dataset import DataSet, DataSetIterator, ListDataSetIterator
+from deeplearning4j_tpu.data.dataset import DataSet, ListDataSetIterator
 
 
 def _data_dir() -> str:

@@ -19,7 +19,7 @@ from __future__ import annotations
 import csv
 import io
 import os
-from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Union
+from typing import Any, Callable, Dict, Iterable, List, Sequence, Union
 
 import numpy as np
 

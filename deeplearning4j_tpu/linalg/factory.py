@@ -14,7 +14,7 @@ libnd4j, per SURVEY.md).
 from __future__ import annotations
 
 import threading
-from typing import Optional, Sequence
+from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -23,7 +23,7 @@ TPU-native design (NOT a port of BaseNDArray):
 
 from __future__ import annotations
 
-from typing import Any, Optional, Sequence, Tuple, Union
+from typing import Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp

@@ -6,9 +6,7 @@ function accepts NDArray or anything array-like and returns NDArray."""
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
-import numpy as np
 
 from deeplearning4j_tpu.linalg.ndarray import NDArray
 from deeplearning4j_tpu.linalg.ndarray import _unwrap as _unwrap_nd

@@ -20,7 +20,6 @@ query/key/value are fused into the single ``wqkv`` matmul.
 
 from __future__ import annotations
 
-import os
 import re
 from typing import Any, Dict, Tuple
 

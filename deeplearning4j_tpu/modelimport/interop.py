@@ -16,7 +16,7 @@ error otherwise (onnxruntime is not in this image; TF is).
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, Sequence
 
 import numpy as np
 

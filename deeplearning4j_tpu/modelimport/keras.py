@@ -25,7 +25,7 @@ Only h5py is required (no TensorFlow/Keras at import time).
 from __future__ import annotations
 
 import json
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import jax.numpy as jnp
 import numpy as np

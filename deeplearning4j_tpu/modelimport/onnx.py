@@ -16,7 +16,7 @@ inputs accepted as attrs too).
 
 from __future__ import annotations
 
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict
 
 import jax
 import jax.numpy as jnp

@@ -19,8 +19,7 @@ scaling-book recipe):
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
-from functools import partial
+from dataclasses import dataclass
 from typing import Any, Dict, Optional
 
 import jax
@@ -29,7 +28,6 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu.ops import attention as attn_ops
-from deeplearning4j_tpu.ops import losses as loss_ops
 from deeplearning4j_tpu.ops import normalization as norm_ops
 from deeplearning4j_tpu.parallel.mesh import DeviceMesh
 from deeplearning4j_tpu.parallel.sequence import ring_attention

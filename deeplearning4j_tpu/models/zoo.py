@@ -13,19 +13,18 @@ environment has no egress, so it loads from DL4J_TPU_DATA_DIR instead.
 from __future__ import annotations
 
 import os
-from typing import Optional, Tuple
+from typing import Tuple
 
 from deeplearning4j_tpu.nn.config import InputType, NeuralNetConfiguration
 from deeplearning4j_tpu.nn.graph import (ComputationGraph, ElementWiseVertex,
-                                         GraphBuilder, MergeVertex)
+                                         MergeVertex)
 from deeplearning4j_tpu.nn.layers import (ActivationLayer, BatchNormalization,
                                           ConvolutionLayer, DenseLayer,
                                           DropoutLayer, GlobalPoolingLayer,
                                           LocalResponseNormalization, LSTM,
                                           OutputLayer, RnnOutputLayer,
                                           SeparableConvolution2D,
-                                          SubsamplingLayer, Upsampling2D,
-                                          ZeroPaddingLayer)
+                                          SubsamplingLayer, Upsampling2D)
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 from deeplearning4j_tpu.train import updaters
 
@@ -896,3 +895,20 @@ class NASNet(ZooModel):
                                       activation="softmax"), "gap")
         g.setOutputs("out")
         return ComputationGraph(g.build())
+
+
+#: Name -> class registry of every shipped architecture (ref:
+#: ZooModel.select-by-name in the reference's zoo). The analysis CLI's
+#: ``--zoo`` mode lints each of these; ``all_zoo_models()`` instantiates
+#: them with default constructors.
+ZOO_MODELS = {cls.__name__: cls for cls in
+              (LeNet, SimpleCNN, AlexNet, VGG16, VGG19, ResNet50,
+               Darknet19, SqueezeNet, UNet, Xception, FaceNetNN4Small2,
+               TextGenerationLSTM, TinyYOLO, YOLO2, InceptionResNetV1,
+               NASNet)}
+
+
+def all_zoo_models():
+    """[(name, uninitialized network)] for every registered architecture
+    — configs only (``conf_builder``), no parameter allocation."""
+    return [(name, cls().conf_builder()) for name, cls in ZOO_MODELS.items()]

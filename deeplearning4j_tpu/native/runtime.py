@@ -24,7 +24,7 @@ import ctypes
 import os
 import subprocess
 import time
-from typing import List, Optional, Sequence
+from typing import List, Optional
 
 import numpy as np
 
@@ -362,6 +362,14 @@ class NativeRuntime:
         else:
             _M_CACHE_MISSES.inc()
             _M_COMPILE_SECONDS.observe(dt)
+            # recompile-churn seam: each fresh program body this client
+            # compiles is a distinct signature (steady-state training
+            # should converge on a handful)
+            from deeplearning4j_tpu.analysis import churn as _churn
+            # owner=None: an unscoped site, so every model.validate()
+            # surfaces a churning native cache (see churn.diagnostics_for)
+            _churn.get_churn_detector().record(
+                "native.compile", (hash(program), hash(opts)))
         return NativeExecutable(self, h, bool(hit.value))
 
     def close(self):

@@ -5,7 +5,7 @@
 from __future__ import annotations
 
 import re
-from typing import Callable, List, Optional
+from typing import List, Optional
 
 
 class TokenPreProcess:

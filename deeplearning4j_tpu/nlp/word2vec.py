@@ -21,15 +21,13 @@ from __future__ import annotations
 
 import os
 from collections import Counter
-from functools import partial
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from deeplearning4j_tpu.nlp.tokenization import (CommonPreprocessor,
-                                                 DefaultTokenizerFactory,
+from deeplearning4j_tpu.nlp.tokenization import (DefaultTokenizerFactory,
                                                  TokenizerFactory)
 
 

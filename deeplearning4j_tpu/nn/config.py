@@ -13,8 +13,22 @@ preprocessors (FeedForwardToCnn etc.) are inserted automatically during
 
 from __future__ import annotations
 
+import difflib
 import json
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional
+
+
+def _builder_typo(builder, name: str) -> AttributeError:
+    """Did-you-mean for builder method typos (``.updatr(...)`` used to be
+    a bare AttributeError; layer-kwarg typos get the same treatment in
+    ``nn.layers._reject_unknown_kwargs``)."""
+    options = sorted(m for m in dir(type(builder))
+                     if not m.startswith("_") and m != name)
+    close = difflib.get_close_matches(name, options, n=1)
+    hint = f" — did you mean '{close[0]}'?" if close else ""
+    return AttributeError(
+        f"{type(builder).__qualname__} has no option '{name}'{hint} "
+        f"(known options: {', '.join(options)})")
 
 
 class InputType:
@@ -146,6 +160,11 @@ class NeuralNetConfiguration:
             from deeplearning4j_tpu.nn.graph import GraphBuilder
             return GraphBuilder(self._freeze())
 
+        def __getattr__(self, name):
+            if name.startswith("_"):
+                raise AttributeError(name)
+            raise _builder_typo(self, name)
+
         def _freeze(self) -> "NeuralNetConfiguration":
             from deeplearning4j_tpu.train.updaters import Sgd
             cfg = NeuralNetConfiguration()
@@ -195,6 +214,8 @@ class ListBuilder:
         self.base = base
         self.layers: List[Any] = []
         self.input_type: Optional[InputType] = None
+        self.backprop_type: str = "standard"
+        self.tbptt_length: Optional[int] = None
 
     def layer(self, *args):
         """.layer(conf) or .layer(idx, conf)"""
@@ -209,8 +230,39 @@ class ListBuilder:
     def inputType(self, it: InputType):
         return self.setInputType(it)
 
+    def backpropType(self, kind: str, tbpttLength: int = None):
+        """ref: ListBuilder.backpropType(BackpropType.TruncatedBPTT) — the
+        config-level TBPTT declaration. Today this is a DECLARATION only:
+        the analyzer's W002 lint reads it (and serialization round-trips
+        it), but ``fit()`` does not yet segment on it — call
+        ``fitTBPTT(ds, length)`` explicitly to train truncated (auto
+        wiring is a ROADMAP follow-up)."""
+        self.backprop_type = str(kind).lower()
+        if tbpttLength is not None:
+            self.tbptt_length = int(tbpttLength)
+        return self
+
+    def tBPTTLength(self, n: int):
+        self.tbptt_length = int(n)
+        return self
+
+    def tBPTTForwardLength(self, n: int):
+        return self.tBPTTLength(n)
+
+    def tBPTTBackwardLength(self, n: int):
+        return self.tBPTTLength(n)
+
     def build(self) -> "MultiLayerConfiguration":
-        return MultiLayerConfiguration(self.base, list(self.layers), self.input_type)
+        mlc = MultiLayerConfiguration(self.base, list(self.layers),
+                                      self.input_type)
+        mlc.backprop_type = self.backprop_type
+        mlc.tbptt_length = self.tbptt_length
+        return mlc
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        raise _builder_typo(self, name)
 
 
 class MultiLayerConfiguration:
@@ -222,10 +274,21 @@ class MultiLayerConfiguration:
         self.base = base
         self.layers = layers
         self.input_type = input_type
+        self.backprop_type: str = "standard"
+        self.tbptt_length: Optional[int] = None
         self.preprocessors: Dict[int, Any] = {}
         self.layer_input_types: List[InputType] = []
         if input_type is not None:
             self._propagate_input_types()
+
+    def validate(self, batch_size: int = None,
+                 data_devices: int = None) -> "Any":
+        """Static lint of this configuration — shape/dtype propagation,
+        structural diagnostics, and TPU layout lints; returns a
+        ``deeplearning4j_tpu.analysis.ValidationReport`` (no jax work)."""
+        from deeplearning4j_tpu.analysis import analyze
+        return analyze(self, batch_size=batch_size,
+                       data_devices=data_devices)
 
     def _propagate_input_types(self):
         """InputType propagation + automatic preprocessor insertion
@@ -250,6 +313,8 @@ class MultiLayerConfiguration:
             "base": self.base.to_config(),
             "layers": [l.to_config() for l in self.layers],
             "input_type": self.input_type.to_config() if self.input_type else None,
+            "backprop_type": self.backprop_type,
+            "tbptt_length": self.tbptt_length,
         })
 
     @staticmethod
@@ -259,4 +324,7 @@ class MultiLayerConfiguration:
         base = NeuralNetConfiguration.from_config(d["base"])
         layers = [L.layer_from_config(lc) for lc in d["layers"]]
         it = InputType.from_config(d["input_type"]) if d["input_type"] else None
-        return MultiLayerConfiguration(base, layers, it)
+        mlc = MultiLayerConfiguration(base, layers, it)
+        mlc.backprop_type = d.get("backprop_type", "standard")
+        mlc.tbptt_length = d.get("tbptt_length")
+        return mlc

@@ -15,13 +15,14 @@ Multiple inputs and multiple outputs (MultiDataSet) are supported.
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu import profiler as _prof
+from deeplearning4j_tpu.analysis import churn as _churn
 from deeplearning4j_tpu.data.dataset import DataSet, DataSetIterator, MultiDataSet
 from deeplearning4j_tpu.evaluation.evaluation import Evaluation
 from deeplearning4j_tpu.nn import layers as L
@@ -31,7 +32,6 @@ from deeplearning4j_tpu.nn.multilayer import (_maybe_attach_env_profiler,
                                               _predict_batches,
                                               _process_and_apply_grads)
 from deeplearning4j_tpu.train import stepping as _stepping
-from deeplearning4j_tpu.train import updaters as upd
 from deeplearning4j_tpu.utils import environment as _environment
 
 _MASK_AWARE = (L.LSTM, L.SimpleRnn, L.Bidirectional, L.LastTimeStep,
@@ -285,6 +285,20 @@ class GraphBuilder:
     def build(self) -> "ComputationGraphConfiguration":
         return ComputationGraphConfiguration(self)
 
+    def validate(self, batch_size: int = None, data_devices: int = None):
+        """Static lint of the (possibly not-yet-buildable) graph — unlike
+        ``build()``, a cyclic or dangling graph comes back as E002/E003
+        diagnostics instead of a ValueError."""
+        from deeplearning4j_tpu.analysis import analyze
+        return analyze(self, batch_size=batch_size,
+                       data_devices=data_devices)
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        from deeplearning4j_tpu.nn.config import _builder_typo
+        raise _builder_typo(self, name)
+
 
 class ComputationGraphConfiguration:
     """ref: org.deeplearning4j.nn.conf.ComputationGraphConfiguration."""
@@ -300,6 +314,12 @@ class ComputationGraphConfiguration:
         self._toposort()
         if self.input_types:
             self._propagate_types()
+
+    def validate(self, batch_size: int = None, data_devices: int = None):
+        """Static lint — see ``deeplearning4j_tpu.analysis.analyze``."""
+        from deeplearning4j_tpu.analysis import analyze
+        return analyze(self, batch_size=batch_size,
+                       data_devices=data_devices)
 
     def _toposort(self):
         order, seen = [], set(self.graph_inputs)
@@ -383,7 +403,16 @@ class ComputationGraph:
         self._fwd_cache = None
         self._initialized = False
 
-    def init(self, seed: int = None):
+    def validate(self, batch_size: int = None, data_devices: int = None):
+        """Static lint of this graph network (configuration analysis plus
+        model-level findings) — see MultiLayerNetwork.validate."""
+        from deeplearning4j_tpu.analysis import analyze
+        return analyze(self, batch_size=batch_size,
+                       data_devices=data_devices)
+
+    def init(self, seed: int = None, strict: bool = False):
+        if strict:
+            self.validate().raise_if_errors()
         seed = self.conf.base.seed if seed is None else seed
         key = jax.random.PRNGKey(seed)
         self._params, self._states = {}, {}
@@ -618,6 +647,11 @@ class ComputationGraph:
             ins = {self.conf.graph_inputs[0]: jnp.asarray(ds.features)}
             labels = [jnp.asarray(ds.labels)]
             lmasks = [jnp.asarray(ds.labels_mask)] if ds.labels_mask is not None else None
+        # recompile-churn seam (see MultiLayerNetwork._fit_one)
+        _churn.get_churn_detector().record(
+            "ComputationGraph.fit",
+            _churn.array_fingerprint(
+                [ins[k] for k in sorted(ins)], labels, lmasks), owner=self)
         sig = lmasks is not None
         if sig not in self._train_step_cache:
             self._train_step_cache[sig] = self._make_train_step(sig)
@@ -669,6 +703,10 @@ class ComputationGraph:
             labels = [jnp.asarray(mb.labels)]
             lmasks = [jnp.asarray(mb.labels_mask)] \
                 if mb.labels_mask is not None else None
+        _churn.get_churn_detector().record(
+            "ComputationGraph.megastep",
+            _churn.array_fingerprint(
+                [ins[k] for k in sorted(ins)], labels, lmasks), owner=self)
         sig = lmasks is not None
         if (sig, k) not in self._megastep_cache:
             self._megastep_cache[(sig, k)] = self._make_train_step(sig, steps=k)

@@ -15,6 +15,8 @@ the RETAIN probability, applied to the layer's input.
 
 from __future__ import annotations
 
+import difflib
+import inspect
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -37,6 +39,43 @@ def _pair(v):
     return (int(v), int(v))
 
 
+_KNOWN_KWARGS_CACHE: Dict[type, frozenset] = {}
+
+
+def _known_kwargs(cls) -> frozenset:
+    """Every keyword a layer class's constructor chain accepts (collected
+    over the MRO so subclass kwargs and base Layer kwargs both count)."""
+    cached = _KNOWN_KWARGS_CACHE.get(cls)
+    if cached is not None:
+        return cached
+    keys = set()
+    for c in cls.__mro__:
+        init = c.__dict__.get("__init__")
+        if init is None:
+            continue
+        for name, p in inspect.signature(init).parameters.items():
+            if name == "self" or p.kind in (p.VAR_KEYWORD, p.VAR_POSITIONAL):
+                continue
+            keys.add(name)
+    cached = _KNOWN_KWARGS_CACHE[cls] = frozenset(keys)
+    return cached
+
+
+def _reject_unknown_kwargs(cls, extra: Dict[str, Any]) -> None:
+    """Typo'd/unknown config keys fail loudly with a did-you-mean instead
+    of an opaque TypeError (or, worse, silently configuring nothing)."""
+    if not extra:
+        return
+    known = sorted(_known_kwargs(cls))
+    parts = []
+    for k in sorted(extra):
+        close = difflib.get_close_matches(k, known, n=1)
+        parts.append(f"'{k}'" + (f" (did you mean '{close[0]}'?)"
+                                 if close else ""))
+    raise TypeError(f"{cls.__name__}: unknown config key(s) "
+                    f"{', '.join(parts)}; known keys: {', '.join(known)}")
+
+
 class Layer:
     """Base layer config. Subclasses define params + forward."""
 
@@ -46,7 +85,8 @@ class Layer:
     def __init__(self, nOut: int = None, nIn: int = None, activation: str = None,
                  weightInit: str = None, biasInit: float = 0.0,
                  dropOut: float = 0.0, l1: float = None, l2: float = None,
-                 name: str = None):
+                 name: str = None, **extra):
+        _reject_unknown_kwargs(type(self), extra)
         self.nOut = nOut
         self.nIn = nIn
         self.activation = activation
@@ -75,6 +115,26 @@ class Layer:
             self.nIn = it.channels
         elif self.nIn is None and it.kind == "rnn":
             self.nIn = it.size
+
+    def expected_nin(self, it: InputType) -> Optional[int]:
+        """Declared-shape hook for ``analysis/``: the nIn this layer's
+        ``infer_nin`` would derive from ``it``, computed on a throwaway
+        copy so the static linter can compare a user-declared nIn against
+        the propagated input WITHOUT mutating the config. May raise —
+        subclasses' infer_nin validates geometry (the analyzer maps the
+        exception to a diagnostic)."""
+        import copy
+        probe = copy.deepcopy(self)
+        probe.nIn = None
+        probe.infer_nin(it)
+        return probe.nIn
+
+    def mxu_lane_dims(self):
+        """Declared-shape hook for the TPU layout lints: the lane
+        (minor-most) dims of this layer's MXU matmuls. Default: nOut for
+        any param-bearing layer; elementwise param layers override to []
+        and gated recurrent layers report their fused gate width."""
+        return [self.nOut] if self.has_params and self.nOut else []
 
     def output_type(self, it: InputType) -> InputType:
         return InputType.feedForward(self.nOut)
@@ -381,6 +441,9 @@ class BatchNormalization(Layer):
         state = {"mean": jnp.zeros((n,)), "var": jnp.ones((n,))}
         return params, state
 
+    def mxu_lane_dims(self):
+        return []   # elementwise scale/shift — no matmul
+
     def apply(self, params, state, x, train, key):
         # mixed-precision island handled inside the ops: stats accumulate
         # fp32, the normalize is an FMA in x.dtype (no fp32 activation copy)
@@ -623,6 +686,9 @@ class LSTM(Layer):
         }
         return params, {}
 
+    def mxu_lane_dims(self):
+        return [4 * self.nOut] if self.nOut else []   # fused [i,f,g,o] gates
+
     def apply(self, params, state, x, train, key, mask=None):
         x_tnc = jnp.transpose(x, (2, 0, 1))  # [N,C,T] -> [T,N,C]
         mask_tn = jnp.transpose(mask, (1, 0)) if mask is not None else None
@@ -673,6 +739,9 @@ class GRU(Layer):
             "bR": jnp.zeros((3 * H,), jnp.float32),
         }
         return params, {}
+
+    def mxu_lane_dims(self):
+        return [3 * self.nOut] if self.nOut else []   # fused [r,z,n] gates
 
     def apply(self, params, state, x, train, key, mask=None):
         x_tnc = jnp.transpose(x, (2, 0, 1))
@@ -886,6 +955,9 @@ class Bidirectional(Layer):
         self.bwd.infer_nin(it)
         self.nIn = self.fwd.nIn
         self.nOut = self.fwd.nOut * (2 if self.mode == "concat" else 1)
+
+    def mxu_lane_dims(self):
+        return self.fwd.mxu_lane_dims() + self.bwd.mxu_lane_dims()
 
     def initialize(self, key):
         k1, k2 = jax.random.split(key)
@@ -1131,6 +1203,9 @@ class PReLULayer(Layer):
     def infer_nin(self, it):
         self.nIn = self.nOut = it.arrayElementsPerExample()
 
+    def mxu_lane_dims(self):
+        return []   # elementwise slope — no matmul
+
     def initialize(self, key):
         return {"alpha": jnp.full((self.nIn,), 0.25)}, {}
 
@@ -1202,6 +1277,9 @@ class LayerNorm(Layer):
         self.nIn = self.nOut = it.size if it.kind == "rnn" \
             else it.arrayElementsPerExample()
 
+    def mxu_lane_dims(self):
+        return []   # elementwise gain/bias — no matmul
+
     def initialize(self, key):
         return {"gamma": jnp.ones((self.nIn,), jnp.float32),
                 "beta": jnp.zeros((self.nIn,), jnp.float32)}, {}
@@ -1243,6 +1321,9 @@ class GroupNorm(Layer):
         if self.groups < 1 or self.nIn % self.groups:
             raise ValueError(f"GroupNorm: {self.nIn} channels not divisible "
                              f"by {self.groups} groups")
+
+    def mxu_lane_dims(self):
+        return []   # elementwise gain/bias — no matmul
 
     def initialize(self, key):
         return {"gamma": jnp.ones((self.nIn,), jnp.float32),

@@ -14,17 +14,14 @@ returning the reference's single flat contiguous parameter vector.
 
 from __future__ import annotations
 
-import io
-import json
-import os
-import zipfile
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu import profiler as _prof
+from deeplearning4j_tpu.analysis import churn as _churn
 from deeplearning4j_tpu.data.dataset import (AsyncDataSetIterator, DataSet,
                                              DataSetIterator,
                                              IterableDataSetIterator)
@@ -159,9 +156,24 @@ class MultiLayerNetwork:
         self._score = float("nan")
         self._initialized = False
 
+    # ------------------------------------------------------------ validation
+    def validate(self, batch_size: int = None, data_devices: int = None):
+        """Static lint of this network: the configuration analysis
+        (shape/dtype propagation + structural diagnostics + TPU layout
+        lints) plus model-level findings (frozen-layer/updater pairing,
+        accumulated recompile-churn W201s). Returns a
+        ``deeplearning4j_tpu.analysis.ValidationReport``; no jax work."""
+        from deeplearning4j_tpu.analysis import analyze
+        return analyze(self, batch_size=batch_size,
+                       data_devices=data_devices)
+
     # ------------------------------------------------------------------ init
-    def init(self, seed: int = None):
-        """Initialize parameters (ref: MultiLayerNetwork.init)."""
+    def init(self, seed: int = None, strict: bool = False):
+        """Initialize parameters (ref: MultiLayerNetwork.init).
+        ``strict=True`` runs the static analyzer first and raises
+        ``ModelValidationError`` on any E-code diagnostic."""
+        if strict:
+            self.validate().raise_if_errors()
         seed = self.conf.base.seed if seed is None else seed
         key = jax.random.PRNGKey(seed)
         self._params, self._states = [], []
@@ -369,6 +381,11 @@ class MultiLayerNetwork:
         y = jnp.asarray(ds.labels)
         fmask = jnp.asarray(ds.features_mask) if ds.features_mask is not None else None
         lmask = jnp.asarray(ds.labels_mask) if ds.labels_mask is not None else None
+        # recompile-churn seam: every distinct (shape, dtype) signature
+        # here is one XLA compile of the train step
+        _churn.get_churn_detector().record(
+            "MultiLayerNetwork.fit",
+            _churn.array_fingerprint(x, y, fmask, lmask), owner=self)
         sig = (fmask is not None, lmask is not None)
         if sig not in self._train_step_cache:
             self._train_step_cache[sig] = self._make_train_step(*sig)
@@ -423,6 +440,9 @@ class MultiLayerNetwork:
         y = jnp.asarray(mb.labels)
         fmask = jnp.asarray(mb.features_mask) if mb.features_mask is not None else None
         lmask = jnp.asarray(mb.labels_mask) if mb.labels_mask is not None else None
+        _churn.get_churn_detector().record(
+            "MultiLayerNetwork.megastep",
+            _churn.array_fingerprint(x, y, fmask, lmask), owner=self)
         sig = (fmask is not None, lmask is not None)
         if (sig, k) not in self._megastep_cache:
             self._megastep_cache[(sig, k)] = self._make_train_step(*sig, steps=k)

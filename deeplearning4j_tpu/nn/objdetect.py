@@ -20,7 +20,7 @@ Conventions follow the reference:
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence, Tuple
+from typing import List
 
 import jax
 import jax.numpy as jnp

@@ -14,9 +14,8 @@ trains only the head.
 from __future__ import annotations
 
 import copy
-from typing import List, Optional
+from typing import Optional
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 

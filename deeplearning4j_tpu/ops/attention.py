@@ -14,8 +14,6 @@ modern [B, T, H, D]; the reference-layout wrappers live at the bottom.
 
 from __future__ import annotations
 
-from functools import partial
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -18,10 +18,8 @@ Padding semantics follow DL4J's ``ConvolutionMode``:
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Sequence, Tuple, Union
 
-import jax
 import jax.numpy as jnp
 from jax import lax
 import numpy as np

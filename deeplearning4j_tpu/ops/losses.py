@@ -19,7 +19,6 @@ All functions take (labels, predictions) in that order, like the reference.
 
 from __future__ import annotations
 
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -10,7 +10,7 @@ stats functionally (no mutation), so the whole step stays jittable.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp

@@ -17,7 +17,6 @@ the nn layer wrappers transpose at the boundary.
 
 from __future__ import annotations
 
-from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp

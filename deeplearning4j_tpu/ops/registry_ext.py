@@ -17,7 +17,6 @@ This module is imported for its side effects at the bottom of
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp

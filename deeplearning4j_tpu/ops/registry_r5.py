@@ -30,7 +30,7 @@ Every op has a validation case in ``ops/validation_r5.py`` behind the
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence
+from typing import List, Optional
 
 import jax
 import jax.numpy as jnp

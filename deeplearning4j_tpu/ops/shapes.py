@@ -21,7 +21,7 @@ API:
 
 from __future__ import annotations
 
-from typing import Callable, Dict, Sequence, Tuple
+from typing import Callable, Dict, Tuple
 
 import jax
 import jax.numpy as jnp

@@ -14,7 +14,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.ops import registry as R
-from deeplearning4j_tpu.ops.validation import OpCase, _r, _r2, _rpos, _r2pos
+from deeplearning4j_tpu.ops.validation import OpCase, _r, _r2, _r2pos
 
 
 def _np_ctc_loss(labels, logits, label_lengths, logit_lengths, blank=0):

@@ -12,7 +12,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.ops import registry as R
-from deeplearning4j_tpu.ops.validation import OpCase, _r, _r2, _rpos, _r2pos
+from deeplearning4j_tpu.ops.validation import OpCase, _r, _r2
 
 
 def build_r5_cases() -> List[OpCase]:

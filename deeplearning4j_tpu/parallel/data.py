@@ -11,7 +11,7 @@ exactly like a single-host batch (scaling-book recipe).
 
 from __future__ import annotations
 
-from typing import Iterator, Optional
+from typing import Optional
 
 import jax
 import numpy as np

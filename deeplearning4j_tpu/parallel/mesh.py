@@ -20,7 +20,7 @@ over the reference's Aeron mesh + Spark topology (MeshOrganizer etc.).
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Sequence, Tuple
+from typing import Dict, Sequence, Tuple
 
 import jax
 import numpy as np

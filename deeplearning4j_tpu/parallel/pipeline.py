@@ -27,7 +27,6 @@ from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 try:                                    # jax <= 0.4.x
     from jax.experimental.shard_map import shard_map as _shard_map
 except ImportError:                     # newer jax: promoted to top level

@@ -19,7 +19,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 try:                                    # jax <= 0.4.x
     from jax.experimental.shard_map import shard_map
 except ImportError:                     # newer jax: promoted to top level

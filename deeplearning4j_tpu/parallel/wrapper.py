@@ -17,10 +17,8 @@ from __future__ import annotations
 import queue
 import threading
 import warnings
-from typing import Any, List, Optional
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu import profiler as _prof

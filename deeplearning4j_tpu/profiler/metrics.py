@@ -30,7 +30,7 @@ from __future__ import annotations
 import math
 import threading
 from bisect import bisect_left
-from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 # latency-shaped default: 100us .. 10s (seconds)
 DEFAULT_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
@@ -82,6 +82,14 @@ class _Metric:
     def _child(self) -> "_Metric":
         c = type(self)(self.name, self.help)
         return c
+
+    def children(self) -> Dict[Tuple[str, ...], "_Metric"]:
+        """Snapshot of the per-label-set children (empty for an
+        unlabelled family). Lets readers — the analysis CLI's churn
+        report, tests — enumerate which label sets exist without parsing
+        the text exposition."""
+        with self._lock:
+            return dict(self._children)
 
     def labels(self, *values, **kv) -> "_Metric":
         if kv:

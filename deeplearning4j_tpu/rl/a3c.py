@@ -20,13 +20,13 @@ from __future__ import annotations
 
 import queue
 import threading
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from deeplearning4j_tpu.rl.dqn import _mlp_apply, _mlp_init
+from deeplearning4j_tpu.rl.dqn import _mlp_init
 from deeplearning4j_tpu.rl.mdp import MDP
 
 

@@ -14,9 +14,8 @@ from __future__ import annotations
 import copy
 import os
 import time
-from typing import Any, Callable, List, Optional
+from typing import List
 
-import jax
 import numpy as np
 
 
@@ -192,14 +191,39 @@ class EarlyStoppingResult:
 
 class EarlyStoppingTrainer:
     """ref: EarlyStoppingTrainer (works for MultiLayerNetwork and
-    ComputationGraph — both expose fit/score)."""
+    ComputationGraph — both expose fit/score).
 
-    def __init__(self, config: EarlyStoppingConfiguration, model, train_iterator):
+    ``steps_per_dispatch=K`` routes each epoch through the megastep path
+    (ROADMAP PR-2 follow-up): K consecutive same-signature batches run as
+    ONE compiled ``lax.scan`` dispatch, with iteration termination
+    conditions scored between megabatches (the score checked after a
+    K-step dispatch is the dispatch's final per-step loss — conditions
+    fire at dispatch granularity, epoch semantics are unchanged)."""
+
+    def __init__(self, config: EarlyStoppingConfiguration, model,
+                 train_iterator, steps_per_dispatch: int = 1):
         self.config = config
         self.model = model
         self.iterator = train_iterator
+        self.steps_per_dispatch = max(int(steps_per_dispatch), 1)
+
+    def _epoch_batches(self):
+        self.iterator.reset()
+        while self.iterator.hasNext():
+            yield self.iterator.next()
+
+    def _epoch_items(self):
+        """Per-dispatch work items: plain DataSets at K=1, MegaBatches
+        (with single-step fallbacks at signature changes / epoch tails)
+        at K>1."""
+        if self.steps_per_dispatch <= 1:
+            return self._epoch_batches()
+        from deeplearning4j_tpu.train import stepping as _stepping
+        return _stepping.group_into_megabatches(self._epoch_batches(),
+                                                self.steps_per_dispatch)
 
     def fit(self) -> EarlyStoppingResult:
+        from deeplearning4j_tpu.train.stepping import MegaBatch
         cfg = self.config
         best_score = float("inf")
         best_epoch = -1
@@ -207,11 +231,13 @@ class EarlyStoppingTrainer:
         epoch = 0
         reason, details = "MaxEpochs", ""
         while True:
-            # one epoch, watching iteration conditions
-            self.iterator.reset()
+            # one epoch, watching iteration conditions between dispatches
             aborted = False
-            while self.iterator.hasNext():
-                self.model._fit_one(self.iterator.next())
+            for item in self._epoch_items():
+                if isinstance(item, MegaBatch):
+                    self.model._fit_mega(item)
+                else:
+                    self.model._fit_one(item)
                 for ic in cfg.iter_conditions:
                     if ic.terminate_iteration(self.model.score()):
                         reason = "IterationTerminationCondition"

@@ -16,7 +16,7 @@ from __future__ import annotations
 import io
 import json
 import zipfile
-from typing import Any, Dict
+from typing import Dict
 
 import jax
 import jax.numpy as jnp

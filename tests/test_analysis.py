@@ -1,0 +1,538 @@
+"""Static analyzer (ISSUE 3): one seeded misconfiguration per diagnostic
+code, clean-bill assertions over the whole model zoo + fixtures, the
+recompile-churn detector, strict init, did-you-mean kwarg rejection, the
+EarlyStoppingTrainer megastep path, the CLI, and the repo lint gate."""
+
+import ast
+import importlib.util
+import pathlib
+import subprocess
+import sys
+import warnings
+
+import numpy as np
+import pytest
+
+import deeplearning4j_tpu.analysis as analysis
+from deeplearning4j_tpu.analysis import (DIAGNOSTIC_CODES, Diagnostic,
+                                         ModelValidationError,
+                                         RecompileChurnDetector, Severity,
+                                         analyze, get_churn_detector)
+from deeplearning4j_tpu.data.dataset import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.nn.config import (InputType, MultiLayerConfiguration,
+                                          NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.graph import (ComputationGraph, ElementWiseVertex,
+                                         MergeVertex)
+from deeplearning4j_tpu.nn.layers import (ConvolutionLayer, DenseLayer, LSTM,
+                                          OutputLayer, RnnOutputLayer,
+                                          SubsamplingLayer)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.train.updaters import Adam, Sgd
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _builder(updater=None):
+    return (NeuralNetConfiguration.Builder()
+            .seed(7).updater(updater or Sgd(0.1)).weightInit("xavier"))
+
+
+def _mlp_conf(n_in=4, hidden=8, n_out=2, updater=None):
+    return (_builder(updater).list()
+            .layer(DenseLayer(nOut=hidden, activation="relu"))
+            .layer(OutputLayer(nOut=n_out, lossFunction="mcxent",
+                               activation="softmax"))
+            .setInputType(InputType.feedForward(n_in))
+            .build())
+
+
+def _graph_builder():
+    return (_builder().graphBuilder()
+            .addInputs("in")
+            .setInputTypes(InputType.feedForward(4)))
+
+
+def _one_hot(n, k=2, seed=0):
+    rng = np.random.RandomState(seed)
+    y = np.zeros((n, k), np.float32)
+    y[np.arange(n), rng.randint(0, k, n)] = 1.0
+    return y
+
+
+class TestSeededDiagnostics:
+    """Each documented code fires on its seeded misconfiguration."""
+
+    def test_e001_nin_mismatch(self):
+        conf = (_builder().list()
+                .layer(DenseLayer(nIn=300, nOut=16))
+                .layer(OutputLayer(nOut=4))
+                .setInputType(InputType.feedForward(128))
+                .build())
+        report = conf.validate()
+        assert "DL4J-E001" in report.codes()
+        assert not report.ok()
+
+    def test_e001_unresolvable_nin(self):
+        conf = (_builder().list()
+                .layer(DenseLayer(nOut=16))
+                .layer(OutputLayer(nOut=4, nIn=16))
+                .build())     # no setInputType -> nIn can't be inferred
+        assert "DL4J-E001" in conf.validate().codes()
+
+    def test_e002_cycle(self):
+        g = (_graph_builder()
+             .addLayer("a", DenseLayer(nIn=4, nOut=4), "b")
+             .addLayer("b", DenseLayer(nIn=4, nOut=4), "a")
+             .addLayer("out", OutputLayer(nIn=4, nOut=2), "b")
+             .setOutputs("out"))
+        report = g.validate()      # build() would raise; validate reports
+        assert "DL4J-E002" in report.codes()
+
+    def test_e003_undefined_input(self):
+        g = (_graph_builder()
+             .addLayer("out", OutputLayer(nIn=4, nOut=2), "nonexistent")
+             .setOutputs("out"))
+        report = g.validate()
+        assert "DL4J-E003" in report.codes()
+        assert report.errors()
+
+    def test_e003_dangling_vertex(self):
+        g = (_graph_builder()
+             .addLayer("used", DenseLayer(nOut=4), "in")
+             .addLayer("orphan", DenseLayer(nOut=4), "in")
+             .addLayer("out", OutputLayer(nOut=2), "used")
+             .setOutputs("out"))
+        report = analyze(g.build())
+        dangling = [d for d in report if d.code == "DL4J-E003"]
+        assert dangling and dangling[0].severity is Severity.WARNING
+        assert "orphan" in dangling[0].location
+
+    def test_e004_duplicate_graph_name(self):
+        g = (_graph_builder()
+             .addLayer("fc", DenseLayer(nOut=4), "in")
+             .addLayer("fc", DenseLayer(nOut=4), "in")
+             .addLayer("out", OutputLayer(nOut=2), "fc")
+             .setOutputs("out"))
+        assert "DL4J-E004" in g.validate().codes()
+
+    def test_e004_duplicate_explicit_layer_name(self):
+        conf = (_builder().list()
+                .layer(DenseLayer(nOut=8, name="fc"))
+                .layer(DenseLayer(nOut=8, name="fc"))
+                .layer(OutputLayer(nOut=2))
+                .setInputType(InputType.feedForward(4))
+                .build())
+        assert "DL4J-E004" in conf.validate().codes()
+
+    def test_e005_missing_cnn_to_dense_flatten(self):
+        conf = (_builder().list()
+                .layer(ConvolutionLayer(nIn=1, nOut=8, kernelSize=(3, 3)))
+                .layer(DenseLayer(nIn=800, nOut=10))
+                .layer(OutputLayer(nIn=10, nOut=2))
+                .build())     # no input type -> no auto preprocessor
+        assert "DL4J-E005" in conf.validate().codes()
+
+    def test_e006_elementwise_shape_conflict(self):
+        g = (_builder().graphBuilder()
+             .addInputs("in")
+             .setInputTypes(InputType.convolutional(8, 8, 3))
+             .addLayer("a", ConvolutionLayer(nOut=4, kernelSize=(1, 1)), "in")
+             .addLayer("b", ConvolutionLayer(nOut=8, kernelSize=(1, 1)), "in")
+             .addVertex("add", ElementWiseVertex("Add"), "a", "b")
+             .addLayer("out", OutputLayer(nOut=2), "add")
+             .setOutputs("out"))
+        assert "DL4J-E006" in analyze(g.build()).codes()
+
+    def test_e006_merge_spatial_conflict(self):
+        g = (_builder().graphBuilder()
+             .addInputs("in")
+             .setInputTypes(InputType.convolutional(8, 8, 3))
+             .addLayer("a", ConvolutionLayer(nOut=4, kernelSize=(1, 1)), "in")
+             .addLayer("b", ConvolutionLayer(nOut=4, kernelSize=(1, 1),
+                                             stride=(2, 2)), "in")
+             .addVertex("cat", MergeVertex(), "a", "b")
+             .addLayer("out", OutputLayer(nOut=2), "cat")
+             .setOutputs("out"))
+        assert "DL4J-E006" in analyze(g.build()).codes()
+
+    def test_e007_shape_inference_failure(self):
+        lb = (_builder().list()
+              .layer(DenseLayer())          # nOut missing
+              .layer(OutputLayer(nOut=2))
+              .setInputType(InputType.feedForward(4)))
+        assert "DL4J-E007" in analyze(lb).codes()   # unbuilt builder
+
+    def test_e008_missing_loss_head(self):
+        conf = (_builder().list()
+                .layer(DenseLayer(nOut=8))
+                .layer(DenseLayer(nOut=2))
+                .setInputType(InputType.feedForward(4))
+                .build())
+        assert "DL4J-E008" in conf.validate().codes()
+
+    def test_w001_softmax_mse(self):
+        conf = (_builder().list()
+                .layer(OutputLayer(nOut=4, lossFunction="mse",
+                                   activation="softmax"))
+                .setInputType(InputType.feedForward(4))
+                .build())
+        report = conf.validate()
+        assert "DL4J-W001" in report.codes()
+        assert report.ok()                  # warning, not error
+        assert not report.ok(warnings_as_errors=True)
+
+    def test_w001_sigmoid_multiclass(self):
+        conf = (_builder().list()
+                .layer(OutputLayer(nOut=4, lossFunction="mcxent",
+                                   activation="sigmoid"))
+                .setInputType(InputType.feedForward(4))
+                .build())
+        assert "DL4J-W001" in conf.validate().codes()
+
+    def test_w002_tbptt_without_recurrence(self):
+        conf = (_builder().list()
+                .layer(DenseLayer(nOut=8))
+                .layer(OutputLayer(nOut=2))
+                .setInputType(InputType.feedForward(4))
+                .backpropType("tbptt", 16)
+                .build())
+        assert "DL4J-W002" in conf.validate().codes()
+
+    def test_w002_absent_on_recurrent_net(self):
+        conf = (_builder().list()
+                .layer(LSTM(nOut=8))
+                .layer(RnnOutputLayer(nOut=2))
+                .setInputType(InputType.recurrent(4, 10))
+                .backpropType("tbptt", 16)
+                .build())
+        assert "DL4J-W002" not in conf.validate().codes()
+
+    def test_w003_frozen_with_stateful_updater(self):
+        net = MultiLayerNetwork(_mlp_conf(updater=Adam(1e-3)))
+        net._frozen_layers = {0}
+        report = net.validate()
+        assert "DL4J-W003" in report.codes()
+        # Sgd is stateless -> no warning
+        net2 = MultiLayerNetwork(_mlp_conf(updater=Sgd(0.1)))
+        net2._frozen_layers = {0}
+        assert "DL4J-W003" not in net2.validate().codes()
+
+    def test_w101_mxu_padding_waste(self):
+        conf = _mlp_conf(hidden=300)        # 300 -> 384 lanes, 22% dead
+        report = conf.validate()
+        w101 = [d for d in report if d.code == "DL4J-W101"]
+        assert w101 and "384" in w101[0].message
+        assert "DL4J-W101" not in _mlp_conf(hidden=512).validate().codes()
+
+    def test_w102_non_native_dtype(self):
+        conf = (_builder().dataType("float64").list()
+                .layer(OutputLayer(nOut=2))
+                .setInputType(InputType.feedForward(4))
+                .build())
+        assert "DL4J-W102" in conf.validate().codes()
+
+    def test_w103_batch_mesh_divisibility(self):
+        conf = _mlp_conf()
+        assert "DL4J-W103" in conf.validate(batch_size=6,
+                                            data_devices=4).codes()
+        assert "DL4J-W103" not in conf.validate(batch_size=8,
+                                                data_devices=4).codes()
+
+
+class TestChurnDetector:
+    def test_w201_fires_past_threshold(self):
+        from deeplearning4j_tpu.profiler.metrics import MetricsRegistry
+        reg = MetricsRegistry()
+        det = RecompileChurnDetector(threshold=3, registry=reg)
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            results = [det.record("test.site", (("shape", i),))
+                       for i in range(5)]
+        assert results[:3] == [None, None, None]
+        assert isinstance(results[3], Diagnostic)       # 4th distinct > 3
+        assert results[3].code == "DL4J-W201"
+        assert results[4] is None                       # flagged once
+        assert any("DL4J-W201" in str(w.message) for w in caught)
+        # repeats are free
+        assert det.record("test.site", (("shape", 0),)) is None
+        assert det.signature_count("test.site") == 5
+        child = reg.get("dl4j_recompiles_total").children()[("test.site",)]
+        assert child.value == 5
+        assert [d.code for d in det.diagnostics_for(None)] == ["DL4J-W201"]
+        det.reset()
+        assert det.signature_count("test.site") == 0
+
+    def test_fingerprint_shape_dtype_sensitivity(self):
+        a = np.zeros((4, 3), np.float32)
+        b = np.zeros((5, 3), np.float32)
+        c = np.zeros((4, 3), np.float64)
+        from deeplearning4j_tpu.analysis import array_fingerprint
+        assert array_fingerprint(a) != array_fingerprint(b)
+        assert array_fingerprint(a) != array_fingerprint(c)
+        assert array_fingerprint(a, None) == array_fingerprint(a, None)
+
+    def test_model_fit_churn_surfaces_in_validate(self):
+        det = get_churn_detector()
+        old_threshold = det.threshold
+        det.threshold = 3
+        try:
+            net = MultiLayerNetwork(_mlp_conf()).init()
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore", RuntimeWarning)
+                for n in (1, 2, 3, 4, 5):   # 5 distinct batch shapes
+                    net.fit(DataSet(np.random.RandomState(n)
+                                    .rand(n, 4).astype(np.float32),
+                                    _one_hot(n)))
+            report = net.validate()
+            assert "DL4J-W201" in report.codes()
+            # a fresh model has no churn findings
+            fresh = MultiLayerNetwork(_mlp_conf())
+            assert "DL4J-W201" not in fresh.validate().codes()
+        finally:
+            det.threshold = old_threshold
+
+
+class TestEntryPoints:
+    def test_strict_init_raises_on_errors(self):
+        conf = (_builder().list()
+                .layer(DenseLayer(nIn=300, nOut=16))
+                .layer(OutputLayer(nOut=4))
+                .setInputType(InputType.feedForward(128))
+                .build())
+        net = MultiLayerNetwork(conf)
+        with pytest.raises(ModelValidationError) as ei:
+            net.init(strict=True)
+        assert "DL4J-E001" in str(ei.value)
+        net.init()                          # non-strict path unchanged
+        assert net._initialized
+
+    def test_strict_init_graph(self):
+        g = (_graph_builder()
+             .addLayer("fc", DenseLayer(nOut=8), "in")
+             .addLayer("out", DenseLayer(nOut=2), "fc")   # not a loss head
+             .setOutputs("out"))
+        net = ComputationGraph(g.build())
+        with pytest.raises(ModelValidationError):
+            net.init(strict=True)
+
+    def test_strict_init_passes_clean_model(self):
+        net = MultiLayerNetwork(_mlp_conf())
+        net.init(strict=True)
+        assert net._initialized
+
+    def test_validate_runs_no_jax_trace(self):
+        # validate() on an uninitialized net must not allocate params
+        net = MultiLayerNetwork(_mlp_conf())
+        net.validate()
+        assert not net._initialized
+
+    def test_tbptt_config_roundtrip(self):
+        conf = (_builder().list()
+                .layer(LSTM(nOut=8))
+                .layer(RnnOutputLayer(nOut=2))
+                .setInputType(InputType.recurrent(4, 10))
+                .backpropType("tbptt", 16)
+                .build())
+        back = MultiLayerConfiguration.from_json(conf.to_json())
+        assert back.backprop_type == "tbptt"
+        assert back.tbptt_length == 16
+
+
+class TestDidYouMean:
+    def test_layer_kwarg_typo(self):
+        with pytest.raises(TypeError, match=r"did you mean 'nOut'"):
+            DenseLayer(nOutt=8)
+
+    def test_layer_kwarg_unknown(self):
+        with pytest.raises(TypeError, match="unknown config key"):
+            ConvolutionLayer(nOut=8, zebra=1)
+
+    def test_subclass_kwargs_still_accepted(self):
+        layer = ConvolutionLayer(nOut=8, kernelSize=(3, 3),
+                                 convolutionMode="same", hasBias=False)
+        assert layer.mode == "same" and not layer.has_bias
+
+    def test_builder_method_typo(self):
+        with pytest.raises(AttributeError, match="did you mean 'updater'"):
+            NeuralNetConfiguration.Builder().updatr(Sgd(0.1))
+
+    def test_list_builder_method_typo(self):
+        with pytest.raises(AttributeError, match="setInputType"):
+            _builder().list().setInputTyp(InputType.feedForward(4))
+
+
+class TestZooCleanBill:
+    def test_every_zoo_model_is_clean(self):
+        from deeplearning4j_tpu.models.zoo import all_zoo_models
+        for name, net in all_zoo_models():
+            report = analyze(net)
+            assert report.ok(warnings_as_errors=True), \
+                f"{name} not clean:\n{report.format()}"
+
+    def test_fixture_configs_are_clean(self):
+        fixtures = [
+            _mlp_conf(),
+            (_builder().list()
+             .layer(ConvolutionLayer(nOut=8, kernelSize=(3, 3)))
+             .layer(SubsamplingLayer(kernelSize=(2, 2), stride=(2, 2)))
+             .layer(DenseLayer(nOut=16, activation="relu"))
+             .layer(OutputLayer(nOut=2))
+             .setInputType(InputType.convolutional(12, 12, 1))
+             .build()),
+            (_builder().list()
+             .layer(LSTM(nOut=8))
+             .layer(RnnOutputLayer(nOut=3))
+             .setInputType(InputType.recurrent(5, 7))
+             .build()),
+        ]
+        for conf in fixtures:
+            report = conf.validate()
+            assert report.ok(warnings_as_errors=True), report.format()
+
+    def test_documented_code_table_is_complete(self):
+        assert len(DIAGNOSTIC_CODES) >= 10
+        for code in DIAGNOSTIC_CODES:
+            assert code.startswith("DL4J-")
+        with pytest.raises(ValueError):
+            Diagnostic("DL4J-E999", Severity.ERROR, "x", "undocumented")
+
+
+class TestPureStatic:
+    """The analyzer is jax-free: no module-scope jax imports (AST check)
+    and the package imports with jax blocked (subprocess check)."""
+
+    @staticmethod
+    def _module_scope_imports(tree):
+        out = []
+
+        def visit(stmts):
+            for node in stmts:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue          # lazy imports are fine
+                if isinstance(node, ast.Import):
+                    out.extend(a.name for a in node.names)
+                elif isinstance(node, ast.ImportFrom):
+                    out.append(node.module or "")
+                for field in ("body", "orelse", "finalbody", "handlers"):
+                    sub = getattr(node, field, None)
+                    if sub:
+                        visit([s for s in sub if isinstance(s, ast.stmt)])
+        visit(tree.body)
+        return out
+
+    def test_no_module_scope_jax_imports(self):
+        pkg = pathlib.Path(analysis.__file__).parent
+        for py in sorted(pkg.glob("*.py")):
+            tree = ast.parse(py.read_text(encoding="utf-8"))
+            for mod in self._module_scope_imports(tree):
+                root = mod.split(".")[0]
+                assert root not in ("jax", "jaxlib"), \
+                    f"{py.name} imports {mod} at module scope"
+
+    def test_analysis_package_imports_with_jax_blocked(self):
+        code = (
+            "import sys\n"
+            "sys.modules['jax'] = None\n"           # ImportError on import
+            "sys.modules['jax.numpy'] = None\n"
+            "import deeplearning4j_tpu.analysis as a\n"
+            "r = a.ValidationReport(subject='x')\n"
+            "a.get_churn_detector().record('s', ((1,), 'f32', False))\n"
+            "d = a.Diagnostic('DL4J-E001', a.Severity.ERROR, 'l', 'm')\n"
+            "print('PURE-STATIC-OK')\n")
+        proc = subprocess.run([sys.executable, "-c", code], cwd=str(REPO),
+                              capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stderr
+        assert "PURE-STATIC-OK" in proc.stdout
+
+
+class TestEarlyStoppingMegasteps:
+    def _train(self, steps_per_dispatch):
+        from deeplearning4j_tpu.train.earlystopping import (
+            DataSetLossCalculator, EarlyStoppingConfiguration,
+            EarlyStoppingTrainer, MaxEpochsTerminationCondition)
+        rng = np.random.RandomState(0)
+        train = DataSet(rng.rand(32, 4).astype(np.float32), _one_hot(32))
+        val = DataSet(rng.rand(16, 4).astype(np.float32), _one_hot(16, seed=1))
+        net = MultiLayerNetwork(_mlp_conf()).init(seed=99)
+        cfg = EarlyStoppingConfiguration.Builder() \
+            .scoreCalculator(DataSetLossCalculator(
+                ListDataSetIterator(val, 8))) \
+            .epochTerminationConditions(MaxEpochsTerminationCondition(2)) \
+            .build()
+        trainer = EarlyStoppingTrainer(
+            cfg, net, ListDataSetIterator(train, 8),
+            steps_per_dispatch=steps_per_dispatch)
+        result = trainer.fit()
+        return net, result
+
+    def test_k_step_path_matches_single_step(self):
+        net1, res1 = self._train(1)
+        net2, res2 = self._train(2)
+        assert res1.total_epochs == res2.total_epochs == 2
+        assert net1._iteration == net2._iteration == 8   # 4 batches x 2
+        np.testing.assert_allclose(np.asarray(net1.params()),
+                                   np.asarray(net2.params()),
+                                   rtol=0, atol=0)       # bit-exact
+        assert res2.best_score == pytest.approx(res1.best_score)
+
+    def test_iteration_condition_checked_between_dispatches(self):
+        from deeplearning4j_tpu.train.earlystopping import (
+            DataSetLossCalculator, EarlyStoppingConfiguration,
+            EarlyStoppingTrainer, MaxEpochsTerminationCondition,
+            MaxScoreIterationTerminationCondition)
+        rng = np.random.RandomState(0)
+        train = DataSet(rng.rand(32, 4).astype(np.float32), _one_hot(32))
+        net = MultiLayerNetwork(_mlp_conf()).init(seed=99)
+        cfg = EarlyStoppingConfiguration.Builder() \
+            .scoreCalculator(DataSetLossCalculator(
+                ListDataSetIterator(train, 8))) \
+            .epochTerminationConditions(MaxEpochsTerminationCondition(3)) \
+            .iterationTerminationConditions(
+                MaxScoreIterationTerminationCondition(-1.0)) \
+            .build()
+        result = EarlyStoppingTrainer(cfg, net,
+                                      ListDataSetIterator(train, 8),
+                                      steps_per_dispatch=2).fit()
+        assert result.termination_reason == "IterationTerminationCondition"
+        assert net._iteration == 2      # one 2-step dispatch, then stop
+
+
+class TestCli:
+    def test_zoo_lint_exits_zero(self, capsys):
+        from deeplearning4j_tpu.analysis.__main__ import main
+        assert main(["--zoo"]) == 0
+        out = capsys.readouterr().out
+        assert "16 model(s) linted: 16 clean" in out
+
+    def test_single_model_by_name(self, capsys):
+        from deeplearning4j_tpu.analysis.__main__ import main
+        assert main(["LeNet"]) == 0
+        assert "LeNet: clean" in capsys.readouterr().out
+
+    def test_findings_fail_the_exit_code(self, capsys, tmp_path,
+                                         monkeypatch):
+        mod = tmp_path / "badmodel.py"
+        mod.write_text(
+            "from deeplearning4j_tpu.nn.config import (InputType,\n"
+            "    NeuralNetConfiguration)\n"
+            "from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer\n"
+            "conf = (NeuralNetConfiguration.Builder().list()\n"
+            "        .layer(DenseLayer(nIn=300, nOut=16))\n"
+            "        .layer(OutputLayer(nOut=4))\n"
+            "        .setInputType(InputType.feedForward(128))\n"
+            "        .build())\n")
+        monkeypatch.syspath_prepend(str(tmp_path))
+        from deeplearning4j_tpu.analysis.__main__ import main
+        assert main(["badmodel:conf"]) == 1
+        assert "DL4J-E001" in capsys.readouterr().out
+
+
+class TestRepoLintGate:
+    def test_repo_lints_clean(self, capsys):
+        spec = importlib.util.spec_from_file_location(
+            "repo_lint", REPO / "tools" / "lint.py")
+        lint = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(lint)
+        rc = lint.run_fallback(lint.DEFAULT_PATHS)
+        out = capsys.readouterr().out
+        assert rc == 0, f"repo lint found issues:\n{out}"

@@ -9,10 +9,12 @@ import jax.numpy as jnp
 
 from deeplearning4j_tpu.data.dataset import DataSet
 from deeplearning4j_tpu.nn.config import NeuralNetConfiguration, InputType
-from deeplearning4j_tpu.nn.layers import (
-    DenseLayer, GlobalPoolingLayer, LearnedSelfAttentionLayer, OutputLayer,
-    RecurrentAttentionLayer, RnnOutputLayer, SameDiffLayer,
-    SelfAttentionLayer)
+from deeplearning4j_tpu.nn.layers import (GlobalPoolingLayer,
+                                          LearnedSelfAttentionLayer,
+                                          OutputLayer,
+                                          RecurrentAttentionLayer,
+                                          RnnOutputLayer, SameDiffLayer,
+                                          SelfAttentionLayer)
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 from deeplearning4j_tpu.train import updaters
 

@@ -5,7 +5,6 @@ feature sanity, reader + iterator feeding a Conv1D classifier."""
 import os
 
 import numpy as np
-import pytest
 
 from deeplearning4j_tpu.data.audio import (AudioDataSetIterator,
                                            WavFileRecordReader, mel_filterbank,

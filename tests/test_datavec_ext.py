@@ -2,15 +2,15 @@
 the end-to-end CSV → join → sequence window → iterator → fit pipeline
 (VERDICT r3 #6; ref: org.datavec.api.transform.*)."""
 
-import os
 
 import numpy as np
 import pytest
 
-from deeplearning4j_tpu.data.records import (
-    CollectionRecordReader, CollectionSequenceRecordReader, ColumnType,
-    CSVRecordReader, Join, Reducer, Schema,
-    SequenceRecordReaderDataSetIterator, TransformProcess, executeJoin)
+from deeplearning4j_tpu.data.records import (CollectionSequenceRecordReader,
+                                             CSVRecordReader, Join, Reducer,
+                                             Schema,
+                                             SequenceRecordReaderDataSetIterator,
+                                             TransformProcess, executeJoin)
 
 
 def _schema(*cols):

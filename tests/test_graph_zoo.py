@@ -5,7 +5,6 @@ model and run a tiny forward pass; graph tests check vertices/DAG wiring;
 YOLO loss/NMS sanity.
 """
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -15,10 +14,8 @@ from deeplearning4j_tpu.nn import InputType, NeuralNetConfiguration
 from deeplearning4j_tpu.nn.graph import (ComputationGraph, ElementWiseVertex,
                                          L2NormalizeVertex, MergeVertex,
                                          SubsetVertex)
-from deeplearning4j_tpu.nn.layers import (ActivationLayer, BatchNormalization,
-                                          ConvolutionLayer, DenseLayer,
-                                          GlobalPoolingLayer, OutputLayer,
-                                          SubsamplingLayer)
+from deeplearning4j_tpu.nn.layers import (ConvolutionLayer, DenseLayer,
+                                          OutputLayer)
 from deeplearning4j_tpu.nn.objdetect import (DetectedObject, Yolo2OutputLayer,
                                              YoloUtils)
 from deeplearning4j_tpu.models import zoo

@@ -15,13 +15,19 @@ import pytest
 PIL = pytest.importorskip("PIL")
 from PIL import Image  # noqa: E402
 
-from deeplearning4j_tpu.data.image import (  # noqa: E402
-    BrightnessTransform, ColorConversionTransform, CropImageTransform,
-    FlipImageTransform, ImageRecordReader, ImageRecordReaderDataSetIterator,
-    NativeImageLoader, ObjectDetectionDataSetIterator,
-    ObjectDetectionRecordReader, ParentPathLabelGenerator,
-    PipelineImageTransform, ResizeImageTransform, RotateImageTransform,
-    ScaleImageTransform)
+from deeplearning4j_tpu.data.image import (BrightnessTransform,
+                                           ColorConversionTransform,
+                                           CropImageTransform,
+                                           FlipImageTransform,
+                                           ImageRecordReader,
+                                           ImageRecordReaderDataSetIterator,
+                                           NativeImageLoader,
+                                           ObjectDetectionDataSetIterator,
+                                           ObjectDetectionRecordReader,
+                                           PipelineImageTransform,
+                                           ResizeImageTransform,
+                                           RotateImageTransform,
+                                           ScaleImageTransform)
 
 
 def _write_image(path, hw=(24, 24), color=(255, 0, 0)):

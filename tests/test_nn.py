@@ -8,18 +8,15 @@ accuracy, save-load exact-parity round trips.
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from deeplearning4j_tpu.data import (DataSet, IrisDataSetIterator,
                                      ListDataSetIterator, MnistDataSetIterator,
                                      NormalizerStandardize, AsyncDataSetIterator)
-from deeplearning4j_tpu.evaluation import Evaluation
 from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
                                    NeuralNetConfiguration)
 from deeplearning4j_tpu.nn.layers import (ActivationLayer, BatchNormalization,
                                           Bidirectional, ConvolutionLayer,
                                           DenseLayer, DropoutLayer,
-                                          EmbeddingSequenceLayer,
                                           GlobalPoolingLayer, LastTimeStep,
                                           LSTM, OutputLayer, RnnOutputLayer,
                                           SimpleRnn, SubsamplingLayer)

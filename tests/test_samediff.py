@@ -4,7 +4,6 @@ Reference test-strategy parity (SURVEY.md §4): eager-vs-graph equality,
 numeric gradient checks, serialization round-trips, training convergence.
 """
 
-import os
 
 import jax
 import jax.numpy as jnp

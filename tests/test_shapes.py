@@ -7,7 +7,6 @@ import pytest
 pytestmark = pytest.mark.quick
 import jax.numpy as jnp
 
-from deeplearning4j_tpu.ops import shapes as S
 from deeplearning4j_tpu.ops.shapes import OpShapeError, infer_shape
 
 

@@ -1,7 +1,6 @@
 """Transfer learning, early stopping, streaming RNN (rnnTimeStep/tBPTT)."""
 
 import numpy as np
-import pytest
 
 from deeplearning4j_tpu.data import DataSet, IrisDataSetIterator, ListDataSetIterator, NormalizerStandardize
 from deeplearning4j_tpu.nn import InputType, MultiLayerNetwork, NeuralNetConfiguration

@@ -1,0 +1,206 @@
+#!/usr/bin/env python
+"""Repo linter — the tier-1 flow's "repo lints itself" gate.
+
+Prefers ``ruff`` (config in pyproject.toml: pyflakes + bugbear) when the
+binary is installed; this container ships no linter, so the default path
+is a dependency-free AST fallback implementing the highest-signal subset
+of the same rules:
+
+- ``F401``  module-level import bound but never used (skipped in
+  ``__init__.py`` re-export surfaces)
+- ``F632``  ``is``/``is not`` comparison against a str/int/tuple literal
+- ``F811``  module-level def/class silently redefining an earlier one
+- ``B006``  mutable default argument ([], {}, set()/list()/dict())
+- ``E722``  bare ``except:``
+
+``# noqa`` (bare, or ``# noqa: F401,...``) on the flagged line suppresses
+a finding, matching ruff semantics, so both linters agree on the same
+annotations. Exit status 0 = clean.
+
+Usage: ``python tools/lint.py [paths...]`` (default: the package, tests,
+tools, benchmarks). ``--fallback`` forces the AST linter even when ruff
+exists (what the test suite pins).
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import re
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+DEFAULT_PATHS = ["deeplearning4j_tpu", "tests", "tools", "benchmarks",
+                 "bench.py"]
+
+_NOQA_RE = re.compile(r"#\s*noqa(?::\s*(?P<codes>[A-Z0-9, ]+))?", re.I)
+_WORD_RE = re.compile(r"[A-Za-z_][A-Za-z0-9_]*")
+
+
+class Finding:
+    def __init__(self, path: Path, line: int, code: str, message: str):
+        self.path, self.line, self.code, self.message = path, line, code, message
+
+    def __str__(self):
+        return f"{self.path}:{self.line}: {self.code} {self.message}"
+
+
+def _noqa_lines(source: str):
+    """line number -> set of suppressed codes (empty set = suppress all)."""
+    out = {}
+    for i, line in enumerate(source.splitlines(), 1):
+        m = _NOQA_RE.search(line)
+        if m:
+            codes = m.group("codes")
+            out[i] = {c.strip().upper() for c in codes.split(",")} \
+                if codes else set()
+    return out
+
+
+def _used_names(tree: ast.AST):
+    """Every identifier the module can plausibly reference: Name loads,
+    plus word tokens inside string constants (quoted annotations,
+    __all__ entries, forward references)."""
+    used = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Constant) and isinstance(node.value, str) \
+                and len(node.value) < 200:
+            used.update(_WORD_RE.findall(node.value))
+        elif isinstance(node, ast.Global):
+            used.update(node.names)
+    return used
+
+
+def _check_f401(tree, path: Path, findings):
+    if path.name == "__init__.py":
+        return
+    used = _used_names(tree)
+    for node in tree.body:                       # module level only
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                bound = alias.asname or alias.name.split(".")[0]
+                if bound not in used:
+                    findings.append(Finding(
+                        path, node.lineno, "F401",
+                        f"'{alias.name}' imported but unused"))
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "__future__":
+                continue
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                bound = alias.asname or alias.name
+                if bound not in used:
+                    findings.append(Finding(
+                        path, node.lineno, "F401",
+                        f"'{node.module}.{alias.name}' imported but unused"))
+
+
+def _check_f811(tree, path: Path, findings):
+    seen = {}
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            if node.name in seen:
+                findings.append(Finding(
+                    path, node.lineno, "F811",
+                    f"redefinition of '{node.name}' from line "
+                    f"{seen[node.name]}"))
+            seen[node.name] = node.lineno
+
+
+def _check_f632(tree, path: Path, findings):
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Compare):
+            continue
+        for op, comp in zip(node.ops, node.comparators):
+            if isinstance(op, (ast.Is, ast.IsNot)) and \
+                    isinstance(comp, ast.Constant) and \
+                    isinstance(comp.value, (str, int, bytes)) and \
+                    not isinstance(comp.value, bool):
+                findings.append(Finding(
+                    path, node.lineno, "F632",
+                    "use == / != to compare with literals, not 'is'"))
+
+
+def _check_b006(tree, path: Path, findings):
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        defaults = list(node.args.defaults) + \
+            [d for d in node.args.kw_defaults if d is not None]
+        for d in defaults:
+            mutable = isinstance(d, (ast.List, ast.Dict, ast.Set)) or (
+                isinstance(d, ast.Call) and isinstance(d.func, ast.Name)
+                and d.func.id in ("list", "dict", "set") and not d.args
+                and not d.keywords)
+            if mutable:
+                findings.append(Finding(
+                    path, d.lineno, "B006",
+                    f"mutable default argument in '{node.name}' — use "
+                    f"None and create inside the function"))
+
+
+def _check_e722(tree, path: Path, findings):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            findings.append(Finding(path, node.lineno, "E722",
+                                    "bare 'except:' — name the exception"))
+
+
+def lint_file(path: Path):
+    source = path.read_text(encoding="utf-8")
+    try:
+        tree = ast.parse(source, filename=str(path))
+    except SyntaxError as e:
+        return [Finding(path, e.lineno or 0, "E999", f"syntax error: {e.msg}")]
+    findings = []
+    for check in (_check_f401, _check_f811, _check_f632, _check_b006,
+                  _check_e722):
+        check(tree, path, findings)
+    noqa = _noqa_lines(source)
+    return [f for f in findings
+            if not (f.line in noqa and
+                    (not noqa[f.line] or f.code in noqa[f.line]))]
+
+
+def iter_py_files(paths):
+    for p in paths:
+        p = (REPO / p) if not Path(p).is_absolute() else Path(p)
+        if p.is_file() and p.suffix == ".py":
+            yield p
+        elif p.is_dir():
+            yield from sorted(p.rglob("*.py"))
+
+
+def run_fallback(paths) -> int:
+    findings = []
+    for f in iter_py_files(paths):
+        findings.extend(lint_file(f))
+    for f in findings:
+        print(f)
+    n = len(findings)
+    print(f"lint (ast fallback): {n} finding(s)" if n
+          else "lint (ast fallback): clean")
+    return 1 if findings else 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*", default=None)
+    ap.add_argument("--fallback", action="store_true",
+                    help="force the AST fallback even when ruff is on PATH")
+    args = ap.parse_args(argv)
+    paths = args.paths or DEFAULT_PATHS
+    if not args.fallback and shutil.which("ruff"):
+        return subprocess.call(["ruff", "check", *paths], cwd=REPO)
+    return run_fallback(paths)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
