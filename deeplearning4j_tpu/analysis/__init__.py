@@ -15,13 +15,24 @@ validation is the same shape of tool):
   without recurrence, ``W003`` frozen layers + stateful updater).
 - :mod:`layout` — TPU layout lints: ``W101`` MXU tile-padding waste,
   ``W102`` non-native dtypes, ``W103`` batch vs. data-mesh divisibility.
+- :mod:`distribution` — mesh/sharding/pipeline lints against a declared
+  :class:`MeshSpec`: ``E101`` batch vs. data axis, ``E102`` absent mesh
+  axis, ``E103`` pipeline-split weight tie, ``E104`` per-device HBM
+  budget, ``W104`` replicated giant, ``W105`` pipeline FLOP imbalance,
+  ``W106`` sub-MXU shard, ``W107`` per-layer collective volume.
+- :mod:`samediff` — recorded-op-graph lints (``sd.validate()``): shape
+  propagation over ``_Node`` graphs plus ``E151`` undefined input,
+  ``E152`` shape conflict, ``E153`` bad loss variable, ``W151`` dangling
+  placeholder, ``W152`` unused variable, ``W153`` no training op.
 - :mod:`churn` — runtime detector behind the fit/compile dispatch seams:
   ``dl4j_recompiles_total{site=...}`` in the profiler registry plus a
   ``W201`` diagnostic when one site crosses the signature threshold.
 
-Entry points: ``config.validate()`` / ``model.validate()``,
-``init(strict=True)`` (raises :class:`ModelValidationError` on E-codes),
-and ``python -m deeplearning4j_tpu.analysis [--zoo | <model-or-module>]``.
+Entry points: ``config.validate()`` / ``model.validate()`` /
+``sd.validate()`` (all accept ``mesh=...``, ``suppress=[...]``,
+``severity_overrides={...}``), ``init(strict=True)`` (raises
+:class:`ModelValidationError` on E-codes), and ``python -m
+deeplearning4j_tpu.analysis [--zoo | <model-or-module>] [--mesh data=8]``.
 
 The package imports no jax at module scope (pinned by a test) — analysis
 is pure-static and runs anywhere the configs import.
@@ -35,10 +46,14 @@ from deeplearning4j_tpu.analysis.diagnostics import (DIAGNOSTIC_CODES,
                                                      Diagnostic,
                                                      ModelValidationError,
                                                      Severity,
-                                                     ValidationReport)
+                                                     ValidationReport,
+                                                     normalize_code)
+from deeplearning4j_tpu.analysis.distribution import MeshSpec, PipelineSpec
+from deeplearning4j_tpu.analysis.samediff import analyze_samediff
 
 __all__ = [
-    "analyze", "Diagnostic", "Severity", "ValidationReport",
-    "ModelValidationError", "DIAGNOSTIC_CODES", "RecompileChurnDetector",
+    "analyze", "analyze_samediff", "Diagnostic", "Severity",
+    "ValidationReport", "ModelValidationError", "DIAGNOSTIC_CODES",
+    "MeshSpec", "PipelineSpec", "normalize_code", "RecompileChurnDetector",
     "get_churn_detector", "array_fingerprint",
 ]

@@ -24,7 +24,9 @@ import sys
 from typing import List, Tuple
 
 from deeplearning4j_tpu.analysis.analyzer import analyze
-from deeplearning4j_tpu.analysis.diagnostics import ValidationReport
+from deeplearning4j_tpu.analysis.diagnostics import (ValidationReport,
+                                                     _normalize_severity,
+                                                     normalize_code)
 
 
 def _zoo_registry():
@@ -91,12 +93,48 @@ def main(argv=None) -> int:
                     help="lint every model-zoo architecture")
     ap.add_argument("--batch-size", type=int, default=None,
                     help="planned global batch size (enables the W103 "
-                         "mesh-divisibility lint)")
+                         "mesh-divisibility lint, or E101 with --mesh)")
     ap.add_argument("--devices", type=int, default=None,
                     help="data-parallel mesh axis size for W103")
+    ap.add_argument("--mesh", default=None, metavar="AXES",
+                    help="declared device mesh, e.g. 'data=8' or "
+                         "'data=4,model=2' — enables the E1xx/W10x "
+                         "distribution lints")
+    ap.add_argument("--hbm-gb", type=float, default=None,
+                    help="per-device HBM budget in GiB for the E104 "
+                         "parameter-footprint check (default 16)")
+    ap.add_argument("--suppress", action="append", default=[],
+                    metavar="CODES",
+                    help="suppress diagnostic codes (comma-separated or "
+                         "repeated), e.g. --suppress W101,DL4J-W107 — the "
+                         "'# dl4j: noqa=W101' equivalent for the CLI")
+    ap.add_argument("--severity", action="append", default=[],
+                    metavar="CODE=LEVEL",
+                    help="override a code's severity, e.g. --severity "
+                         "W104=error or --severity E101=warning "
+                         "(levels: info, warning, error; repeatable)")
     ap.add_argument("--warnings-ok", action="store_true",
                     help="exit 0 even when warnings (W-codes) were found")
     args = ap.parse_args(argv)
+
+    # validate the per-code config up front — a typo'd code must be a
+    # clean usage error, not a traceback halfway through a --zoo run
+    try:
+        suppress = [normalize_code(c) for chunk in args.suppress
+                    for c in chunk.split(",") if c]
+    except ValueError as e:
+        ap.error(f"--suppress: {e}")
+    overrides = {}
+    for spec in args.severity:
+        code, eq, level = spec.partition("=")
+        if not eq or not code or not level:
+            ap.error(f"--severity expects CODE=LEVEL, got {spec!r}")
+        try:
+            overrides[normalize_code(code)] = _normalize_severity(level)
+        except ValueError as e:
+            ap.error(f"--severity: {e}")
+    if args.hbm_gb is not None and not args.mesh:
+        ap.error("--hbm-gb needs a mesh declaration: pass --mesh as well")
 
     targets: List[Tuple[str, object]] = []
     if args.zoo:
@@ -113,7 +151,9 @@ def main(argv=None) -> int:
     total = ValidationReport()
     for name, obj in targets:
         report = analyze(obj, batch_size=args.batch_size,
-                         data_devices=args.devices)
+                         data_devices=args.devices, mesh=args.mesh,
+                         hbm_gb=args.hbm_gb, suppress=suppress,
+                         severity_overrides=overrides)
         report.subject = name
         total.extend(report.diagnostics)
         print(report.format())

@@ -21,9 +21,11 @@ from __future__ import annotations
 
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from deeplearning4j_tpu.analysis import distribution as _dist
 from deeplearning4j_tpu.analysis import layout as _layout
 from deeplearning4j_tpu.analysis.diagnostics import (Diagnostic, Severity,
                                                      ValidationReport)
+from deeplearning4j_tpu.analysis.distribution import MeshSpec
 
 #: Loss functions that assume unbounded/regression outputs — pairing one
 #: with softmax collapses the gradient signal (ref: DL4J's
@@ -32,25 +34,63 @@ _REGRESSION_LOSSES = {"mse", "l2", "l1", "mae", "squaredloss", "huber"}
 
 
 def analyze(target, batch_size: Optional[int] = None,
-            data_devices: Optional[int] = None) -> ValidationReport:
-    """Analyze a configuration, builder, or network.
+            data_devices: Optional[int] = None, mesh=None, sharding=None,
+            pipeline=None, hbm_gb: Optional[float] = None,
+            suppress=None, severity_overrides=None) -> ValidationReport:
+    """Analyze a configuration, builder, network, or SameDiff graph.
 
     ``batch_size``/``data_devices`` feed the W103 mesh-divisibility lint
     (both optional — pass the planned global batch and the size of the
-    ``parallel/`` data axis when known).
+    ``parallel/`` data axis when known). ``mesh`` (a
+    :class:`~deeplearning4j_tpu.analysis.distribution.MeshSpec`, an
+    ``{axis: size}`` dict, a ``"data=8,model=2"`` string, or a runtime
+    ``DeviceMesh``) switches on the E1xx/W10x distribution lints;
+    ``sharding`` (``ShardingRule`` or {regex: spec}), ``pipeline``
+    (``PipelineSpec``/stage count), and ``hbm_gb`` refine them.
+    ``suppress``/``severity_overrides`` shape the report per code
+    (:meth:`ValidationReport.apply_config`).
     """
     conf = getattr(target, "conf", target)
-    if hasattr(conf, "graph_inputs") and hasattr(conf, "nodes"):
-        report = _analyze_graph(conf, batch_size, data_devices)
+    mesh_spec = _mesh_spec(mesh, sharding, pipeline, hbm_gb)
+    if hasattr(conf, "_nodes") and hasattr(conf, "_placeholders"):
+        if mesh_spec is not None:
+            raise ValueError(
+                "the distribution lints (mesh=/sharding=/pipeline=/"
+                "hbm_gb=) apply to layer configurations, not SameDiff "
+                "graphs — recorded op graphs carry no per-layer shard "
+                "declaration to check yet")
+        from deeplearning4j_tpu.analysis.samediff import analyze_samediff
+        report = analyze_samediff(conf, batch_size=batch_size or 1)
+    elif hasattr(conf, "graph_inputs") and hasattr(conf, "nodes"):
+        report = _analyze_graph(conf, batch_size, data_devices, mesh_spec)
     elif hasattr(conf, "layers") and hasattr(conf, "base"):
-        report = _analyze_multilayer(conf, batch_size, data_devices)
+        report = _analyze_multilayer(conf, batch_size, data_devices,
+                                     mesh_spec)
     else:
         raise TypeError(f"cannot analyze {type(target).__name__}: expected a "
                         "MultiLayerConfiguration, ComputationGraph"
-                        "Configuration, one of their builders, or a network")
+                        "Configuration, one of their builders, a network, "
+                        "or a SameDiff graph")
     if target is not conf:                       # a network: add model-level
         report.extend(_model_checks(target))
-    return report
+    return report.apply_config(suppress, severity_overrides)
+
+
+def _mesh_spec(mesh, sharding, pipeline, hbm_gb) -> Optional[MeshSpec]:
+    spec = MeshSpec.coerce(mesh)
+    if spec is None:
+        if sharding is not None or pipeline is not None \
+                or hbm_gb is not None:
+            raise ValueError("sharding/pipeline/hbm_gb lints need a mesh "
+                             "declaration — pass mesh=... as well")
+        return None
+    if sharding is not None or pipeline is not None or hbm_gb is not None:
+        spec = MeshSpec(
+            spec.axes, data_axis=spec.data_axis,
+            sharding=sharding if sharding is not None else spec.sharding,
+            pipeline=pipeline if pipeline is not None else spec.pipeline,
+            hbm_gb=hbm_gb if hbm_gb is not None else spec.hbm_gb)
+    return spec
 
 
 def _model_checks(net) -> List[Diagnostic]:
@@ -97,7 +137,8 @@ def _layer_loc(i: int, layer) -> str:
     return f"layer {i} ({cls})"
 
 
-def _analyze_multilayer(conf, batch_size, data_devices) -> ValidationReport:
+def _analyze_multilayer(conf, batch_size, data_devices,
+                        mesh: Optional[MeshSpec] = None) -> ValidationReport:
     report = ValidationReport(subject="MultiLayerConfiguration")
     layers = list(conf.layers)
     preprocessors = dict(getattr(conf, "preprocessors", {}) or {})
@@ -136,7 +177,10 @@ def _analyze_multilayer(conf, batch_size, data_devices) -> ValidationReport:
         (_layer_loc(i, l), l) for i, l in enumerate(layers)))
     report.extend(_layout.lint_dtype(
         getattr(conf.base, "dtype", None)))
-    report.extend(_layout.lint_batch_mesh(batch_size, data_devices))
+    if mesh is not None:
+        report.extend(_dist.lint_multilayer(conf, mesh, batch_size))
+    else:
+        report.extend(_layout.lint_batch_mesh(batch_size, data_devices))
     return report
 
 
@@ -309,7 +353,8 @@ def _node_loc(node) -> str:
     return f"'{node.name}' ({type(node.obj).__name__})"
 
 
-def _analyze_graph(conf, batch_size, data_devices) -> ValidationReport:
+def _analyze_graph(conf, batch_size, data_devices,
+                   mesh: Optional[MeshSpec] = None) -> ValidationReport:
     report = ValidationReport(subject="ComputationGraphConfiguration")
     nodes = list(conf.nodes)
     inputs = list(conf.graph_inputs)
@@ -375,7 +420,10 @@ def _analyze_graph(conf, batch_size, data_devices) -> ValidationReport:
     report.extend(_layout.lint_layers(
         (_node_loc(n), n.obj) for n in nodes if n.kind == "layer"))
     report.extend(_layout.lint_dtype(getattr(conf.base, "dtype", None)))
-    report.extend(_layout.lint_batch_mesh(batch_size, data_devices))
+    if mesh is not None:
+        report.extend(_dist.lint_graph(conf, mesh, batch_size))
+    else:
+        report.extend(_layout.lint_batch_mesh(batch_size, data_devices))
     return report
 
 

@@ -65,7 +65,64 @@ DIAGNOSTIC_CODES = {
                  "so per-device batches would be ragged",
     "DL4J-W201": "recompile churn: one dispatch site compiled more than N "
                  "distinct jit signatures (shifting shapes/dtypes)",
+    # E1xx/W10x distribution lints (analysis/distribution.py): statically
+    # decidable from config + mesh declaration alone, before any compile.
+    "DL4J-E101": "batch/mesh mismatch: the global batch size does not "
+                 "divide the declared data-parallel mesh axis",
+    "DL4J-E102": "mesh axis mismatch: a sharding rule or parallel "
+                 "declaration names a mesh axis that is absent (or sized "
+                 "differently than the declaration requires)",
+    "DL4J-E103": "pipeline tie split: a pipeline stage boundary separates "
+                 "two weight-tied layers onto different stages",
+    "DL4J-E104": "HBM budget exceeded: the per-device parameter footprint "
+                 "(shards + replicated tensors) exceeds the configured "
+                 "per-device HBM budget",
+    "DL4J-W104": "replicated giant: a large parameter tensor is fully "
+                 "replicated although the mesh declares a non-trivial "
+                 "model axis it could shard over",
+    "DL4J-W105": "pipeline imbalance: per-stage FLOP estimates differ "
+                 "beyond tolerance, so the slowest stage gates every tick",
+    "DL4J-W106": "sub-MXU shard: a sharding rule splits a parameter's "
+                 "lane dim below one 8x128 MXU tile per device (or leaves "
+                 "it non-divisible, forcing padding)",
+    "DL4J-W107": "collective volume: a single layer's estimated gradient "
+                 "allreduce payload per step exceeds the threshold",
+    # E15x/W15x SameDiff graph lints (analysis/samediff.py).
+    "DL4J-E151": "undefined graph input: an op node consumes a name no "
+                 "variable, constant, placeholder, or node output defines",
+    "DL4J-E152": "graph shape conflict: static shape propagation over the "
+                 "recorded op graph found incompatible operand shapes",
+    "DL4J-E153": "bad loss variable: setLossVariables names a variable "
+                 "that does not exist in the graph",
+    "DL4J-W151": "dangling placeholder: a placeholder no recorded op "
+                 "consumes (every output() still requires feeding it)",
+    "DL4J-W152": "unused variable: a trainable variable no loss output "
+                 "depends on (it gets zero gradient every step)",
+    "DL4J-W153": "no training op: a TrainingConfig is set but no loss "
+                 "variables are marked, so fit() has nothing to minimize",
 }
+
+
+def normalize_code(code: str) -> str:
+    """Accept both spellings everywhere codes are configured:
+    ``"W101"``/``"w101"`` and the full ``"DL4J-W101"``."""
+    code = str(code).strip().upper()
+    if not code.startswith("DL4J-"):
+        code = "DL4J-" + code
+    if code not in DIAGNOSTIC_CODES:
+        raise ValueError(f"unknown diagnostic code {code!r} (documented: "
+                         f"{', '.join(sorted(DIAGNOSTIC_CODES))})")
+    return code
+
+
+def _normalize_severity(value) -> "Severity":
+    if isinstance(value, Severity):
+        return value
+    try:
+        return Severity[str(value).strip().upper()]
+    except KeyError:
+        raise ValueError(f"unknown severity {value!r} (use one of "
+                         f"{[s.name.lower() for s in Severity]})") from None
 
 
 class Diagnostic:
@@ -108,6 +165,28 @@ class ValidationReport:
 
     def extend(self, diags: Iterable[Diagnostic]) -> None:
         self.diagnostics.extend(diags)
+
+    def apply_config(self, suppress: Iterable[str] = None,
+                     severity_overrides=None) -> "ValidationReport":
+        """Per-code report shaping (the flake8-noqa equivalent for model
+        lints): drop every diagnostic whose code is in ``suppress``, and
+        re-grade codes named in ``severity_overrides`` ({code: severity},
+        severity as a :class:`Severity` or its name). Codes accept both
+        the short (``"W101"``) and full (``"DL4J-W101"``) spelling.
+        Mutates and returns the report (so ``validate(...)`` chains)."""
+        if suppress:
+            if isinstance(suppress, str):
+                suppress = [suppress]
+            dropped = {normalize_code(c) for c in suppress}
+            self.diagnostics = [d for d in self.diagnostics
+                                if d.code not in dropped]
+        if severity_overrides:
+            remap = {normalize_code(c): _normalize_severity(s)
+                     for c, s in dict(severity_overrides).items()}
+            for d in self.diagnostics:
+                if d.code in remap:
+                    d.severity = remap[d.code]
+        return self
 
     def errors(self) -> List[Diagnostic]:
         return [d for d in self.diagnostics if d.severity is Severity.ERROR]

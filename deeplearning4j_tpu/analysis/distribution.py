@@ -1,0 +1,616 @@
+"""Distribution analyzer — static sharding/mesh/pipeline lints (E1xx/W10x).
+
+The costliest misconfigurations on a multi-chip mesh are *distribution*
+mistakes — a batch that does not divide the data axis, a sharding rule
+naming an axis the mesh lacks, a replicated giant that eats HBM on every
+device, a pipeline whose slowest stage gates every tick. All of them are
+statically decidable from the model config plus the mesh declaration
+(the GSPMD/weight-update-sharding observation: sharding is a property of
+shapes and axis sizes, not of runtime state), so this pass runs them
+ahead of any compile and with NO jax import — the declarations here are
+plain-data mirrors of the ``parallel/`` runtime objects
+(:class:`MeshSpec` ~ ``parallel.mesh.DeviceMesh``, sharding-rule dicts ~
+``parallel.mesh.ShardingRule``, :class:`PipelineSpec` ~
+``parallel.pipeline``).
+
+Codes (documented in :mod:`analysis.diagnostics`):
+
+- ``E101`` batch not divisible by the data axis
+- ``E102`` named mesh axis absent / sized differently than declared
+- ``E103`` pipeline stage boundary splits a weight-tied pair
+- ``E104`` per-device parameter footprint exceeds the HBM budget
+- ``W104`` replicated parameter tensor above threshold with a model axis idle
+- ``W105`` pipeline stage FLOP imbalance beyond tolerance
+- ``W106`` sub-MXU per-device shard after splitting
+- ``W107`` per-layer gradient-collective bytes per step above threshold
+
+Entry points: ``analyze(conf, mesh=...)`` / ``conf.validate(mesh=...)``
+(the lints run from :mod:`analysis.analyzer`), and the CLI's ``--mesh``
+flag. The per-layer shape/FLOP facts come from the jax-free declared-
+shape hooks on the layer configs (``Layer.param_shapes()``).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from deeplearning4j_tpu.analysis.diagnostics import Diagnostic, Severity
+from deeplearning4j_tpu.analysis.layout import MXU_LANES, MXU_SUBLANES
+
+#: W104 only flags tensors at least this large (bytes) — small replicated
+#: params are the normal, correct layout.
+REPLICATED_BYTES_THRESHOLD = 16 * 1024 * 1024
+#: W107 threshold on one layer's estimated per-step gradient allreduce
+#: payload (ring allreduce sends ~2(N-1)/N of the tensor per device).
+COLLECTIVE_BYTES_THRESHOLD = 1024 ** 3
+#: Default E104 per-device HBM budget (GiB) — a TPUv4-ish chip. Params
+#: only; the message reminds that optimizer state multiplies it.
+DEFAULT_HBM_GB = 16.0
+
+_DTYPE_BYTES = {"float64": 8, "double": 8, "f64": 8,
+                "float32": 4, "float": 4, "f32": 4,
+                "bfloat16": 2, "bf16": 2,
+                "float16": 2, "half": 2, "f16": 2,
+                "int8": 1, "uint8": 1}
+
+
+def dtype_bytes(dtype) -> int:
+    return _DTYPE_BYTES.get(str(dtype or "float32").lower(), 4)
+
+
+def _prod(shape: Sequence[int]) -> int:
+    out = 1
+    for d in shape:
+        out *= int(d)
+    return out
+
+
+class PipelineSpec:
+    """Static declaration of a GPipe-style pipeline split (the jax-free
+    mirror of ``parallel.pipeline``): ``stages`` contiguous stages over
+    the layer list, either evenly split or at explicit ``boundaries``
+    (stage-start layer indices, first must be 0), sharded over mesh axis
+    ``axis``."""
+
+    def __init__(self, stages: int, axis: str = "pipe",
+                 boundaries: Optional[Sequence[int]] = None,
+                 flop_tolerance: float = 0.25):
+        self.stages = int(stages)
+        self.axis = axis
+        self.boundaries = list(boundaries) if boundaries is not None else None
+        self.flop_tolerance = float(flop_tolerance)
+
+    @staticmethod
+    def coerce(obj) -> Optional["PipelineSpec"]:
+        if obj is None or isinstance(obj, PipelineSpec):
+            return obj
+        if isinstance(obj, int):
+            return PipelineSpec(obj)
+        if isinstance(obj, dict):
+            return PipelineSpec(**obj)
+        raise TypeError(f"cannot interpret {obj!r} as a pipeline spec "
+                        "(use PipelineSpec, an int stage count, or a dict)")
+
+    def stage_of(self, n_layers: int) -> List[int]:
+        """Stage index per layer. Raises ValueError on bad boundaries."""
+        if self.stages < 1:
+            raise ValueError(f"pipeline stages must be >= 1, got {self.stages}")
+        if self.boundaries is not None:
+            b = list(self.boundaries)
+            if len(b) != self.stages or b != sorted(b) or (b and b[0] != 0) \
+                    or len(set(b)) != len(b) or (b and b[-1] >= max(n_layers, 1)):
+                raise ValueError(
+                    f"pipeline boundaries {b} must be {self.stages} strictly "
+                    f"increasing stage-start indices beginning at 0 and "
+                    f"below {n_layers}")
+            out, stage = [], 0
+            for i in range(n_layers):
+                while stage + 1 < len(b) and i >= b[stage + 1]:
+                    stage += 1
+                out.append(stage)
+            return out
+        per = max(1, -(-n_layers // self.stages))       # ceil
+        return [min(i // per, self.stages - 1) for i in range(n_layers)]
+
+
+class MeshSpec:
+    """Jax-free device-mesh declaration for the static pass.
+
+    ``axes``: ordered {name: size} (the ``parallel.mesh.DeviceMesh``
+    convention: ``data``/``model``/``seq``/``pipe``). ``sharding``: a
+    ``parallel.mesh.ShardingRule``-shaped declaration — {param-name-regex:
+    partition-spec-tuple} (or a ShardingRule instance; entries may be an
+    axis name, ``None``, or a tuple of axis names per dim). ``pipeline``:
+    a :class:`PipelineSpec`. ``hbm_gb``: per-device parameter budget for
+    E104 (``None`` disables)."""
+
+    def __init__(self, axes: Dict[str, int], data_axis: str = "data",
+                 sharding=None, pipeline=None, hbm_gb: float = DEFAULT_HBM_GB):
+        self.axes = {str(k): int(v) for k, v in dict(axes).items()}
+        for name, size in self.axes.items():
+            if size < 1:
+                raise ValueError(f"mesh axis {name!r} has size {size}")
+        self.data_axis = data_axis
+        self.sharding = sharding
+        self.pipeline = PipelineSpec.coerce(pipeline)
+        self.hbm_gb = hbm_gb
+
+    @staticmethod
+    def parse(text: str) -> "MeshSpec":
+        """``"data=8,model=2"`` -> MeshSpec (the CLI ``--mesh`` syntax)."""
+        axes: Dict[str, int] = {}
+        for part in str(text).split(","):
+            part = part.strip()
+            if not part:
+                continue
+            name, eq, size = part.partition("=")
+            if not eq or not name.strip():
+                raise ValueError(f"bad mesh axis {part!r}: expected "
+                                 f"name=size[,name=size...]")
+            try:
+                axes[name.strip()] = int(size)
+            except ValueError:
+                raise ValueError(f"bad mesh axis size in {part!r}") from None
+        if not axes:
+            raise ValueError(f"empty mesh declaration {text!r}")
+        return MeshSpec(axes)
+
+    @staticmethod
+    def coerce(obj) -> Optional["MeshSpec"]:
+        """MeshSpec | axes dict | "data=8,..." string | a runtime
+        ``DeviceMesh`` (duck-typed via its jax Mesh's ``.shape`` mapping,
+        so this module still never imports jax)."""
+        if obj is None or isinstance(obj, MeshSpec):
+            return obj
+        if isinstance(obj, str):
+            return MeshSpec.parse(obj)
+        if isinstance(obj, dict):
+            return MeshSpec(obj)
+        inner = getattr(obj, "mesh", None)
+        shape = getattr(inner, "shape", None) or getattr(obj, "shape", None)
+        if shape is not None and hasattr(shape, "items"):
+            return MeshSpec(dict(shape))
+        raise TypeError(f"cannot interpret {obj!r} as a mesh declaration "
+                        "(use MeshSpec, {axis: size}, 'data=8,model=2', or "
+                        "a parallel.mesh.DeviceMesh)")
+
+    def size(self, axis: str, default: int = 1) -> int:
+        return self.axes.get(axis, default)
+
+    def model_axes(self) -> List[str]:
+        """Axes a parameter tensor could shard over (size > 1): excludes
+        the data axis (shards the batch), the declared pipeline axis
+        (shards by stage assignment, not by spec), and ``seq`` (sequence
+        parallelism shards activations — params stay replicated)."""
+        skip = {self.data_axis, "seq"}
+        if self.pipeline is not None:
+            skip.add(self.pipeline.axis)
+        else:
+            skip.add("pipe")
+        return [a for a, n in self.axes.items() if a not in skip and n > 1]
+
+    def __repr__(self):
+        body = ", ".join(f"{k}={v}" for k, v in self.axes.items())
+        return f"MeshSpec({body})"
+
+
+# ----------------------------------------------------------- sharding rules
+
+def _normalize_rules(sharding) -> List[Tuple[Any, Tuple]]:
+    """-> [(compiled regex, spec tuple)]. Accepts a
+    ``parallel.mesh.ShardingRule`` (has ``.rules``), a {pattern: spec}
+    dict, an already-normalized list, or None."""
+    if sharding is None:
+        return []
+    rules = getattr(sharding, "rules", sharding)
+    if isinstance(rules, dict):
+        rules = [(re.compile(k), tuple(v)) for k, v in rules.items()]
+    out = []
+    for pat, spec in rules:
+        if isinstance(pat, str):
+            pat = re.compile(pat)
+        out.append((pat, tuple(spec)))
+    return out
+
+
+def _spec_for(rules, name: str, ndim: int) -> Tuple:
+    """Partition spec for one named param, padded to ``ndim`` (missing
+    trailing dims replicate — jax PartitionSpec semantics)."""
+    for pat, spec in rules:
+        if pat.search(name):
+            spec = tuple(spec)[:ndim]
+            return spec + (None,) * (ndim - len(spec))
+    return (None,) * ndim
+
+
+def _dim_axes(entry) -> Tuple[str, ...]:
+    """One spec entry -> the tuple of axis names it shards over."""
+    if entry is None:
+        return ()
+    if isinstance(entry, (tuple, list)):
+        return tuple(entry)
+    return (entry,)
+
+
+def _spec_axes(spec) -> List[str]:
+    return [a for entry in spec for a in _dim_axes(entry)]
+
+
+def _shard_divisor(entry, mesh: MeshSpec) -> int:
+    div = 1
+    for a in _dim_axes(entry):
+        div *= mesh.size(a)
+    return div
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB"):
+        if abs(n) < 1024:
+            return f"{n:.0f} {unit}"
+        n /= 1024
+    return f"{n:.2f} GiB" if n >= 100 else f"{n:.1f} GiB"
+
+
+# ------------------------------------------------------------- layer facts
+
+class _ParamFact:
+    """One parameter tensor's static facts under the mesh. ``idx`` is the
+    owning entry's position (the pipeline stage assignment keys off it)."""
+
+    __slots__ = ("idx", "location", "name", "shape", "spec", "bytes_total",
+                 "bytes_per_device")
+
+    def __init__(self, idx, location, name, shape, spec, itemsize, mesh):
+        self.idx = idx
+        self.location = location
+        self.name = name
+        self.shape = tuple(int(d) for d in shape)
+        self.spec = spec
+        self.bytes_total = _prod(self.shape) * itemsize
+        div = 1
+        for entry in spec:
+            div *= _shard_divisor(entry, mesh)
+        self.bytes_per_device = self.bytes_total / max(div, 1)
+
+
+def _param_facts(entries, mesh: MeshSpec, itemsize: int) -> List[_ParamFact]:
+    rules = _normalize_rules(mesh.sharding)
+    facts = []
+    for idx, (loc, layer, _it, _out) in enumerate(entries):
+        shapes = getattr(layer, "param_shapes", lambda: {})()
+        lname = getattr(layer, "name", None) or type(layer).__name__
+        for pname, shape in shapes.items():
+            if not shape or any(not d or d < 0 for d in shape):
+                continue                       # unresolved nIn/nOut: skip
+            full = f"{lname}/{pname}"
+            spec = _spec_for(rules, full, len(shape))
+            facts.append(_ParamFact(idx, loc, full, shape, spec, itemsize,
+                                    mesh))
+    return facts
+
+
+def _stage_assignment(mesh: MeshSpec, n_entries: int) -> Optional[List[int]]:
+    """Stage index per entry when a VALID pipeline is declared (axis
+    present, sized to the stage count, boundaries well-formed) — else
+    None. Invalid declarations are _lint_axes/_lint_pipeline's E102."""
+    pipe = mesh.pipeline
+    if pipe is None or mesh.size(pipe.axis) != pipe.stages:
+        return None
+    try:
+        return pipe.stage_of(n_entries)
+    except ValueError:
+        return None
+
+
+def _approx_flops(layer, it, out_it) -> int:
+    """Per-example forward FLOP estimate from declared shapes: 2*W for
+    every matmul-bearing weight, times spatial positions for conv output
+    maps, times timesteps for recurrent input."""
+    shapes = getattr(layer, "param_shapes", lambda: {})()
+    w = sum(_prod(s) for s in shapes.values() if len(s) >= 2)
+    if not w:
+        return 0
+    mult = 1
+    if out_it is not None and getattr(out_it, "kind", None) == "cnn":
+        mult = max(int(out_it.dims.get("height", 1)), 1) * \
+            max(int(out_it.dims.get("width", 1)), 1)
+    elif it is not None and getattr(it, "kind", None) == "rnn":
+        t = int(it.dims.get("timesteps", -1) or -1)
+        mult = t if t > 0 else 1
+    return 2 * w * mult
+
+
+def _propagate_types(conf):
+    """Best-effort InputType per layer for the sequential config: (input,
+    output) pairs, None where propagation is impossible or fails (the
+    structural analyzer already reported that as its own diagnostic)."""
+    layers = list(conf.layers)
+    out: List[Tuple] = [(None, None)] * len(layers)
+    cur = getattr(conf, "input_type", None)
+    if cur is None:
+        return out
+    preprocessors = dict(getattr(conf, "preprocessors", {}) or {})
+    try:
+        from deeplearning4j_tpu.nn import preprocessors as pp
+    except ImportError:      # jax-blocked environment: skip type refinement
+        return out
+    for i, layer in enumerate(layers):
+        if cur is None:
+            break
+        try:
+            pre = preprocessors.get(i)
+            if pre is None:
+                pre = pp.preprocessor_for(cur, layer)
+            if pre is not None:
+                cur = pre.output_type(cur)
+            nxt = layer.output_type(cur)
+        except Exception:
+            out[i] = (cur, None)
+            break
+        out[i] = (cur, nxt)
+        cur = nxt
+    return out
+
+
+# -------------------------------------------------------------- the checks
+
+def lint_multilayer(conf, mesh: MeshSpec,
+                    batch_size: Optional[int]) -> List[Diagnostic]:
+    from deeplearning4j_tpu.analysis.analyzer import _layer_loc
+    layers = list(conf.layers)
+    types = _propagate_types(conf)
+    entries = [(_layer_loc(i, l), l, types[i][0], types[i][1])
+               for i, l in enumerate(layers)]
+    diags = lint_entries(entries, mesh, batch_size,
+                         getattr(getattr(conf, "base", None), "dtype", None))
+    diags.extend(_lint_pipeline(entries, mesh))
+    return diags
+
+
+def lint_graph(conf, mesh: MeshSpec,
+               batch_size: Optional[int]) -> List[Diagnostic]:
+    """Graph configs get every per-tensor/mesh check; the pipeline checks
+    are sequential-only (a DAG has no single stage order to split)."""
+    from deeplearning4j_tpu.analysis.analyzer import _node_loc
+    entries = [(_node_loc(n), n.obj, None, None)
+               for n in conf.nodes if n.kind == "layer"]
+    return lint_entries(entries, mesh, batch_size,
+                        getattr(getattr(conf, "base", None), "dtype", None))
+
+
+def lint_entries(entries, mesh: MeshSpec, batch_size: Optional[int],
+                 dtype) -> List[Diagnostic]:
+    """Mesh-wide checks over ``(location, layer, in_type, out_type)``
+    entries — shared by the sequential and graph paths."""
+    diags: List[Diagnostic] = []
+    diags.extend(_lint_batch(mesh, batch_size))
+    diags.extend(_lint_axes(mesh))
+    facts = _param_facts(entries, mesh, dtype_bytes(dtype))
+    diags.extend(_lint_hbm(facts, mesh,
+                           _stage_assignment(mesh, len(entries))))
+    diags.extend(_lint_replicated(facts, mesh))
+    diags.extend(_lint_shard_geometry(facts, mesh))
+    diags.extend(_lint_collectives(facts, mesh))
+    return diags
+
+
+def _lint_batch(mesh: MeshSpec, batch_size) -> List[Diagnostic]:
+    n = mesh.size(mesh.data_axis)
+    if not batch_size or n <= 1 or batch_size % n == 0:
+        return []
+    return [Diagnostic(
+        "DL4J-E101", Severity.ERROR, "mesh",
+        f"global batch {batch_size} does not divide the "
+        f"'{mesh.data_axis}' axis ({n} devices) — per-device batches "
+        f"would be ragged and the sharded dispatch will pad or fail",
+        fix_hint=f"use a global batch that is a multiple of {n} "
+                 f"(e.g. {((batch_size // n) + 1) * n})")]
+
+
+def _lint_axes(mesh: MeshSpec) -> List[Diagnostic]:
+    diags = []
+    missing = []
+    for _pat, spec in _normalize_rules(mesh.sharding):
+        missing.extend(a for a in _spec_axes(spec) if a not in mesh.axes)
+    for axis in sorted(set(missing)):
+        diags.append(Diagnostic(
+            "DL4J-E102", Severity.ERROR, "sharding rules",
+            f"partition spec names mesh axis '{axis}' but the declared "
+            f"mesh has axes {sorted(mesh.axes)} — placement would fail at "
+            f"the first device_put",
+            fix_hint=f"add '{axis}' to the mesh (DeviceMesh.create / "
+                     f"--mesh {axis}=N) or fix the rule's axis name"))
+    pipe = mesh.pipeline
+    if pipe is not None:
+        if pipe.axis not in mesh.axes:
+            diags.append(Diagnostic(
+                "DL4J-E102", Severity.ERROR, "pipeline",
+                f"pipeline declares mesh axis '{pipe.axis}' but the mesh "
+                f"has axes {sorted(mesh.axes)}",
+                fix_hint=f"declare the axis (--mesh {pipe.axis}="
+                         f"{pipe.stages}) or drop the pipeline spec"))
+        elif mesh.size(pipe.axis) != pipe.stages:
+            diags.append(Diagnostic(
+                "DL4J-E102", Severity.ERROR, "pipeline",
+                f"pipeline declares {pipe.stages} stages but mesh axis "
+                f"'{pipe.axis}' has size {mesh.size(pipe.axis)} — one "
+                f"device per stage is the parallel/pipeline contract",
+                fix_hint="make the stage count equal the pipe-axis size"))
+    return diags
+
+
+def _lint_pipeline(entries, mesh: MeshSpec) -> List[Diagnostic]:
+    pipe = mesh.pipeline
+    if pipe is None or pipe.axis not in mesh.axes \
+            or mesh.size(pipe.axis) != pipe.stages:
+        return []                     # E102 already covers the mismatch
+    diags = []
+    try:
+        stage_of = pipe.stage_of(len(entries))
+    except ValueError as e:
+        return [Diagnostic("DL4J-E102", Severity.ERROR, "pipeline", str(e),
+                           fix_hint="fix the stage boundaries")]
+    # E103: weight-tied pairs must live on one stage (a tie across stages
+    # means the 'shared' tensor is two tensors on two devices, kept in
+    # sync only by luck)
+    groups: Dict[str, List[Tuple[int, str]]] = {}
+    for i, (loc, layer, _it, _out) in enumerate(entries):
+        tie = getattr(layer, "tied_with", None)
+        if tie:
+            groups.setdefault(str(tie), []).append((i, loc))
+    for tie, members in sorted(groups.items()):
+        stages = {stage_of[i] for i, _ in members}
+        if len(stages) > 1:
+            locs = ", ".join(loc for _, loc in members)
+            diags.append(Diagnostic(
+                "DL4J-E103", Severity.ERROR, locs,
+                f"weight-tie group '{tie}' is split across pipeline "
+                f"stages {sorted(stages)} — tied parameters on different "
+                f"stages are physically distinct tensors and silently "
+                f"diverge",
+                fix_hint="move the stage boundary so every layer of the "
+                         "tie group lands on one stage (or break the tie)"))
+    # W105: FLOP balance — the pipeline advances at the slowest stage's
+    # pace, so imbalance is pure bubble on every other device
+    flops = [0.0] * pipe.stages
+    for i, (_loc, layer, it, out) in enumerate(entries):
+        flops[stage_of[i]] += _approx_flops(layer, it, out)
+    total = sum(flops)
+    if total > 0:
+        mean = total / pipe.stages
+        worst = max(range(pipe.stages), key=lambda s: flops[s])
+        if flops[worst] > mean * (1.0 + pipe.flop_tolerance):
+            per = ", ".join(f"stage {s}: {f / 1e9:.2f}"
+                            for s, f in enumerate(flops))
+            diags.append(Diagnostic(
+                "DL4J-W105", Severity.WARNING, "pipeline",
+                f"stage FLOP imbalance: stage {worst} carries "
+                f"{flops[worst] / mean:.2f}x the mean (GFLOP/example: "
+                f"{per}) — every lighter stage idles the difference each "
+                f"tick",
+                fix_hint="move the stage boundaries toward an even FLOP "
+                         "split (boundaries=[...]), not an even layer "
+                         "count"))
+    return diags
+
+
+def _lint_hbm(facts, mesh: MeshSpec,
+              stages: Optional[List[int]] = None) -> List[Diagnostic]:
+    if mesh.hbm_gb is None or not facts:
+        return []
+    budget = float(mesh.hbm_gb) * 1024 ** 3
+    if stages is not None:
+        # pipeline: a device holds only its own stage's layers — budget
+        # the heaviest stage, not the whole model
+        per_stage: Dict[int, float] = {}
+        for f in facts:
+            per_stage[stages[f.idx]] = per_stage.get(stages[f.idx], 0.0) \
+                + f.bytes_per_device
+        worst = max(per_stage, key=per_stage.get)
+        total = per_stage[worst]
+        location = f"pipeline stage {worst}"
+        facts = [f for f in facts if stages[f.idx] == worst]
+    else:
+        total = sum(f.bytes_per_device for f in facts)
+        location = "mesh"
+    if total <= budget:
+        return []
+    top = sorted(facts, key=lambda f: -f.bytes_per_device)[:3]
+    biggest = "; ".join(f"{f.name} {f.shape} {_fmt_bytes(f.bytes_per_device)}"
+                        f"/device" for f in top)
+    return [Diagnostic(
+        "DL4J-E104", Severity.ERROR, location,
+        f"per-device parameter footprint {_fmt_bytes(total)} exceeds the "
+        f"{mesh.hbm_gb:g} GiB HBM budget (params only — optimizer state "
+        f"multiplies this 2-3x). Biggest shards: {biggest}",
+        fix_hint="shard the large tensors over a model axis (ShardingRule"
+                 "), raise the budget (--hbm-gb), or shrink the model")]
+
+
+def _lint_replicated(facts, mesh: MeshSpec) -> List[Diagnostic]:
+    model_axes = mesh.model_axes()
+    if not model_axes:
+        return []
+    diags = []
+    for f in facts:
+        if f.bytes_total < REPLICATED_BYTES_THRESHOLD:
+            continue
+        if any(a in mesh.axes and mesh.size(a) > 1
+               for a in _spec_axes(f.spec)):
+            continue                   # sharded over something real
+        diags.append(Diagnostic(
+            "DL4J-W104", Severity.WARNING, f.location,
+            f"parameter {f.name} {f.shape} ({_fmt_bytes(f.bytes_total)}) "
+            f"is replicated on every device although the mesh declares "
+            f"model axes {model_axes} — each replica burns the full "
+            f"tensor (and its updater state) in HBM",
+            fix_hint="add a ShardingRule entry partitioning it over "
+                     f"'{model_axes[0]}' (GSPMD-style weight-update "
+                     "sharding: see PAPERS.md cross-replica sharding)"))
+    return diags
+
+
+def _lint_shard_geometry(facts, mesh: MeshSpec) -> List[Diagnostic]:
+    diags = []
+    for f in facts:
+        if len(f.shape) < 2:
+            continue
+        for dim_idx, entry in enumerate(f.spec):
+            axes = [a for a in _dim_axes(entry) if mesh.size(a) > 1]
+            if not axes:
+                continue
+            div = _shard_divisor(entry, mesh)
+            dim = f.shape[dim_idx]
+            minor = dim_idx == len(f.shape) - 1
+            tile = MXU_LANES if minor else MXU_SUBLANES
+            per_dev = dim / div
+            if dim % div != 0:
+                diags.append(Diagnostic(
+                    "DL4J-W106", Severity.WARNING, f.location,
+                    f"{f.name} dim {dim_idx} ({dim}) does not divide its "
+                    f"shard factor {div} over {axes} — GSPMD pads every "
+                    f"shard to {-(-dim // div)}",
+                    fix_hint=f"pick a dim that is a multiple of {div}"))
+            elif dim >= tile and per_dev < tile:
+                kind = "lane" if minor else "sublane"
+                diags.append(Diagnostic(
+                    "DL4J-W106", Severity.WARNING, f.location,
+                    f"{f.name} dim {dim_idx} ({dim}) shards over {axes} "
+                    f"to {per_dev:.0f}/device — below one "
+                    f"{MXU_SUBLANES}x{MXU_LANES} MXU tile in the {kind} "
+                    f"dim, so every device pads back up to {tile} and "
+                    f"most of each MAC is dead",
+                    fix_hint=f"shard a larger dim, or keep per-device "
+                             f"extent >= {tile} (dim >= {tile * div} "
+                             f"here)"))
+    return diags
+
+
+def _lint_collectives(facts, mesh: MeshSpec) -> List[Diagnostic]:
+    """Per-layer gradient-allreduce estimate from the SHARDED facts: the
+    gradient carries the parameter's sharding, so model-sharding a tensor
+    shrinks its allreduce payload — following W104/W107's own fix hint
+    clears the warning."""
+    n = mesh.size(mesh.data_axis)
+    if n <= 1:
+        return []
+    ring = 2.0 * (n - 1) / n
+    per_layer: Dict[str, float] = {}
+    for f in facts:
+        per_layer[f.location] = per_layer.get(f.location, 0.0) \
+            + f.bytes_per_device
+    diags = []
+    for loc, pbytes in per_layer.items():
+        payload = pbytes * ring
+        if payload > COLLECTIVE_BYTES_THRESHOLD:
+            diags.append(Diagnostic(
+                "DL4J-W107", Severity.WARNING, loc,
+                f"estimated gradient allreduce for this layer moves "
+                f"{_fmt_bytes(payload)} per device per step (ring "
+                f"allreduce of its {_fmt_bytes(pbytes)} per-device grad "
+                f"shard over {n} '{mesh.data_axis}' devices) — likely "
+                f"the step's communication bottleneck",
+                fix_hint="shard the tensor over a model axis, keep grads "
+                         "in bf16 for the allreduce, or shrink the layer"))
+    return diags

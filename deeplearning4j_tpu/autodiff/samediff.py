@@ -624,6 +624,17 @@ class SameDiff:
                 shapes[name] = tuple(leaf.shape)
         return shapes
 
+    def validate(self, batch_size: int = 1, **kw):
+        """Static lint of the recorded op graph — shape propagation over
+        the ``_Node`` list plus structural checks (E151 undefined input,
+        E152 shape conflict, E153 bad loss variable, W151 dangling
+        placeholder, W152 unused variable, W153 training config with no
+        loss). Pure-static like ``model.validate()``: no trace, no
+        compile, no device. Extra keywords pass through to
+        ``analysis.analyze`` (``suppress=``, ``severity_overrides=``)."""
+        from deeplearning4j_tpu.analysis import analyze
+        return analyze(self, batch_size=batch_size, **kw)
+
     def summary(self, batch_size: int = 1) -> str:
         """Printable graph summary with per-variable shapes — computed by
         the shape functions / abstract interp, not by running the graph

@@ -1216,7 +1216,6 @@ class Join:
             return Join(self._type, self._cols, self._left, self._right)
 
     def outputSchema(self) -> Schema:
-        rnames = self.right_schema.getColumnNames()
         keep_right = [c for c in self.right_schema.columns
                       if c["name"] not in self.join_columns]
         return Schema([dict(c) for c in self.left_schema.columns]

@@ -463,9 +463,9 @@ def _import_node(sd: SameDiff, consts: Dict[str, np.ndarray], node: NodeProto):
             pass                              # fall through to runtime node
 
     wrapped = (lambda _f: lambda *a, **kw: _f(*a))(fn)
-    out = sd._record_fn(f"onnx.{op}", wrapped, ins, name=node.outputs[0],
-                        n_out=n_out, rebuild="onnx",
-                        attrs={"onnx_op": op, "params": params})
+    sd._record_fn(f"onnx.{op}", wrapped, ins, name=node.outputs[0],
+                  n_out=n_out, rebuild="onnx",
+                  attrs={"onnx_op": op, "params": params})
     if n_out > 1:
         # _record_fn names outputs '<base>:i'; align with the graph's names
         for i, oname in enumerate(node.outputs[:n_out]):

@@ -1548,7 +1548,6 @@ def _plan_deframe(nodes):
     Returns (skip: names the main loop must not import, plans: frame
     key -> plan); the import loop runs frames via _collapsed_order."""
     by_name = {n.name: n for n in nodes}
-    order = {n.name: i for i, n in enumerate(nodes)}
 
     def producer(ref):
         return by_name.get(ref.split(":")[0].lstrip("^"))

@@ -230,7 +230,6 @@ def loss_fn(params, tokens, targets, cfg: TransformerConfig,
             mesh: Optional[DeviceMesh] = None, target_mask=None):
     """Masked-LM / causal-LM token cross-entropy (fp32)."""
     logits = forward(params, tokens, cfg, mesh)
-    V = logits.shape[-1]
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None].astype(jnp.int32),
                                axis=-1)[..., 0]

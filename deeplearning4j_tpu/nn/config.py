@@ -232,11 +232,12 @@ class ListBuilder:
 
     def backpropType(self, kind: str, tbpttLength: int = None):
         """ref: ListBuilder.backpropType(BackpropType.TruncatedBPTT) — the
-        config-level TBPTT declaration. Today this is a DECLARATION only:
-        the analyzer's W002 lint reads it (and serialization round-trips
-        it), but ``fit()`` does not yet segment on it — call
-        ``fitTBPTT(ds, length)`` explicitly to train truncated (auto
-        wiring is a ROADMAP follow-up)."""
+        config-level TBPTT declaration. ``fit()`` honors it: sequence
+        batches are segmented into ``tBPTTLength`` windows through the
+        compiled TBPTT step automatically, equivalent to calling
+        ``fitTBPTT(ds, length)`` per batch (pinned by a test). The
+        analyzer's W002 lint flags the declaration on networks with no
+        recurrent layers."""
         self.backprop_type = str(kind).lower()
         if tbpttLength is not None:
             self.tbptt_length = int(tbpttLength)
@@ -282,13 +283,17 @@ class MultiLayerConfiguration:
             self._propagate_input_types()
 
     def validate(self, batch_size: int = None,
-                 data_devices: int = None) -> "Any":
+                 data_devices: int = None, **kw) -> "Any":
         """Static lint of this configuration — shape/dtype propagation,
         structural diagnostics, and TPU layout lints; returns a
-        ``deeplearning4j_tpu.analysis.ValidationReport`` (no jax work)."""
+        ``deeplearning4j_tpu.analysis.ValidationReport`` (no jax work).
+        Extra keywords pass through to ``analysis.analyze``: ``mesh=``
+        (enables the E1xx/W10x distribution lints), ``sharding=``,
+        ``pipeline=``, ``hbm_gb=``, ``suppress=[codes]``,
+        ``severity_overrides={code: severity}``."""
         from deeplearning4j_tpu.analysis import analyze
         return analyze(self, batch_size=batch_size,
-                       data_devices=data_devices)
+                       data_devices=data_devices, **kw)
 
     def _propagate_input_types(self):
         """InputType propagation + automatic preprocessor insertion

@@ -285,13 +285,15 @@ class GraphBuilder:
     def build(self) -> "ComputationGraphConfiguration":
         return ComputationGraphConfiguration(self)
 
-    def validate(self, batch_size: int = None, data_devices: int = None):
+    def validate(self, batch_size: int = None, data_devices: int = None,
+                 **kw):
         """Static lint of the (possibly not-yet-buildable) graph — unlike
         ``build()``, a cyclic or dangling graph comes back as E002/E003
-        diagnostics instead of a ValueError."""
+        diagnostics instead of a ValueError. Extra keywords pass through
+        to ``analysis.analyze`` (``mesh=``, ``suppress=``, ...)."""
         from deeplearning4j_tpu.analysis import analyze
         return analyze(self, batch_size=batch_size,
-                       data_devices=data_devices)
+                       data_devices=data_devices, **kw)
 
     def __getattr__(self, name):
         if name.startswith("_"):
@@ -315,11 +317,12 @@ class ComputationGraphConfiguration:
         if self.input_types:
             self._propagate_types()
 
-    def validate(self, batch_size: int = None, data_devices: int = None):
+    def validate(self, batch_size: int = None, data_devices: int = None,
+                 **kw):
         """Static lint — see ``deeplearning4j_tpu.analysis.analyze``."""
         from deeplearning4j_tpu.analysis import analyze
         return analyze(self, batch_size=batch_size,
-                       data_devices=data_devices)
+                       data_devices=data_devices, **kw)
 
     def _toposort(self):
         order, seen = [], set(self.graph_inputs)
@@ -403,12 +406,13 @@ class ComputationGraph:
         self._fwd_cache = None
         self._initialized = False
 
-    def validate(self, batch_size: int = None, data_devices: int = None):
+    def validate(self, batch_size: int = None, data_devices: int = None,
+                 **kw):
         """Static lint of this graph network (configuration analysis plus
         model-level findings) — see MultiLayerNetwork.validate."""
         from deeplearning4j_tpu.analysis import analyze
         return analyze(self, batch_size=batch_size,
-                       data_devices=data_devices)
+                       data_devices=data_devices, **kw)
 
     def init(self, seed: int = None, strict: bool = False):
         if strict:

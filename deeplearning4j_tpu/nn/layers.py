@@ -85,7 +85,7 @@ class Layer:
     def __init__(self, nOut: int = None, nIn: int = None, activation: str = None,
                  weightInit: str = None, biasInit: float = 0.0,
                  dropOut: float = 0.0, l1: float = None, l2: float = None,
-                 name: str = None, **extra):
+                 name: str = None, tiedWith: str = None, **extra):
         _reject_unknown_kwargs(type(self), extra)
         self.nOut = nOut
         self.nIn = nIn
@@ -96,6 +96,9 @@ class Layer:
         self.l1 = l1
         self.l2 = l2
         self.name = name or type(self).__name__
+        # weight-tie group label: layers sharing one group must land on
+        # the same pipeline stage (analysis/distribution.py E103)
+        self.tied_with = tiedWith
 
     # -- config plumbing --
     def set_defaults(self, base):
@@ -135,6 +138,20 @@ class Layer:
         any param-bearing layer; elementwise param layers override to []
         and gated recurrent layers report their fused gate width."""
         return [self.nOut] if self.has_params and self.nOut else []
+
+    def param_shapes(self) -> Dict[str, Tuple[int, ...]]:
+        """Declared parameter shapes WITHOUT initializing anything — the
+        jax-free static hook ``analysis/distribution.py`` sizes shards,
+        HBM footprints, and FLOP estimates from. Dense-ish default
+        (W [nIn, nOut] + optional b [nOut]); geometry-bearing subclasses
+        override to match their ``initialize``. Returns {} while
+        nIn/nOut are unresolved."""
+        if not self.has_params or not self.nIn or not self.nOut:
+            return {}
+        shapes = {"W": (self.nIn, self.nOut)}
+        if getattr(self, "has_bias", True):
+            shapes["b"] = (self.nOut,)
+        return shapes
 
     def output_type(self, it: InputType) -> InputType:
         return InputType.feedForward(self.nOut)
@@ -236,6 +253,11 @@ class EmbeddingSequenceLayer(Layer):
     def initialize(self, key):
         return {"W": _initialize((self.nIn, self.nOut), self.weight_init, key)}, {}
 
+    def param_shapes(self):
+        if not self.nIn or not self.nOut:
+            return {}
+        return {"W": (self.nIn, self.nOut)}
+
     def apply(self, params, state, x, train, key):
         idx = x.astype(jnp.int32)
         if idx.ndim == 3:  # [N, 1, T]
@@ -284,6 +306,14 @@ class ConvolutionLayer(Layer):
         if self.has_bias:
             params["b"] = jnp.full((self.nOut,), self.bias_init, jnp.float32)
         return params, {}
+
+    def param_shapes(self):
+        if not self.nIn or not self.nOut:
+            return {}
+        shapes = {"W": (self.nOut, self.nIn) + tuple(self.kernel)}
+        if self.has_bias:
+            shapes["b"] = (self.nOut,)
+        return shapes
 
     def apply(self, params, state, x, train, key):
         x = self._maybe_dropout(x, train, key)
@@ -336,6 +366,14 @@ class DepthwiseConvolution2D(ConvolutionLayer):
             params["b"] = jnp.full((self.nOut,), self.bias_init, jnp.float32)
         return params, {}
 
+    def param_shapes(self):
+        if not self.nIn or not self.nOut:
+            return {}
+        shapes = {"W": (self.depth_multiplier, self.nIn) + tuple(self.kernel)}
+        if self.has_bias:
+            shapes["b"] = (self.nOut,)
+        return shapes
+
     def apply(self, params, state, x, train, key):
         out = conv_ops.depthwise_conv2d(x, params["W"], params.get("b"),
                                         stride=self.stride, pad=self.padding,
@@ -361,6 +399,15 @@ class SeparableConvolution2D(ConvolutionLayer):
         if self.has_bias:
             params["b"] = jnp.full((self.nOut,), self.bias_init, jnp.float32)
         return params, {}
+
+    def param_shapes(self):
+        if not self.nIn or not self.nOut:
+            return {}
+        shapes = {"Wd": (self.depth_multiplier, self.nIn) + tuple(self.kernel),
+                  "Wp": (self.nOut, self.nIn * self.depth_multiplier, 1, 1)}
+        if self.has_bias:
+            shapes["b"] = (self.nOut,)
+        return shapes
 
     def apply(self, params, state, x, train, key):
         out = conv_ops.separable_conv2d(x, params["Wd"], params["Wp"],
@@ -443,6 +490,11 @@ class BatchNormalization(Layer):
 
     def mxu_lane_dims(self):
         return []   # elementwise scale/shift — no matmul
+
+    def param_shapes(self):
+        if not self.nIn:
+            return {}
+        return {"gamma": (self.nIn,), "beta": (self.nIn,)}
 
     def apply(self, params, state, x, train, key):
         # mixed-precision island handled inside the ops: stats accumulate
@@ -689,6 +741,12 @@ class LSTM(Layer):
     def mxu_lane_dims(self):
         return [4 * self.nOut] if self.nOut else []   # fused [i,f,g,o] gates
 
+    def param_shapes(self):
+        if not self.nIn or not self.nOut:
+            return {}
+        H = self.nOut
+        return {"W": (self.nIn, 4 * H), "RW": (H, 4 * H), "b": (4 * H,)}
+
     def apply(self, params, state, x, train, key, mask=None):
         x_tnc = jnp.transpose(x, (2, 0, 1))  # [N,C,T] -> [T,N,C]
         mask_tn = jnp.transpose(mask, (1, 0)) if mask is not None else None
@@ -742,6 +800,13 @@ class GRU(Layer):
 
     def mxu_lane_dims(self):
         return [3 * self.nOut] if self.nOut else []   # fused [r,z,n] gates
+
+    def param_shapes(self):
+        if not self.nIn or not self.nOut:
+            return {}
+        H = self.nOut
+        return {"W": (self.nIn, 3 * H), "RW": (H, 3 * H),
+                "b": (3 * H,), "bR": (3 * H,)}
 
     def apply(self, params, state, x, train, key, mask=None):
         x_tnc = jnp.transpose(x, (2, 0, 1))
@@ -867,6 +932,14 @@ class Convolution1D(Layer):
             params["b"] = jnp.full((self.nOut,), self.bias_init, jnp.float32)
         return params, {}
 
+    def param_shapes(self):
+        if not self.nIn or not self.nOut:
+            return {}
+        shapes = {"W": (self.nOut, self.nIn, self.kernel)}
+        if self.has_bias:
+            shapes["b"] = (self.nOut,)
+        return shapes
+
     def apply(self, params, state, x, train, key, mask=None):
         out = conv_ops.conv1d(x, params["W"], params.get("b"),
                               stride=self.stride, pad=self.padding,
@@ -911,6 +984,12 @@ class SimpleRnn(Layer):
             "b": jnp.zeros((self.nOut,)),
         }
         return params, {}
+
+    def param_shapes(self):
+        if not self.nIn or not self.nOut:
+            return {}
+        return {"W": (self.nIn, self.nOut), "RW": (self.nOut, self.nOut),
+                "b": (self.nOut,)}
 
     def apply(self, params, state, x, train, key, mask=None):
         x_tnc = jnp.transpose(x, (2, 0, 1))
@@ -958,6 +1037,11 @@ class Bidirectional(Layer):
 
     def mxu_lane_dims(self):
         return self.fwd.mxu_lane_dims() + self.bwd.mxu_lane_dims()
+
+    def param_shapes(self):
+        out = {f"fwd/{k}": v for k, v in self.fwd.param_shapes().items()}
+        out.update({f"bwd/{k}": v for k, v in self.bwd.param_shapes().items()})
+        return out
 
     def initialize(self, key):
         k1, k2 = jax.random.split(key)
@@ -1206,6 +1290,9 @@ class PReLULayer(Layer):
     def mxu_lane_dims(self):
         return []   # elementwise slope — no matmul
 
+    def param_shapes(self):
+        return {"alpha": (self.nIn,)} if self.nIn else {}
+
     def initialize(self, key):
         return {"alpha": jnp.full((self.nIn,), 0.25)}, {}
 
@@ -1280,6 +1367,9 @@ class LayerNorm(Layer):
     def mxu_lane_dims(self):
         return []   # elementwise gain/bias — no matmul
 
+    def param_shapes(self):
+        return {"gamma": (self.nIn,), "beta": (self.nIn,)} if self.nIn else {}
+
     def initialize(self, key):
         return {"gamma": jnp.ones((self.nIn,), jnp.float32),
                 "beta": jnp.zeros((self.nIn,), jnp.float32)}, {}
@@ -1324,6 +1414,9 @@ class GroupNorm(Layer):
 
     def mxu_lane_dims(self):
         return []   # elementwise gain/bias — no matmul
+
+    def param_shapes(self):
+        return {"gamma": (self.nIn,), "beta": (self.nIn,)} if self.nIn else {}
 
     def initialize(self, key):
         return {"gamma": jnp.ones((self.nIn,), jnp.float32),
@@ -1507,6 +1600,18 @@ class SelfAttentionLayer(Layer):
                     "SelfAttentionLayer: projectInput=False requires "
                     f"nHeads=1 and nOut==nIn (got nHeads={self.n_heads}, "
                     f"nIn={self.nIn}, nOut={self.nOut})")
+
+    def param_shapes(self):
+        if not self.project or not self.nIn or not self.nOut \
+                or not self.head_size:
+            return {}
+        E = self.n_heads * self.head_size
+        shapes = {"Wq": (self.nIn, E), "Wk": (self.nIn, E),
+                  "Wv": (self.nIn, E), "Wo": (E, self.nOut)}
+        if getattr(self, "use_bias", False):
+            shapes.update({"bq": (E,), "bk": (E,), "bv": (E,),
+                           "bo": (self.nOut,)})
+        return shapes
 
     def initialize(self, key):
         if not self.project:
@@ -1753,6 +1858,14 @@ class Convolution3D(Layer):
         if self.has_bias:
             params["b"] = jnp.full((self.nOut,), self.bias_init, jnp.float32)
         return params, {}
+
+    def param_shapes(self):
+        if not self.nIn or not self.nOut:
+            return {}
+        shapes = {"W": (self.nOut, self.nIn) + tuple(self.kernel)}
+        if self.has_bias:
+            shapes["b"] = (self.nOut,)
+        return shapes
 
     def apply(self, params, state, x, train, key):
         x = self._maybe_dropout(x, train, key)

@@ -157,15 +157,19 @@ class MultiLayerNetwork:
         self._initialized = False
 
     # ------------------------------------------------------------ validation
-    def validate(self, batch_size: int = None, data_devices: int = None):
+    def validate(self, batch_size: int = None, data_devices: int = None,
+                 **kw):
         """Static lint of this network: the configuration analysis
         (shape/dtype propagation + structural diagnostics + TPU layout
         lints) plus model-level findings (frozen-layer/updater pairing,
         accumulated recompile-churn W201s). Returns a
-        ``deeplearning4j_tpu.analysis.ValidationReport``; no jax work."""
+        ``deeplearning4j_tpu.analysis.ValidationReport``; no jax work.
+        Extra keywords pass through to ``analysis.analyze``: ``mesh=``,
+        ``sharding=``, ``pipeline=``, ``hbm_gb=``, ``suppress=``,
+        ``severity_overrides=``."""
         from deeplearning4j_tpu.analysis import analyze
         return analyze(self, batch_size=batch_size,
-                       data_devices=data_devices)
+                       data_devices=data_devices, **kw)
 
     # ------------------------------------------------------------------ init
     def init(self, seed: int = None, strict: bool = False):
@@ -338,11 +342,20 @@ class MultiLayerNetwork:
         on the calling thread — required for thread-affine data sources
         like sqlite cursors). Numerically equivalent to K single-step
         fits; listeners observe the K per-step losses after each
-        dispatch."""
+        dispatch.
+
+        A configuration built with ``backpropType('tbptt',
+        tBPTTLength=L)`` trains truncated: every sequence batch
+        ([N, C, T] features) is segmented into length-L windows via the
+        compiled TBPTT step, identical to calling ``fitTBPTT(ds, L)``
+        per batch (pinned by an equivalence test). The TBPTT path keeps
+        its segment-level dispatch — ``steps_per_dispatch`` does not
+        apply to it (megastep x TBPTT composition is a ROADMAP item)."""
         if not self._initialized:
             self.init()
         self._ensure_opt_state()
         _maybe_attach_env_profiler(self)
+        tbptt_len = self._tbptt_length()
 
         def batches():
             if isinstance(data, DataSetIterator):
@@ -361,7 +374,13 @@ class MultiLayerNetwork:
                 # data-wait vs compute split: time spent pulling the next
                 # batch from the (possibly async) iterator is the input
                 # pipeline's bill, not the device's
-                if steps_per_dispatch > 1:
+                if tbptt_len is not None:
+                    for ds in _prof.iter_with_data_wait(batches()):
+                        if ds.features.ndim == 3:
+                            self.fitTBPTT(ds, tbptt_len)
+                        else:        # non-sequence batch: nothing to
+                            self._fit_one(ds)     # segment (W002 case)
+                elif steps_per_dispatch > 1:
                     _stepping.fit_epoch_multistep(self, batches(),
                                                   steps_per_dispatch, prefetch)
                 else:
@@ -606,6 +625,17 @@ class MultiLayerNetwork:
     def rnnGetPreviousState(self, layer_idx: int):
         states = getattr(self, "_rnn_states", None)
         return states[layer_idx] if states else None
+
+    def _tbptt_length(self):
+        """Configured truncation length when the config declares TBPTT
+        (``backpropType('tbptt') + tBPTTLength``), else None — ``fit()``
+        segments sequence batches automatically when set."""
+        bp = str(getattr(self.conf, "backprop_type", "standard")
+                 or "standard").lower()
+        if bp in ("tbptt", "truncatedbptt", "truncated_bptt") \
+                and getattr(self.conf, "tbptt_length", None):
+            return int(self.conf.tbptt_length)
+        return None
 
     def fitTBPTT(self, ds: DataSet, tbptt_length: int):
         """Truncated BPTT (ref: BackpropType.TruncatedBPTT + tBPTTLength):

@@ -90,7 +90,6 @@ def lrn(x, *, depth: int = 5, alpha: float = 1e-4, beta: float = 0.75,
     DL4J LocalResponseNormalization; AlexNet uses this)."""
     c_axis = 1 if data_format.upper().startswith("NC") else x.ndim - 1
     sq = jnp.square(x)
-    c = x.shape[c_axis]
     # sum over a window of `depth` channels centred at each channel
     half = depth // 2
     pad_cfg = [(0, 0)] * x.ndim

@@ -31,6 +31,11 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+#: the compiler-params class was renamed TPUCompilerParams -> CompilerParams
+#: across jax releases; resolve whichever this pin ships
+_COMPILER_PARAMS = getattr(pltpu, "CompilerParams", None) \
+    or getattr(pltpu, "TPUCompilerParams", None)
+
 _ROW_BLOCK = 256
 
 
@@ -260,7 +265,7 @@ def _flash_fwd_pallas(q, k, v, *, causal: bool, bq: int, bk: int,
             pltpu.VMEM((bq, 128), jnp.float32),    # running max
             pltpu.VMEM((bq, 128), jnp.float32),    # running sum
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_COMPILER_PARAMS(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v)

@@ -20,7 +20,7 @@ over the reference's Aeron mesh + Spark topology (MeshOrganizer etc.).
 
 from __future__ import annotations
 
-from typing import Dict, Sequence, Tuple
+from typing import Any, Dict, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -54,6 +54,15 @@ class DeviceMesh:
     @property
     def axis_names(self):
         return self.mesh.axis_names
+
+    def spec(self, **kw) -> "Any":
+        """Jax-free declaration of this mesh for the static distribution
+        analyzer (:class:`analysis.distribution.MeshSpec`) — pass it (or
+        this DeviceMesh directly) to ``model.validate(mesh=...)``.
+        Keywords forward to MeshSpec (``sharding=``, ``pipeline=``,
+        ``hbm_gb=``)."""
+        from deeplearning4j_tpu.analysis.distribution import MeshSpec
+        return MeshSpec(dict(self.mesh.shape), **kw)
 
     def size(self, axis: str = None) -> int:
         if axis is None:

@@ -42,6 +42,16 @@ class ParallelWrapper:
         self.mesh = mesh or DeviceMesh.data_parallel()
         self.prefetch = prefetch_buffer
 
+    def validate(self, batch_size: int = None, **kw):
+        """Static lint of the wrapped model against THIS wrapper's mesh:
+        the full configuration analysis plus the E1xx/W10x distribution
+        lints (batch divisibility, replicated giants, HBM budget, ...).
+        Pass ``batch_size`` for the per-step checks; extra keywords
+        forward to ``analysis.analyze`` (``sharding=``, ``hbm_gb=``,
+        ``suppress=``, ...)."""
+        return self.model.validate(batch_size=batch_size, mesh=self.mesh,
+                                   **kw)
+
     def fit(self, iterator: DataSetIterator, epochs: int = 1,
             steps_per_dispatch: int = 1):
         """``steps_per_dispatch=K`` composes the data-parallel path with

@@ -176,7 +176,6 @@ class A3CDiscreteDense:
 
     # ------------------------------------------------------------- update
     def _make_step(self):
-        gamma = self.conf.gamma
         beta = self.conf.entropy_beta
         vc = self.conf.value_coef
         lr = self.conf.learning_rate

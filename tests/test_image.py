@@ -161,6 +161,11 @@ class TestObjectDetection:
             label_provider=self._provider(boxes),
             classes=["obj"]).initialize(img_dir)
         it = ObjectDetectionDataSetIterator(rr, batch_size=6)
+        # raw [0, 255] pixels through exp(wh) overflow Adam's fp32 second
+        # moment (g^2 ~ 1e68 -> inf -> zero updates); the reference
+        # pipeline scales pixels first, same here
+        from deeplearning4j_tpu.data.dataset import ImagePreProcessingScaler
+        it.setPreProcessor(ImagePreProcessingScaler())
         anchors = np.asarray([[1.0, 1.0], [2.5, 2.5]], np.float32)
         conf = (NeuralNetConfiguration.Builder().seed(7)
                 .updater(updaters.Adam(1e-3)).list()

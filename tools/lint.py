@@ -10,8 +10,12 @@ of the same rules:
   ``__init__.py`` re-export surfaces)
 - ``F632``  ``is``/``is not`` comparison against a str/int/tuple literal
 - ``F811``  module-level def/class silently redefining an earlier one
+- ``F841``  local variable assigned but never used (plain single-name
+  assignments only; ``_``-prefixed names exempt; skipped under tests/
+  to match the ruff per-file-ignores)
 - ``B006``  mutable default argument ([], {}, set()/list()/dict())
 - ``E722``  bare ``except:``
+- ``W605``  invalid escape sequence in a non-raw string literal
 
 ``# noqa`` (bare, or ``# noqa: F401,...``) on the flagged line suppresses
 a finding, matching ruff semantics, so both linters agree on the same
@@ -26,10 +30,12 @@ from __future__ import annotations
 
 import argparse
 import ast
+import io
 import re
 import shutil
 import subprocess
 import sys
+import tokenize
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
@@ -153,6 +159,100 @@ def _check_e722(tree, path: Path, findings):
                                     "bare 'except:' — name the exception"))
 
 
+def _scope_statements(fn):
+    """Nodes belonging to ``fn``'s own scope — descends everything except
+    nested function/class/lambda bodies (their assignments are THEIR
+    locals, and each nested def is linted as its own scope)."""
+    stack = list(fn.body)
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef, ast.Lambda)):
+                continue
+            stack.append(child)
+
+
+def _check_f841(tree, path: Path, findings):
+    """Local assigned but never used. Conservative subset of ruff's F841:
+    plain single-Name ``x = ...`` / annotated assignments only (tuple
+    unpacking, loop targets, and aug-assigns are deliberate far too often
+    to flag), ``_``-prefixed names exempt, and a name counts as used if it
+    is loaded ANYWHERE inside the function — including nested closures
+    and short string constants (quoted forward refs)."""
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        used = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) and not isinstance(node.ctx,
+                                                             ast.Store):
+                used.add(node.id)
+            elif isinstance(node, (ast.Global, ast.Nonlocal)):
+                used.update(node.names)
+            elif isinstance(node, ast.Constant) \
+                    and isinstance(node.value, str) and len(node.value) < 200:
+                used.update(_WORD_RE.findall(node.value))
+        first_assign = {}
+        for node in _scope_statements(fn):
+            target = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                target = node.targets[0].id
+            elif isinstance(node, ast.AnnAssign) \
+                    and isinstance(node.target, ast.Name) \
+                    and node.value is not None:
+                target = node.target.id
+            if target and not target.startswith("_") \
+                    and target not in used:
+                first_assign.setdefault(target, node.lineno)
+        for name, lineno in sorted(first_assign.items(),
+                                   key=lambda kv: kv[1]):
+            findings.append(Finding(
+                path, lineno, "F841",
+                f"local variable '{name}' is assigned to but never used"))
+
+
+#: every escape the language defines for str literals (bytes' stricter
+#: set is not distinguished — conservative)
+_VALID_ESCAPES = frozenset("\n\\'\"abfnrtv01234567xNuU")
+
+
+def _check_w605(source: str, path: Path, findings):
+    """Invalid escape sequences in non-raw string literals — today a
+    DeprecationWarning, eventually a SyntaxError, always a latent regex
+    or path bug. Token-level (not AST) so every literal is seen exactly
+    where it is written."""
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, SyntaxError, IndentationError):
+        return
+    for tok in tokens:
+        if tok.type != tokenize.STRING:
+            continue
+        text = tok.string
+        prefix = re.match(r"[A-Za-z]*", text).group(0)
+        if "r" in prefix.lower():
+            continue
+        rest = text[len(prefix):]
+        qlen = 3 if rest[:3] in ('"""', "'''") else 1
+        body = rest[qlen:-qlen]
+        line = tok.start[0]
+        i = 0
+        while i < len(body) - 1:
+            if body[i] == "\\":
+                nxt = body[i + 1]
+                if nxt not in _VALID_ESCAPES:
+                    findings.append(Finding(
+                        path, line + body[:i].count("\n"), "W605",
+                        f"invalid escape sequence '\\{nxt}' — use a raw "
+                        f"string (r'...') or double the backslash"))
+                i += 2
+            else:
+                i += 1
+
+
 def lint_file(path: Path):
     source = path.read_text(encoding="utf-8")
     try:
@@ -163,6 +263,10 @@ def lint_file(path: Path):
     for check in (_check_f401, _check_f811, _check_f632, _check_b006,
                   _check_e722):
         check(tree, path, findings)
+    # tests/* keep F841 probes (mirrors the pyproject per-file-ignores)
+    if "tests" not in path.parts:
+        _check_f841(tree, path, findings)
+    _check_w605(source, path, findings)
     noqa = _noqa_lines(source)
     return [f for f in findings
             if not (f.line in noqa and
