@@ -11,6 +11,9 @@ from deeplearning4j_tpu.data.dataset import (  # noqa: F401
     MultiDataSet,
     NormalizerMinMaxScaler,
     NormalizerStandardize,
+    RetryingDataSetIterator,
+    TransientDataError,
+    is_transient_error,
 )
 from deeplearning4j_tpu.data.iterators import (  # noqa: F401
     IrisDataSetIterator,

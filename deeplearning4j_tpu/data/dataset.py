@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Callable, Iterable, Iterator, List, Sequence
 
 import jax
@@ -39,6 +40,46 @@ _PREFETCH_H2D_BYTES = _REG.counter(
     "dl4j_prefetch_h2d_bytes_total",
     "Host bytes staged onto the device by DevicePrefetcher while prior "
     "dispatches compute (H2D/compute overlap)")
+_DATA_RETRIES = _REG.counter(
+    "dl4j_data_retries_total",
+    "Transient data-pipeline errors retried (RetryingDataSetIterator / "
+    "AsyncDataSetIterator bounded backoff)")
+
+
+class TransientDataError(IOError):
+    """A data-pipeline error the source declares RETRYABLE (flaky
+    network filesystem, object-store 5xx, preempted reader): the bounded
+    retry-with-backoff paths (RetryingDataSetIterator,
+    AsyncDataSetIterator) re-pull instead of killing the fit. Any other
+    exception type can opt in by setting a truthy ``transient``
+    attribute."""
+
+    transient = True
+
+
+def is_transient_error(e: BaseException) -> bool:
+    """True when the error is marked retryable (see TransientDataError)."""
+    return bool(getattr(e, "transient", False))
+
+
+def _retry_pull(pull, max_retries: int, backoff: float, sleep):
+    """The one bounded transient-retry loop both data paths share
+    (AsyncDataSetIterator's worker and RetryingDataSetIterator):
+    exponential backoff, ``dl4j_data_retries_total`` per retry,
+    immediate propagation of non-transient errors. ``sleep(seconds)``
+    returns True to abort retrying (the async worker passes its stop
+    event's ``wait``)."""
+    attempt = 0
+    while True:
+        try:
+            return pull()
+        except BaseException as e:
+            if attempt >= max_retries or not is_transient_error(e):
+                raise
+            attempt += 1
+            _DATA_RETRIES.inc()
+            if sleep(backoff * (2 ** (attempt - 1))):
+                raise
 
 
 def _as_batch_array(a):
@@ -162,6 +203,20 @@ class DataSetIterator:
     def batch(self) -> int:
         raise NotImplementedError
 
+    # -- checkpoint/resume cursor protocol (train.resilience) --
+    def cursor(self):
+        """JSON-able position token for checkpoint/resume, or None when
+        the source cannot seek. Captured by the resilience layer right
+        after each pull so a resumed fit continues from the exact batch
+        the restored step count expects."""
+        return None
+
+    def seek(self, cursor) -> None:
+        """Restore a position previously returned by :meth:`cursor`."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support seek(); checkpoint "
+            "resume will restart this iterator from the beginning")
+
     def setPreProcessor(self, pre):
         self._pre = pre
 
@@ -210,6 +265,23 @@ class ListDataSetIterator(DataSetIterator):
     def batch(self):
         return self.batch_size
 
+    def cursor(self):
+        """Position + epoch: enough to rebuild the (seeded) shuffle
+        order deterministically on seek."""
+        return {"pos": int(self._pos), "epoch": int(self._epoch)}
+
+    def seek(self, cursor) -> None:
+        epoch = int(cursor["epoch"])
+        if self._shuffle:
+            # reset() drew the order from seed + epoch THEN incremented
+            # _epoch, so the order for stored epoch e came from seed+e-1
+            rng = np.random.RandomState(self._seed + max(epoch - 1, 0))
+            self._order = rng.permutation(self.data.numExamples())
+        else:
+            self._order = np.arange(self.data.numExamples())
+        self._epoch = epoch
+        self._pos = int(cursor["pos"])
+
     def totalOutcomes(self):
         return self.data.labels.shape[1] if self.data.labels is not None else 0
 
@@ -233,29 +305,50 @@ def _offer_until_stopped(q, item, stop) -> bool:
 
 class AsyncDataSetIterator(DataSetIterator):
     """Background prefetch wrapper (ref: AsyncDataSetIterator — the
-    process-internal thread boundary in SURVEY.md §3.1)."""
+    process-internal thread boundary in SURVEY.md §3.1).
+
+    ``max_retries`` adds a bounded retry-with-exponential-backoff around
+    the worker's base-iterator pulls for errors marked transient
+    (:class:`TransientDataError` / a truthy ``transient`` attribute),
+    counted in ``dl4j_data_retries_total``. Any worker error the
+    consumer never observed is re-raised by ``close()`` — before that,
+    an exception racing a ``close()`` was silently dropped. Double
+    ``close()`` is idempotent."""
 
     _END = object()
 
-    def __init__(self, base: DataSetIterator, prefetch: int = 2):
+    def __init__(self, base: DataSetIterator, prefetch: int = 2,
+                 max_retries: int = 0, retry_backoff: float = 0.05):
         self.base = base
         self.prefetch = prefetch
+        self.max_retries = max_retries
+        self.retry_backoff = retry_backoff
         self._queue = None
         self._thread = None
         self._next_item = None
         self._stop = None
+        self._pending_error = None
         self.reset()
+
+    def _pull_with_retry(self, stop):
+        # stop.wait as the sleep: a shutdown mid-backoff aborts the retry
+        return _retry_pull(self.base.next, self.max_retries,
+                           self.retry_backoff, stop.wait)
 
     def _worker(self, q, stop):
         try:
             while not stop.is_set() and self.base.hasNext():
-                if not _offer_until_stopped(q, self.base.next(), stop):
+                if not _offer_until_stopped(q, self._pull_with_retry(stop),
+                                            stop):
                     return
         except BaseException as e:
             # surface on the consumer thread: letting the exception kill
             # the worker would enqueue _END and silently truncate the
             # stream (e.g. an evaluation quietly computed on 2 of 100
-            # batches)
+            # batches). Also recorded so close() can propagate an error
+            # the consumer never pulled.
+            if self._pending_error is None:
+                self._pending_error = e
             _offer_until_stopped(q, _PrefetchFailure(e), stop)
         finally:
             # block-put the END sentinel with the same stop-checked retry as
@@ -277,7 +370,11 @@ class AsyncDataSetIterator(DataSetIterator):
 
     def reset(self):
         self._shutdown_worker()
+        self._pending_error = None      # explicit restart: fresh slate
         self.base.reset()
+        self._restart_worker()
+
+    def _restart_worker(self):
         self._stop = threading.Event()
         self._queue = queue.Queue(maxsize=self.prefetch)
         self._thread = threading.Thread(target=self._worker,
@@ -287,17 +384,28 @@ class AsyncDataSetIterator(DataSetIterator):
         self._next_item = self._queue.get()
 
     def close(self):
-        """Stop the prefetch thread and drop buffered batches. Safe to
-        call repeatedly; the iterator reads as exhausted afterwards (a
-        later reset() restarts it). Before this existed the reset() drain
-        loop was the only shutdown path."""
+        """Stop the prefetch thread and drop buffered batches.
+        Idempotent; the iterator reads as exhausted afterwards (a later
+        reset() restarts it). Re-raises the FIRST worker error the
+        consumer never saw — a failure that landed in the buffer just as
+        the consumer stopped pulling must not vanish."""
         self._shutdown_worker()
         self._next_item = self._END
+        err, self._pending_error = self._pending_error, None
+        if err is not None:
+            raise err
 
     def __enter__(self):
         return self
 
     def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None:
+            # already unwinding: shut down without masking the original
+            try:
+                self.close()
+            except BaseException:
+                pass
+            return False
         self.close()
         return False
 
@@ -308,6 +416,8 @@ class AsyncDataSetIterator(DataSetIterator):
         item = self._next_item
         if isinstance(item, _PrefetchFailure):
             self._next_item = self._END
+            if self._pending_error is item.error:
+                self._pending_error = None      # delivered here, not close()
             raise item.error
         self._next_item = self._queue.get()
         if _prof.instrumentation_active():
@@ -316,6 +426,53 @@ class AsyncDataSetIterator(DataSetIterator):
 
     def batch(self):
         return self.base.batch()
+
+    def cursor(self):
+        """Base cursor — NOTE: the worker prefetches ahead, so this can
+        overstate consumed position by up to ``prefetch+1`` batches; for
+        exact resume cursors feed the resilience layer an un-prefetched
+        iterator (it records cursors at the pull seam itself)."""
+        return self.base.cursor()
+
+    def seek(self, cursor) -> None:
+        self._shutdown_worker()
+        self._pending_error = None
+        self.base.seek(cursor)
+        self._restart_worker()
+
+
+class RetryingDataSetIterator(DataSetIterator):
+    """Bounded retry-with-exponential-backoff around a flaky source
+    iterator: ``next()`` re-pulls on errors marked transient
+    (:class:`TransientDataError` / ``transient`` attribute) up to
+    ``max_retries`` times, counting ``dl4j_data_retries_total``;
+    permanent errors propagate immediately. The resilience layer wraps
+    fit() iterators with this automatically."""
+
+    def __init__(self, base: DataSetIterator, max_retries: int = 3,
+                 backoff: float = 0.05):
+        self.base = base
+        self.max_retries = max_retries
+        self.backoff = backoff
+
+    def hasNext(self):
+        return self.base.hasNext()
+
+    def next(self):
+        return _retry_pull(self.base.next, self.max_retries, self.backoff,
+                           time.sleep)
+
+    def reset(self):
+        self.base.reset()
+
+    def batch(self):
+        return self.base.batch()
+
+    def cursor(self):
+        return self.base.cursor()
+
+    def seek(self, cursor) -> None:
+        self.base.seek(cursor)
 
 
 class IterableDataSetIterator(DataSetIterator):
@@ -378,13 +535,21 @@ class DevicePrefetcher:
     _END = object()
 
     def __init__(self, batches: Iterable, steps_per_dispatch: int = 1,
-                 prefetch: int = 2, placement: Callable = None):
+                 prefetch: int = 2, placement: Callable = None,
+                 max_retries: int = 0, retry_backoff: float = 0.05):
         from deeplearning4j_tpu.train.stepping import group_into_megabatches
         self._placement = placement
         self._queue = queue.Queue(maxsize=max(1, prefetch))
         self._stop = threading.Event()
+        if max_retries and isinstance(batches, DataSetIterator):
+            # transient-error retry happens at the pull seam: a generator
+            # source dies on raise and cannot be retried, a DataSetIterator
+            # can re-serve the failed pull
+            batches = RetryingDataSetIterator(batches, max_retries,
+                                              retry_backoff)
         self._src = group_into_megabatches(batches, steps_per_dispatch)
         self._done = False
+        self._pending_error = None
         self._thread = threading.Thread(target=self._worker, daemon=True)
         self._thread.start()
 
@@ -408,6 +573,9 @@ class DevicePrefetcher:
                 if not self._offer(self._stage(item)):
                     return
         except BaseException as e:  # surface in the consumer, not the log
+            # record first so a close() racing this offer still sees it
+            if self._pending_error is None:
+                self._pending_error = e
             self._offer(_PrefetchFailure(e))
         finally:
             self._offer(self._END)
@@ -427,11 +595,16 @@ class DevicePrefetcher:
             raise StopIteration
         if isinstance(item, _PrefetchFailure):
             self._done = True
+            if self._pending_error is item.error:
+                self._pending_error = None      # delivered to the consumer
             raise item.error
         return item
 
     def close(self):
-        """Stop the worker and drop staged batches. Idempotent."""
+        """Stop the worker and drop staged batches. Idempotent; re-raises
+        the FIRST worker error the consumer never pulled (a failure
+        buffered just as the consumer stopped iterating must not be
+        silently dropped)."""
         self._stop.set()
         while self._thread is not None and self._thread.is_alive():
             try:
@@ -442,11 +615,20 @@ class DevicePrefetcher:
         self._thread = None
         self._done = True
         _PREFETCH_QUEUE_DEPTH.set(0)
+        err, self._pending_error = self._pending_error, None
+        if err is not None:
+            raise err
 
     def __enter__(self):
         return self
 
     def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None:
+            try:
+                self.close()
+            except BaseException:
+                pass                # don't mask the in-flight exception
+            return False
         self.close()
         return False
 

@@ -1,0 +1,231 @@
+"""Deterministic fault-injection harness for the resilience layer.
+
+Large-scale training treats failure as the common case: preemptions,
+poisoned batches, flaky storage, NaN updates (Abadi et al., 2016 make
+periodic checkpointing + automatic recovery a founding design point;
+multi-hour data-parallel accelerator jobs hit preemption as a matter of
+course). A recovery path that is not exercised by a test is a recovery
+path that does not work — this module makes every failure mode the
+training stack claims to survive *injectable, deterministic, and
+seedable*, behind the seams the real failures would hit:
+
+- **NaN gradients at step k** — the k-th pulled batch has its features
+  poisoned with NaN, so the compiled step's loss/grads go non-finite
+  exactly the way a real numerics blow-up does (through the device, not
+  by monkeypatching the loss).
+- **Data-pipeline errors at step k** — the iterator raises on the k-th
+  ``next()`` pull; marked transient (``TransientDataError``) the retry
+  path must recover, marked permanent it must propagate.
+- **Checkpoint write failure / corruption at step k** — the manager's
+  write raises ``OSError`` once (retry-with-backoff must succeed), or
+  the finalized checkpoint has bytes flipped post-write (resume-time
+  checksum validation must quarantine it).
+- **Synthetic preemption at step k** — a pluggable
+  :class:`~deeplearning4j_tpu.train.resilience.PreemptionSignal` that
+  fires once step k completes, standing in for SIGTERM.
+
+Every fault fires exactly once per planned step index (so a retried
+pull succeeds, like a real transient), and :meth:`FaultPlan.seeded`
+derives a whole plan from one integer seed for sweep-style chaos tests
+(``pytest -m chaos``).
+
+Step indices are **1-based global update steps** — step k poisons the
+k-th batch pulled, which is the k-th update applied (pull order is
+apply order through the megabatch grouping and the prefetcher).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterable, Optional, Set
+
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import (DataSet, DataSetIterator,
+                                             MultiDataSet, TransientDataError)
+
+
+def _as_step_set(steps) -> Set[int]:
+    if steps is None:
+        return set()
+    if isinstance(steps, int):
+        return {steps}
+    return {int(s) for s in steps}
+
+
+class FaultPlan:
+    """A deterministic schedule of injected faults.
+
+    Parameters name the failure mode and the 1-based update step(s) it
+    fires at; each planned (mode, step) fires exactly once. Pass the
+    plan to ``fit(..., faults=plan)`` (or a ``CheckpointManager``) and
+    the resilience layer wires it behind the real seams.
+    """
+
+    def __init__(self, seed: int = 0,
+                 nan_grads_at: Iterable[int] = (),
+                 data_error_at: Iterable[int] = (),
+                 data_error_transient: bool = True,
+                 checkpoint_write_fail_at: Iterable[int] = (),
+                 checkpoint_corrupt_at: Iterable[int] = (),
+                 preempt_at_step: Optional[int] = None):
+        self.seed = seed
+        self.nan_grads_at = _as_step_set(nan_grads_at)
+        self.data_error_at = _as_step_set(data_error_at)
+        self.data_error_transient = bool(data_error_transient)
+        self.checkpoint_write_fail_at = _as_step_set(checkpoint_write_fail_at)
+        self.checkpoint_corrupt_at = _as_step_set(checkpoint_corrupt_at)
+        self.preempt_at_step = preempt_at_step
+        # consumed-state: each fault fires once
+        self._nan_pending = set(self.nan_grads_at)
+        self._data_pending = set(self.data_error_at)
+        self._ckpt_fail_pending = set(self.checkpoint_write_fail_at)
+        self._ckpt_corrupt_pending = set(self.checkpoint_corrupt_at)
+        self._pull_index = 0
+
+    @classmethod
+    def seeded(cls, seed: int, horizon: int, n_nan: int = 1,
+               n_data_errors: int = 1, preempt: bool = False,
+               corrupt_checkpoint: bool = False) -> "FaultPlan":
+        """Derive a whole plan from one seed: fault steps are drawn
+        without replacement from ``[2, horizon]`` (step 1 is left clean
+        so every run performs at least one good update first). The chaos
+        sweep (``pytest -m chaos``) runs this across a seed range."""
+        rng = np.random.RandomState(seed)
+        n_faults = n_nan + n_data_errors + (1 if preempt else 0)
+        lo = 2
+        pool = rng.permutation(np.arange(lo, max(horizon + 1, lo + n_faults)))
+        picks = [int(p) for p in pool[:n_faults]]
+        nan_at = picks[:n_nan]
+        data_at = picks[n_nan:n_nan + n_data_errors]
+        preempt_at = picks[-1] if preempt else None
+        return cls(seed=seed, nan_grads_at=nan_at, data_error_at=data_at,
+                   preempt_at_step=preempt_at,
+                   checkpoint_corrupt_at=(
+                       [int(rng.randint(lo, horizon + 1))]
+                       if corrupt_checkpoint else ()))
+
+    # ----------------------------------------------------------- data seams
+    def wrap_iterator(self, iterator: DataSetIterator) -> DataSetIterator:
+        """Wrap a DataSetIterator so the data-side faults (NaN batches,
+        iterator errors) fire at the planned pull indices."""
+        return _FaultInjectionIterator(iterator, self)
+
+    def _on_pull(self):
+        """One batch pull is about to be served: returns the poisoned
+        batch transform (or raises the planned iterator error). Called
+        by the injection iterator only."""
+        self._pull_index += 1
+        k = self._pull_index
+        if k in self._data_pending:
+            self._data_pending.discard(k)
+            # the pull index is NOT rolled back: the retry that follows
+            # delivers this same batch (the base iterator never advanced)
+            self._pull_index -= 1
+            if self.data_error_transient:
+                raise TransientDataError(
+                    f"injected transient data error at step {k} "
+                    f"(FaultPlan seed={self.seed})")
+            raise IOError(f"injected permanent data error at step {k} "
+                          f"(FaultPlan seed={self.seed})")
+        if k in self._nan_pending:
+            self._nan_pending.discard(k)
+            return True
+        return False
+
+    # ------------------------------------------------------ checkpoint seams
+    def checkpoint_write_error(self, step: int) -> bool:
+        """True exactly once for a step planned to fail its checkpoint
+        write — the manager raises OSError, and the retry-with-backoff
+        path gets a clean second attempt."""
+        if step in self._ckpt_fail_pending:
+            self._ckpt_fail_pending.discard(step)
+            return True
+        return False
+
+    def corrupt_checkpoint(self, step: int, directory: str) -> bool:
+        """After a checkpoint for ``step`` is finalized: flip bytes in
+        its model archive if the plan says so, leaving a checkpoint
+        whose manifest checksums no longer match (resume must
+        quarantine it). Returns True when corruption was applied."""
+        if step not in self._ckpt_corrupt_pending:
+            return False
+        self._ckpt_corrupt_pending.discard(step)
+        target = os.path.join(directory, "model.zip")
+        if not os.path.exists(target):
+            return False
+        size = os.path.getsize(target)
+        with open(target, "r+b") as f:
+            f.seek(size // 2)
+            chunk = f.read(64)
+            f.seek(size // 2)
+            f.write(bytes(b ^ 0xFF for b in chunk))
+        return True
+
+    # ------------------------------------------------------ preemption seam
+    def preemption_signal(self):
+        """A StepPreemption for the planned synthetic preemption, or
+        None when the plan has no preemption."""
+        if self.preempt_at_step is None:
+            return None
+        from deeplearning4j_tpu.train.resilience import StepPreemption
+        return StepPreemption(self.preempt_at_step)
+
+    def __repr__(self):
+        return (f"FaultPlan(seed={self.seed}, nan={sorted(self.nan_grads_at)}, "
+                f"data={sorted(self.data_error_at)}"
+                f"{' transient' if self.data_error_transient else ' permanent'}, "
+                f"ckpt_fail={sorted(self.checkpoint_write_fail_at)}, "
+                f"ckpt_corrupt={sorted(self.checkpoint_corrupt_at)}, "
+                f"preempt={self.preempt_at_step})")
+
+
+def _poison(ds):
+    """NaN-poisoned copy of a batch: features become NaN so the compiled
+    step's loss and gradients go non-finite through the real device
+    path."""
+    if isinstance(ds, MultiDataSet):
+        out = MultiDataSet.__new__(MultiDataSet)
+        out.features = [np.full_like(np.asarray(a), np.nan)
+                        for a in ds.features]
+        out.labels = list(ds.labels)
+        out.features_masks = ds.features_masks
+        out.labels_masks = ds.labels_masks
+        return out
+    out = DataSet.__new__(DataSet)
+    out.features = np.full_like(np.asarray(ds.features, dtype=np.float32),
+                                np.nan)
+    out.labels = ds.labels
+    out.features_mask = ds.features_mask
+    out.labels_mask = ds.labels_mask
+    return out
+
+
+class _FaultInjectionIterator(DataSetIterator):
+    """DataSetIterator wrapper executing a FaultPlan's data-side faults:
+    raises the planned iterator errors (without advancing the base, so a
+    retry delivers the batch) and NaN-poisons the planned batches."""
+
+    def __init__(self, base: DataSetIterator, plan: FaultPlan):
+        self.base = base
+        self.plan = plan
+
+    def hasNext(self) -> bool:
+        return self.base.hasNext()
+
+    def next(self):
+        poison = self.plan._on_pull()          # may raise the planned error
+        ds = self.base.next()
+        return _poison(ds) if poison else ds
+
+    def reset(self):
+        self.base.reset()
+
+    def batch(self):
+        return self.base.batch()
+
+    def cursor(self):
+        return self.base.cursor()
+
+    def seek(self, cursor):
+        self.base.seek(cursor)

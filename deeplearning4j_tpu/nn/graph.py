@@ -602,19 +602,31 @@ class ComputationGraph:
         return self._t_dev
 
     def fit(self, data, labels=None, epochs: int = 1,
-            steps_per_dispatch: int = 1, prefetch: int = 2):
+            steps_per_dispatch: int = 1, prefetch: int = 2,
+            checkpoint=None, nan_policy=None, faults=None):
         """Accepts a DataSetIterator, DataSet, MultiDataSet, or arrays.
         ``steps_per_dispatch=K`` runs K update steps per compiled dispatch
         with double-buffered device prefetch (``prefetch=0`` = synchronous
-        consumption on the calling thread) — see MultiLayerNetwork.fit."""
+        consumption on the calling thread) — see MultiLayerNetwork.fit.
+        ``checkpoint=``/``nan_policy=``/``faults=`` enable the fault-
+        tolerance layer (atomic checkpoint + auto-resume, NaN recovery
+        policies, deterministic fault injection) — semantics identical to
+        MultiLayerNetwork.fit."""
         if not self._initialized:
             self.init()
         self._ensure_opt_state()
         _maybe_attach_env_profiler(self)
+        session = None
+        if checkpoint is not None or nan_policy is not None \
+                or faults is not None:
+            from deeplearning4j_tpu.train import resilience as _resilience
+            session, data = _resilience.begin_session(
+                self, data, checkpoint, nan_policy, faults)
 
         def batches():
             if isinstance(data, DataSetIterator):
-                data.reset()
+                if session is None or not session.consume_skip_reset():
+                    data.reset()
                 while data.hasNext():
                     yield data.next()
             elif isinstance(data, (DataSet, MultiDataSet)):
@@ -625,19 +637,28 @@ class ComputationGraph:
             else:
                 yield DataSet(np.asarray(data), np.asarray(labels))
 
-        for _ in range(epochs):
-            with _prof.trace_span("train:epoch", epoch=self._epoch):
-                # data-wait vs compute split (see MultiLayerNetwork.fit)
-                if steps_per_dispatch > 1:
-                    _stepping.fit_epoch_multistep(self, batches(),
-                                                  steps_per_dispatch, prefetch)
-                else:
-                    for ds in _prof.iter_with_data_wait(batches()):
-                        self._fit_one(ds)
-            self._epoch += 1
-            for lst in self._listeners:
-                if hasattr(lst, "onEpochEnd"):
-                    lst.onEpochEnd(self)
+        def epoch_stream():
+            return session.wrap_batches(batches()) if session is not None \
+                else batches()
+
+        from deeplearning4j_tpu.train.resilience import fit_scope
+        with fit_scope(session, self, epochs) as n_epochs:
+            for _ in range(n_epochs):
+                with _prof.trace_span("train:epoch", epoch=self._epoch):
+                    # data-wait vs compute split (see MultiLayerNetwork.fit)
+                    if steps_per_dispatch > 1:
+                        _stepping.fit_epoch_multistep(self, epoch_stream(),
+                                                      steps_per_dispatch,
+                                                      prefetch)
+                    else:
+                        for ds in _prof.iter_with_data_wait(epoch_stream()):
+                            self._fit_one(ds)
+                self._epoch += 1
+                for lst in self._listeners:
+                    if hasattr(lst, "onEpochEnd"):
+                        lst.onEpochEnd(self)
+                if session is not None:
+                    session.on_epoch_end()
         return self
 
     def _fit_one(self, ds):
@@ -661,6 +682,9 @@ class ComputationGraph:
             self._train_step_cache[sig] = self._make_train_step(sig)
         step = self._train_step_cache[sig]
         dummy = [jnp.zeros((1,))] * len(labels)
+        res = getattr(self, "_resilience", None)
+        if res is not None:
+            res.before_step()
         for lst in self._listeners:
             if hasattr(lst, "onIterationStart"):
                 # 1-based, matching iterationDone: hook pair refers to the
@@ -688,6 +712,8 @@ class ComputationGraph:
         for lst in self._listeners:
             if hasattr(lst, "iterationDone"):
                 lst.iterationDone(self, self._iteration, self._epoch)
+        if res is not None:
+            res.after_step()
 
     def _fit_mega(self, mb):
         """One multi-step dispatch over K stacked batches — the graph
@@ -715,6 +741,9 @@ class ComputationGraph:
         if (sig, k) not in self._megastep_cache:
             self._megastep_cache[(sig, k)] = self._make_train_step(sig, steps=k)
         step = self._megastep_cache[(sig, k)]
+        res = getattr(self, "_resilience", None)
+        if res is not None:
+            res.before_dispatch()
         dummy = [jnp.zeros((k, 1))] * len(labels)
         if _prof.instrumentation_active():
             _stepping.STEPS_PER_DISPATCH.set(k)
@@ -791,9 +820,10 @@ class ComputationGraph:
 
     # ------------------------------------------------------------ save / load
     def save(self, path: str, save_updater: bool = True):
-        import io
-        import json
-        import zipfile
+        """Atomic (temp + os.replace) model archive — a crash mid-write
+        never leaves a truncated zip under ``path`` (serializer parity
+        with ModelSerializer.writeModel)."""
+        from deeplearning4j_tpu.train.serializer import write_model_zip
         meta = {"type": "ComputationGraph", "iteration": self._iteration,
                 "epoch": self._epoch,
                 "save_updater": bool(save_updater and self._opt_state is not None)}
@@ -808,22 +838,21 @@ class ComputationGraph:
             leaves, _ = jax.tree_util.tree_flatten(self._opt_state)
             for j, leaf in enumerate(leaves):
                 arrays[f"u::{j}"] = np.asarray(leaf)
-        with zipfile.ZipFile(path, "w") as z:
-            z.writestr("conf.json", self.conf.to_json())
-            z.writestr("meta.json", json.dumps(meta))
-            buf = io.BytesIO()
-            np.savez(buf, **arrays) if arrays else np.savez(buf, __empty__=np.zeros(1))
-            z.writestr("arrays.npz", buf.getvalue())
+        write_model_zip(path, self.conf.to_json(), meta, arrays)
 
     @staticmethod
     def load(path: str, load_updater: bool = True) -> "ComputationGraph":
-        import io
-        import json
-        import zipfile
-        with zipfile.ZipFile(path) as z:
-            conf = ComputationGraphConfiguration.from_json(z.read("conf.json").decode())
-            meta = json.loads(z.read("meta.json"))
-            arrays = np.load(io.BytesIO(z.read("arrays.npz")))
+        """Raises ``serializer.CorruptModelError`` naming the bad entry on
+        a truncated/damaged archive instead of a raw KeyError."""
+        from deeplearning4j_tpu.train.serializer import (CorruptModelError,
+                                                         read_model_zip,
+                                                         require_array)
+        conf_json, meta, arrays = read_model_zip(path)
+        try:
+            conf = ComputationGraphConfiguration.from_json(conf_json)
+        except Exception as e:
+            raise CorruptModelError(path, "conf.json",
+                                    f"unparseable configuration ({e})") from e
         net = ComputationGraph(conf)
         net.init()
         for k in arrays.files:
@@ -837,6 +866,7 @@ class ComputationGraph:
         if load_updater and meta.get("save_updater"):
             net._ensure_opt_state()
             leaves, treedef = jax.tree_util.tree_flatten(net._opt_state)
-            new_leaves = [jnp.asarray(arrays[f"u::{j}"]) for j in range(len(leaves))]
+            new_leaves = [jnp.asarray(require_array(arrays, f"u::{j}", path))
+                          for j in range(len(leaves))]
             net._opt_state = jax.tree_util.tree_unflatten(treedef, new_leaves)
         return net

@@ -74,9 +74,20 @@ def _predict_batches(output_fn, iterator, chunk: int = _EVAL_PULL_CHUNK,
                 yield from drain()
         if pending:
             yield from drain()
-    finally:
+    except BaseException:
+        # already unwinding (forward error, consumer abandoning the
+        # generator): close defensively without letting a buffered
+        # worker error mask the primary exception
         if owns:
-            it.close()
+            try:
+                it.close()
+            except BaseException:
+                pass
+        raise
+    else:
+        if owns:
+            it.close()      # clean exit: an undelivered worker error
+                            # (close() re-raises it) must surface here
 
 
 def _ensure_eval_iterator(iterator, prefetch: bool = True):
@@ -329,7 +340,8 @@ class MultiLayerNetwork:
         return self._t_dev
 
     def fit(self, data, labels=None, epochs: int = 1,
-            steps_per_dispatch: int = 1, prefetch: int = 2):
+            steps_per_dispatch: int = 1, prefetch: int = 2,
+            checkpoint=None, nan_policy=None, faults=None):
         """ref: MultiLayerNetwork.fit(DataSetIterator) — accepts an
         iterator, a DataSet, or (features, labels) arrays.
 
@@ -350,16 +362,39 @@ class MultiLayerNetwork:
         compiled TBPTT step, identical to calling ``fitTBPTT(ds, L)``
         per batch (pinned by an equivalence test). The TBPTT path keeps
         its segment-level dispatch — ``steps_per_dispatch`` does not
-        apply to it (megastep x TBPTT composition is a ROADMAP item)."""
+        apply to it (megastep x TBPTT composition is a ROADMAP item).
+
+        Fault tolerance (``train.resilience``): ``checkpoint=
+        CheckpointConfig(dir, every_steps=..., resume=True)`` gives the
+        fit periodic atomic checkpoints and auto-resume from the newest
+        validated one; ``nan_policy=NanPolicy.{RAISE, SKIP_STEP,
+        BACKOFF_LR, ROLLBACK}`` (or a ``NanRecovery``) turns a
+        non-finite loss into recovery instead of a dead job; ``faults=
+        FaultPlan(...)`` injects deterministic failures for testing.
+        SIGTERM/SIGINT during a checkpointed fit finishes the in-flight
+        (mega)step, writes a checkpoint marked ``"preempted"``, and
+        returns cleanly."""
         if not self._initialized:
             self.init()
         self._ensure_opt_state()
         _maybe_attach_env_profiler(self)
         tbptt_len = self._tbptt_length()
+        session = None
+        if checkpoint is not None or nan_policy is not None \
+                or faults is not None:
+            from deeplearning4j_tpu.train import resilience as _resilience
+            if tbptt_len is not None:
+                raise NotImplementedError(
+                    "checkpoint/nan_policy/faults are not supported with a "
+                    "TBPTT-configured fit yet (segment-level accounting is a "
+                    "ROADMAP follow-up)")
+            session, data = _resilience.begin_session(
+                self, data, checkpoint, nan_policy, faults)
 
         def batches():
             if isinstance(data, DataSetIterator):
-                data.reset()
+                if session is None or not session.consume_skip_reset():
+                    data.reset()
                 while data.hasNext():
                     yield data.next()
             elif isinstance(data, DataSet):
@@ -369,27 +404,36 @@ class MultiLayerNetwork:
             else:
                 yield DataSet(np.asarray(data), np.asarray(labels))
 
-        for _ in range(epochs):
-            with _prof.trace_span("train:epoch", epoch=self._epoch):
-                # data-wait vs compute split: time spent pulling the next
-                # batch from the (possibly async) iterator is the input
-                # pipeline's bill, not the device's
-                if tbptt_len is not None:
-                    for ds in _prof.iter_with_data_wait(batches()):
-                        if ds.features.ndim == 3:
-                            self.fitTBPTT(ds, tbptt_len)
-                        else:        # non-sequence batch: nothing to
-                            self._fit_one(ds)     # segment (W002 case)
-                elif steps_per_dispatch > 1:
-                    _stepping.fit_epoch_multistep(self, batches(),
-                                                  steps_per_dispatch, prefetch)
-                else:
-                    for ds in _prof.iter_with_data_wait(batches()):
-                        self._fit_one(ds)
-            self._epoch += 1
-            for lst in self._listeners:
-                if hasattr(lst, "onEpochEnd"):
-                    lst.onEpochEnd(self)
+        def epoch_stream():
+            return session.wrap_batches(batches()) if session is not None \
+                else batches()
+
+        from deeplearning4j_tpu.train.resilience import fit_scope
+        with fit_scope(session, self, epochs) as n_epochs:
+            for _ in range(n_epochs):
+                with _prof.trace_span("train:epoch", epoch=self._epoch):
+                    # data-wait vs compute split: time spent pulling the next
+                    # batch from the (possibly async) iterator is the input
+                    # pipeline's bill, not the device's
+                    if tbptt_len is not None:
+                        for ds in _prof.iter_with_data_wait(batches()):
+                            if ds.features.ndim == 3:
+                                self.fitTBPTT(ds, tbptt_len)
+                            else:        # non-sequence batch: nothing to
+                                self._fit_one(ds)     # segment (W002 case)
+                    elif steps_per_dispatch > 1:
+                        _stepping.fit_epoch_multistep(self, epoch_stream(),
+                                                      steps_per_dispatch,
+                                                      prefetch)
+                    else:
+                        for ds in _prof.iter_with_data_wait(epoch_stream()):
+                            self._fit_one(ds)
+                self._epoch += 1
+                for lst in self._listeners:
+                    if hasattr(lst, "onEpochEnd"):
+                        lst.onEpochEnd(self)
+                if session is not None:
+                    session.on_epoch_end()
         return self
 
     def _fit_one(self, ds: DataSet):
@@ -410,6 +454,9 @@ class MultiLayerNetwork:
             self._train_step_cache[sig] = self._make_train_step(*sig)
         step = self._train_step_cache[sig]
         dummy = jnp.zeros((1,))
+        res = getattr(self, "_resilience", None)
+        if res is not None:
+            res.before_step()
         for lst in self._listeners:
             if hasattr(lst, "onIterationStart"):
                 # 1-based, matching iterationDone: hook pair refers to the
@@ -444,6 +491,8 @@ class MultiLayerNetwork:
         for lst in self._listeners:
             if hasattr(lst, "iterationDone"):
                 lst.iterationDone(self, self._iteration, self._epoch)
+        if res is not None:
+            res.after_step()
 
     def _fit_mega(self, mb):
         """One multi-step dispatch (ISSUE 2 tentpole): K stacked batches
@@ -466,6 +515,9 @@ class MultiLayerNetwork:
         if (sig, k) not in self._megastep_cache:
             self._megastep_cache[(sig, k)] = self._make_train_step(*sig, steps=k)
         step = self._megastep_cache[(sig, k)]
+        res = getattr(self, "_resilience", None)
+        if res is not None:
+            res.before_dispatch()
         dummy = jnp.zeros((k, 1))
         if _prof.instrumentation_active():
             _stepping.STEPS_PER_DISPATCH.set(k)

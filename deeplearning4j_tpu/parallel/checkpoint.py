@@ -19,13 +19,17 @@ Layout on disk::
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 from typing import Any, Dict, List, Optional, Tuple
+from zipfile import BadZipFile as zipfile_BadZipFile
 
 import jax
 import numpy as np
 from jax.sharding import NamedSharding
+
+from deeplearning4j_tpu.train.resilience import CorruptCheckpointError
 
 # One deadline governs BOTH rank 0's sub-manifest merge and every reader's
 # wait for the merged manifest — a shorter reader wait can race a
@@ -75,8 +79,14 @@ def save_sharded(directory: str, tree, step: int = 0):
             key = _index_key(sh.index, arr.shape)
             if f"{name}::{key}" in local:
                 continue
-            local[f"{name}::{key}"] = np.asarray(sh.data)
-            entry["shards"][key] = f"shards_p{pidx}.npz"
+            data = np.asarray(sh.data)
+            local[f"{name}::{key}"] = data
+            # per-shard SHA-256 in the manifest: a truncated/bit-flipped
+            # .npz otherwise loads garbage (or throws an opaque numpy
+            # error) — load_sharded verifies before assembling
+            entry["shards"][key] = {
+                "file": f"shards_p{pidx}.npz",
+                "sha256": _shard_digest(data)}
         manifest["leaves"][name] = entry
     np.savez(os.path.join(directory, f"shards_p{pidx}.npz"), **local)
 
@@ -88,7 +98,29 @@ def save_sharded(directory: str, tree, step: int = 0):
                      manifest)
         _merge_manifests(directory, step)
     else:
+        # single-writer save into a possibly-reused directory: stale rank
+        # sub-manifests from an earlier multi-process save would trip the
+        # load-time step-agreement check — they describe nothing current
+        import glob as _glob
+        for stale in _glob.glob(os.path.join(directory, "manifest_p*.json")):
+            try:
+                os.remove(stale)
+            except OSError:
+                pass
         _atomic_json(os.path.join(directory, "manifest.json"), manifest)
+
+
+def _shard_digest(data: np.ndarray) -> str:
+    return hashlib.sha256(np.ascontiguousarray(data).tobytes()).hexdigest()
+
+
+def _shard_entry(entry_shards: Dict[str, Any], key: str):
+    """(file, sha256-or-None) for a manifest shard entry — tolerates the
+    pre-checksum manifest format where the value was a bare filename."""
+    v = entry_shards[key]
+    if isinstance(v, str):
+        return v, None
+    return v["file"], v.get("sha256")
 
 
 def _atomic_json(path: str, payload):
@@ -156,6 +188,29 @@ def load_sharded(directory: str, target_tree, mesh=None, specs=None):
     with open(man_path) as f:
         manifest = json.load(f)
 
+    # a sub-manifest for a NEWER step than the merged manifest means a
+    # later save started (and overwrote shard files) but never finished
+    # merging — the merged manifest's checksums no longer describe what is
+    # on disk, so refuse up front with a structured error. OLDER stale
+    # sub-manifests (e.g. a directory reused by a save with a smaller
+    # process count) are harmless leftovers and are ignored — the
+    # per-shard checksums still guard the data actually referenced.
+    import glob as _glob
+    for sub_path in sorted(_glob.glob(os.path.join(directory,
+                                                   "manifest_p*.json"))):
+        try:
+            with open(sub_path) as f:
+                sub = json.load(f)
+        except (json.JSONDecodeError, OSError):
+            continue
+        if (isinstance(sub.get("step"), int)
+                and sub["step"] > manifest.get("step", 0)):
+            raise CorruptCheckpointError(
+                f"{directory}: rank sub-manifest {os.path.basename(sub_path)} "
+                f"is for step {sub.get('step')} but the merged manifest is "
+                f"for step {manifest.get('step')} — a newer partial "
+                "overlapping save corrupted this checkpoint")
+
     names, leaves, treedef = _flatten(target_tree)
     if specs is not None:
         spec_leaves = treedef.flatten_up_to(specs)
@@ -163,10 +218,25 @@ def load_sharded(directory: str, target_tree, mesh=None, specs=None):
     files: Dict[str, Any] = {}
 
     def shard_data(name: str, key: str) -> np.ndarray:
-        fname = manifest["leaves"][name]["shards"][key]
+        fname, digest = _shard_entry(manifest["leaves"][name]["shards"], key)
         if fname not in files:
-            files[fname] = np.load(os.path.join(directory, fname))
-        return files[fname][f"{name}::{key}"]
+            try:
+                files[fname] = np.load(os.path.join(directory, fname))
+            except (ValueError, OSError, EOFError) as e:
+                raise CorruptCheckpointError(
+                    f"{directory}/{fname}: unloadable shard archive "
+                    f"({e})") from e
+        try:
+            data = files[fname][f"{name}::{key}"]
+        except (KeyError, ValueError, zipfile_BadZipFile) as e:
+            raise CorruptCheckpointError(
+                f"{directory}/{fname}: missing/unreadable shard "
+                f"{name}::{key} ({e})") from e
+        if digest is not None and _shard_digest(data) != digest:
+            raise CorruptCheckpointError(
+                f"{directory}/{fname}: checksum mismatch for shard "
+                f"{name}::{key} (truncated or bit-flipped write)")
+        return data
 
     out_leaves: List[Any] = []
     for i, (name, leaf) in enumerate(zip(names, leaves)):

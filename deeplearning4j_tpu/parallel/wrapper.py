@@ -53,18 +53,36 @@ class ParallelWrapper:
                                    **kw)
 
     def fit(self, iterator: DataSetIterator, epochs: int = 1,
-            steps_per_dispatch: int = 1):
+            steps_per_dispatch: int = 1, checkpoint=None, nan_policy=None,
+            faults=None):
         """``steps_per_dispatch=K`` composes the data-parallel path with
         the K-step lax.scan megastep: each megabatch is staged as
         ``[K, B, ...]`` arrays batch-sharded over the mesh's ``data`` axis
         (axis 1) by a DevicePrefetcher, so ONE dispatch per K sharded
-        update steps."""
+        update steps.
+
+        ``checkpoint=``/``nan_policy=``/``faults=`` enable the fault-
+        tolerance layer (train.resilience) exactly as on the wrapped
+        model's own ``fit``; resume restores the full training state
+        BEFORE replication so the restored params are distributed over
+        the mesh like freshly initialized ones. With resilience active
+        the K=1 AsyncDataSetIterator auto-wrap is skipped so checkpoint
+        cursors stay exact (the async worker prefetches ahead of the
+        applied step)."""
         model = self.model
         if not model._initialized:
             model.init()
         k = int(steps_per_dispatch)
+        session = None
+        if checkpoint is not None or nan_policy is not None \
+                or faults is not None:
+            from deeplearning4j_tpu.train import resilience as _resilience
+            model._ensure_opt_state()
+            session, iterator = _resilience.begin_session(
+                model, iterator, checkpoint, nan_policy, faults)
         fresh = False
-        if k <= 1 and self.prefetch and not isinstance(iterator, AsyncDataSetIterator):
+        if session is None and k <= 1 and self.prefetch \
+                and not isinstance(iterator, AsyncDataSetIterator):
             # the wrapper's constructor resets the base and starts
             # prefetching (the K-step path prefetches via DevicePrefetcher
             # instead — its worker already pulls the base iterator)
@@ -83,32 +101,43 @@ class ParallelWrapper:
             # see incompatible devices; _ensure_clock rebuilds it (fresh,
             # uncommitted) from _iteration on the first sharded step
             model._t_dev = None
-            for e in range(epochs):
-                if e or not fresh:
-                    iterator.reset()
-                if k > 1:
-                    self._fit_epoch_multistep(model, iterator, k)
-                else:
-                    while iterator.hasNext():
-                        ds = iterator.next()
-                        ds = self._shard(ds)
-                        model._fit_one(ds)
-                model._epoch += 1
+            from deeplearning4j_tpu.train.resilience import fit_scope
+            with fit_scope(session, model, epochs) as n_epochs:
+                for e in range(n_epochs):
+                    if (e or not fresh) and not (
+                            session is not None
+                            and session.consume_skip_reset()):
+                        iterator.reset()
+                    if k > 1:
+                        self._fit_epoch_multistep(model, iterator, k, session)
+                    else:
+                        def pulls():
+                            while iterator.hasNext():
+                                yield iterator.next()
+                        stream = session.wrap_batches(pulls()) \
+                            if session is not None else pulls()
+                        for ds in stream:
+                            model._fit_one(self._shard(ds))
+                    model._epoch += 1
+                    if session is not None:
+                        session.on_epoch_end()
         return model
 
-    def _fit_epoch_multistep(self, model, iterator, k: int):
+    def _fit_epoch_multistep(self, model, iterator, k: int, session=None):
         from deeplearning4j_tpu.train import stepping as _stepping
 
         def padded():
             while iterator.hasNext():
                 yield self._pad(iterator.next())
 
+        stream = session.wrap_batches(padded()) if session is not None \
+            else padded()
         # honor prefetch_buffer exactly: 0 keeps the base iterator on the
         # calling thread (thread-affine data sources) with inline staging,
         # N bounds staged megabatches in device memory to N — each is K
         # minibatches, so the user's bound is a real memory bound
         _stepping.fit_epoch_multistep(
-            model, padded(), k, prefetch=self.prefetch or 0,
+            model, stream, k, prefetch=self.prefetch or 0,
             placement=self._mesh_placement)
 
     def _mesh_placement(self, a, mega: bool):

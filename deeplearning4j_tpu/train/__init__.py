@@ -11,4 +11,17 @@ from deeplearning4j_tpu.train.listeners import (  # noqa: F401
     TimeIterationListener,
     TrainingListener,
 )
-from deeplearning4j_tpu.train.serializer import ModelSerializer  # noqa: F401
+from deeplearning4j_tpu.train.resilience import (  # noqa: F401
+    CheckpointConfig,
+    CheckpointManager,
+    CorruptCheckpointError,
+    NanPolicy,
+    NanRecovery,
+    PreemptionSignal,
+    SignalPreemption,
+    StepPreemption,
+)
+from deeplearning4j_tpu.train.serializer import (  # noqa: F401
+    CorruptModelError,
+    ModelSerializer,
+)

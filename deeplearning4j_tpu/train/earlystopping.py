@@ -94,13 +94,16 @@ class MaxTimeIterationTerminationCondition:
 class InMemoryModelSaver:
     def __init__(self):
         self.best = None
+        self._model_ref = None
 
     def saveBestModel(self, model, score):
         self.best = (copy.deepcopy(model._params), copy.deepcopy(model._states))
         self._model_ref = model
 
     def getBestModel(self):
-        model = self._model_ref
+        if self.best is None:
+            return None      # nothing saved (e.g. a resumed run that never
+        model = self._model_ref  # improved on the restored best score)
         model._params, model._states = self.best
         return model
 
@@ -110,12 +113,18 @@ class LocalFileModelSaver:
         self.dir = directory
         os.makedirs(directory, exist_ok=True)
         self.best_path = os.path.join(directory, "bestModel.zip")
+        self._model_cls = None
 
     def saveBestModel(self, model, score):
         model.save(self.best_path)
         self._model_cls = type(model)
 
     def getBestModel(self):
+        # None when nothing was ever saved (or the zip is gone) — e.g. a
+        # resumed run whose restored best was never beaten; the trainer
+        # falls back to the final model instead of crashing
+        if self._model_cls is None or not os.path.exists(self.best_path):
+            return None
         return self._model_cls.load(self.best_path)
 
 
@@ -198,14 +207,25 @@ class EarlyStoppingTrainer:
     ONE compiled ``lax.scan`` dispatch, with iteration termination
     conditions scored between megabatches (the score checked after a
     K-step dispatch is the dispatch's final per-step loss — conditions
-    fire at dispatch granularity, epoch semantics are unchanged)."""
+    fire at dispatch granularity, epoch semantics are unchanged).
+
+    ``checkpoint=CheckpointConfig(dir, resume=True)`` (train.resilience)
+    checkpoints the model + the trainer's own search state (best score /
+    best epoch / score history) after every scored epoch, and resumes
+    both from the newest validated checkpoint — an early-stopping run
+    killed at epoch 37 restarts with its best-score bookkeeping intact
+    instead of rediscovering (or worse, forgetting) its best model. Use
+    a ``LocalFileModelSaver`` so the best model itself also survives the
+    process."""
 
     def __init__(self, config: EarlyStoppingConfiguration, model,
-                 train_iterator, steps_per_dispatch: int = 1):
+                 train_iterator, steps_per_dispatch: int = 1,
+                 checkpoint=None):
         self.config = config
         self.model = model
         self.iterator = train_iterator
         self.steps_per_dispatch = max(int(steps_per_dispatch), 1)
+        self.checkpoint = checkpoint
 
     def _epoch_batches(self):
         self.iterator.reset()
@@ -222,13 +242,42 @@ class EarlyStoppingTrainer:
         return _stepping.group_into_megabatches(self._epoch_batches(),
                                                 self.steps_per_dispatch)
 
+    def _resume(self, manager):
+        """Restore model + search state from the newest valid checkpoint.
+        Returns (best_score, best_epoch, scores, epoch)."""
+        fresh = (float("inf"), -1, {}, 0)
+        if manager is None or not self.checkpoint.resume:
+            return fresh
+        info = manager.restore(self.model)
+        if info is None:
+            return fresh
+        es = (info.get("extra") or {}).get("earlystopping") or {}
+        if isinstance(self.config.saver, LocalFileModelSaver) \
+                and os.path.exists(self.config.saver.best_path):
+            # re-arm the saver so getBestModel() works without a fresh
+            # saveBestModel() call in the resumed process
+            self.config.saver._model_cls = type(self.model)
+        elif es.get("best_epoch", -1) >= 0:
+            import warnings
+            warnings.warn(
+                "EarlyStoppingTrainer resume: the best-score bookkeeping was "
+                "restored, but this saver cannot reload the best MODEL from a "
+                "previous process — the result falls back to the final model "
+                "unless the resumed run finds a new best. Use "
+                "LocalFileModelSaver for resumable runs.", stacklevel=2)
+        return (es.get("best_score", float("inf")),
+                es.get("best_epoch", -1),
+                {int(k): v for k, v in (es.get("scores") or {}).items()},
+                int(es.get("epoch", 0)))
+
     def fit(self) -> EarlyStoppingResult:
         from deeplearning4j_tpu.train.stepping import MegaBatch
         cfg = self.config
-        best_score = float("inf")
-        best_epoch = -1
-        scores = {}
-        epoch = 0
+        manager = None
+        if self.checkpoint is not None:
+            from deeplearning4j_tpu.train.resilience import CheckpointManager
+            manager = CheckpointManager(self.checkpoint)
+        best_score, best_epoch, scores, epoch = self._resume(manager)
         reason, details = "MaxEpochs", ""
         while True:
             # one epoch, watching iteration conditions between dispatches
@@ -256,6 +305,11 @@ class EarlyStoppingTrainer:
                     best_score = score
                     best_epoch = epoch
                     cfg.saver.saveBestModel(self.model, score)
+            if manager is not None:
+                manager.save(self.model, extra={"earlystopping": {
+                    "best_score": best_score, "best_epoch": best_epoch,
+                    "scores": {str(k): v for k, v in scores.items()},
+                    "epoch": epoch}})
             stop = False
             for ec in cfg.epoch_conditions:
                 if ec.terminate(epoch, scores.get(epoch, best_score), best_epoch):
@@ -265,6 +319,8 @@ class EarlyStoppingTrainer:
                     break
             if stop:
                 break
-        best_model = cfg.saver.getBestModel() if best_epoch >= 0 else self.model
+        best_model = cfg.saver.getBestModel() if best_epoch >= 0 else None
+        if best_model is None:
+            best_model = self.model
         return EarlyStoppingResult(reason, details, scores, best_epoch,
                                    best_score, epoch, best_model)

@@ -1,0 +1,801 @@
+"""Fault-tolerant training: auto-checkpoint/resume, preemption handling,
+NaN/Inf recovery policies.
+
+Periodic checkpointing with automatic recovery is a founding design
+point of production training systems (TensorFlow, Abadi et al., 2016),
+and long data-parallel accelerator jobs make preemption the COMMON
+case, not the exception — yet a training loop without this layer loses
+a multi-hour ``fit()`` to a single SIGTERM, NaN step, or flaky disk.
+This module is the missing layer between the megastep engine and
+anything production-shaped:
+
+- :class:`CheckpointConfig` + :class:`CheckpointManager` — periodic
+  **atomic** checkpoints of the FULL training state: params, updater
+  state, layer states, the per-step RNG counter (the step clock ``t``
+  that ``fold_in(seed, t)`` derives dropout keys from), epoch/step,
+  the iterator's normalizer, and the data-iterator cursor. Writes go
+  to a temp dir finalized by ONE ``os.replace`` (a crash mid-write can
+  never leave a half-checkpoint under the real name); every file is
+  SHA-256'd into the manifest; ``keep_last=N`` rotation; resume picks
+  the newest checkpoint that passes checksum validation and
+  QUARANTINES corrupt ones instead of trusting them.
+- Preemption handling — SIGTERM/SIGINT (plus pluggable
+  :class:`PreemptionSignal` implementations for tests and cluster
+  schedulers) finish the in-flight (mega)step, write a checkpoint whose
+  manifest is marked ``"preempted"``, and return cleanly from ``fit``.
+- :class:`NanPolicy` — upgrades the NAN_PANIC raise-only debug knob to
+  actual recovery: ``RAISE``, ``SKIP_STEP`` (drop the poisoned update,
+  keep going), ``BACKOFF_LR`` (drop the update AND halve the learning
+  rate, recovering it after a cooldown of clean steps), ``ROLLBACK``
+  (restore the last good checkpoint). Tune via :class:`NanRecovery`.
+- Transient-I/O retry with exponential backoff around checkpoint
+  writes/reads (and, via ``data.dataset.RetryingDataSetIterator``,
+  around data pulls).
+
+Everything is observable in the profiler registry:
+``dl4j_nonfinite_steps_total``, ``dl4j_rollbacks_total``,
+``dl4j_checkpoint_seconds``, ``dl4j_resume_total``,
+``dl4j_preemptions_total``, ``dl4j_checkpoint_quarantined_total``,
+``dl4j_lr_backoffs_total`` (plus ``dl4j_data_retries_total`` from the
+data layer). Every recovery path is pinned by a deterministic injected
+fault (``deeplearning4j_tpu.faults``) in ``tests/test_resilience.py``.
+
+Usage::
+
+    net.fit(iterator, epochs=3,
+            checkpoint=CheckpointConfig("/ckpts", every_steps=200,
+                                        resume=True),
+            nan_policy=NanPolicy.SKIP_STEP)
+
+Resume is bit-exact: ``fit(N)`` == ``fit(k)`` + preemption + resume for
+params, updater state, and the step RNG (pinned for MultiLayerNetwork,
+ComputationGraph, and ``steps_per_dispatch>1`` megastep runs).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import shutil
+import signal as _signal
+import threading
+import time
+import warnings
+from collections import deque
+from contextlib import contextmanager
+from dataclasses import dataclass
+from enum import Enum
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import numpy as np
+
+from deeplearning4j_tpu import profiler as _prof
+from deeplearning4j_tpu.data.dataset import (DataSetIterator,
+                                             RetryingDataSetIterator)
+from deeplearning4j_tpu.utils.environment import NumericsPanicError
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+_REG = _prof.get_registry()
+NONFINITE_STEPS = _REG.counter(
+    "dl4j_nonfinite_steps_total",
+    "Update steps whose loss came back NaN/Inf (one per poisoned step, "
+    "whatever the recovery policy did about it)")
+ROLLBACKS = _REG.counter(
+    "dl4j_rollbacks_total",
+    "Checkpoint rollbacks performed by NanPolicy.ROLLBACK")
+CKPT_SECONDS = _REG.histogram(
+    "dl4j_checkpoint_seconds",
+    "Wall time to write one atomic training checkpoint")
+RESUMES = _REG.counter(
+    "dl4j_resume_total",
+    "Successful auto-resumes from a validated checkpoint")
+PREEMPTIONS = _REG.counter(
+    "dl4j_preemptions_total",
+    "Preemption requests honored (signal or synthetic) — each wrote a "
+    "'preempted' checkpoint when a CheckpointConfig was active")
+QUARANTINED = _REG.counter(
+    "dl4j_checkpoint_quarantined_total",
+    "Checkpoints failing checksum/manifest validation at resume, moved "
+    "aside instead of loaded")
+LR_BACKOFFS = _REG.counter(
+    "dl4j_lr_backoffs_total",
+    "Learning-rate halvings performed by NanPolicy.BACKOFF_LR")
+
+
+class CorruptCheckpointError(RuntimeError):
+    """A checkpoint failed validation: unreadable/missing manifest, a
+    file named by the manifest absent, or a SHA-256 mismatch. Resume
+    quarantines the checkpoint and falls back to the previous one."""
+
+
+class PreemptionRequested(Exception):
+    """Internal control flow: a PreemptionSignal fired; the fit loop
+    unwinds to its boundary, writes the 'preempted' checkpoint, and
+    returns cleanly."""
+
+
+# --------------------------------------------------------------- I/O retry
+def retry_io(fn: Callable, retries: int = 3, backoff: float = 0.05,
+             exc=(OSError,)):
+    """Run ``fn`` retrying transient I/O failures with exponential
+    backoff — the storage layer under a checkpoint (NFS, object-store
+    FUSE mounts) fails transiently as a matter of course on large
+    clusters."""
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except exc:
+            if attempt >= retries:
+                raise
+            time.sleep(backoff * (2 ** attempt))
+            attempt += 1
+
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+# ------------------------------------------------------------ NaN policies
+class NanPolicy(Enum):
+    """What to do when a step's loss comes back non-finite (upgrades the
+    raise-only NAN_PANIC debug mode to recovery)."""
+
+    RAISE = "raise"            # fail fast (NumericsPanicError)
+    SKIP_STEP = "skip_step"    # drop the poisoned update, keep training
+    BACKOFF_LR = "backoff_lr"  # drop the update + halve LR (cooldown recovery)
+    ROLLBACK = "rollback"      # restore the last good checkpoint
+
+
+@dataclass
+class NanRecovery:
+    """A NanPolicy plus its tuning. ``fit(nan_policy=...)`` accepts
+    either a bare :class:`NanPolicy` (defaults below) or this."""
+
+    policy: NanPolicy
+    backoff_factor: float = 0.5   # LR multiplier per BACKOFF_LR event
+    cooldown_steps: int = 50      # clean steps before LR recovers one notch
+    min_scale: float = 2.0 ** -16  # LR-scale floor: below this, raise
+    max_rollbacks: int = 3        # consecutive ROLLBACKs before raising
+
+
+# --------------------------------------------------------------- config
+@dataclass
+class CheckpointConfig:
+    """Where/when/how to checkpoint. ``every_steps=0`` disables periodic
+    saves (preemption and ``every_epochs`` still checkpoint)."""
+
+    dir: str
+    every_steps: int = 0
+    every_epochs: int = 0
+    resume: bool = False
+    keep_last: int = 3
+    io_retries: int = 3
+    io_backoff: float = 0.05
+
+
+# ---------------------------------------------------------- preemption
+class PreemptionSignal:
+    """Pluggable preemption source: ``requested(step)`` is polled after
+    every completed (mega)step. Subclass for cluster schedulers that
+    announce preemption out-of-band (metadata server, borglet file)."""
+
+    def requested(self, step: int) -> bool:
+        return False
+
+
+class StepPreemption(PreemptionSignal):
+    """Synthetic preemption once ``step`` update steps have completed —
+    the deterministic stand-in for SIGTERM that the fault harness and
+    the resume-equivalence tests use."""
+
+    def __init__(self, step: int):
+        self.step = int(step)
+
+    def requested(self, step: int) -> bool:
+        return step >= self.step
+
+
+class SignalPreemption(PreemptionSignal):
+    """SIGTERM/SIGINT -> preemption flag. Installed for the duration of
+    a resilient ``fit()`` (main thread only — signal handlers cannot be
+    installed elsewhere); previous handlers are restored on close."""
+
+    def __init__(self, signals=(_signal.SIGTERM, _signal.SIGINT)):
+        self.signals = signals
+        self._event = threading.Event()
+        self._prev: Dict[int, Any] = {}
+
+    def install(self) -> bool:
+        if threading.current_thread() is not threading.main_thread():
+            return False
+        for s in self.signals:
+            self._prev[s] = _signal.signal(s, self._handler)
+        return True
+
+    def uninstall(self):
+        for s, prev in self._prev.items():
+            try:
+                _signal.signal(s, prev)
+            except (ValueError, OSError):
+                pass
+        self._prev = {}
+
+    def _handler(self, signum, frame):
+        self._event.set()
+
+    def requested(self, step: int) -> bool:
+        return self._event.is_set()
+
+
+# ------------------------------------------------------------- manager
+class CheckpointManager:
+    """Atomic, checksummed, rotated training checkpoints.
+
+    On-disk layout (one directory per checkpoint, finalized by a single
+    ``os.replace`` so readers never observe a partial write)::
+
+        <dir>/ckpt_0000000042/model.zip        full model (params, layer
+                                               states, updater state,
+                                               step/epoch counters)
+        <dir>/ckpt_0000000042/extra.json       iterator cursor + caller
+                                               extra state (early stopping)
+        <dir>/ckpt_0000000042/normalizer.npz   iterator preprocessor (opt.)
+        <dir>/ckpt_0000000042/manifest.json    step/epoch/status + per-file
+                                               SHA-256
+        <dir>/quarantine_ckpt_.../             failed validation at resume
+
+    ``status`` in the manifest is ``"complete"`` or ``"preempted"``.
+    """
+
+    PREFIX = "ckpt_"
+
+    def __init__(self, config: CheckpointConfig, fault_plan=None):
+        self.config = config
+        self.faults = fault_plan
+        os.makedirs(config.dir, exist_ok=True)
+
+    # ------------------------------------------------------------- naming
+    def _name(self, step: int) -> str:
+        return f"{self.PREFIX}{step:010d}"
+
+    def checkpoints(self):
+        """[(step, path)] ascending by step; quarantined/temp dirs are
+        excluded."""
+        out = []
+        for entry in os.listdir(self.config.dir):
+            if not entry.startswith(self.PREFIX):
+                continue
+            suffix = entry[len(self.PREFIX):]
+            if not suffix.isdigit():
+                continue
+            out.append((int(suffix), os.path.join(self.config.dir, entry)))
+        return sorted(out)
+
+    # --------------------------------------------------------------- save
+    def save(self, model, status: str = "complete", cursor=None,
+             normalizer=None, extra: Optional[dict] = None) -> str:
+        cfg = self.config
+        step, epoch = int(model._iteration), int(model._epoch)
+        t0 = time.perf_counter()
+        name = self._name(step)
+        final = os.path.join(cfg.dir, name)
+        tmp = os.path.join(cfg.dir, f".tmp_{name}_{os.getpid()}")
+        if os.path.isdir(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+
+        def write_model():
+            if self.faults is not None \
+                    and self.faults.checkpoint_write_error(step):
+                raise OSError(
+                    f"injected checkpoint write failure at step {step}")
+            model.save(os.path.join(tmp, "model.zip"), save_updater=True)
+        retry_io(write_model, cfg.io_retries, cfg.io_backoff)
+        if normalizer is not None:
+            try:
+                from deeplearning4j_tpu.train.serializer import ModelSerializer
+                ModelSerializer.writeNormalizer(
+                    normalizer, os.path.join(tmp, "normalizer.npz"))
+            except Exception as e:   # best effort: a normalizer that can't
+                warnings.warn(       # serialize must not kill the checkpoint
+                    f"checkpoint: could not serialize normalizer: {e}",
+                    stacklevel=2)
+        with open(os.path.join(tmp, "extra.json"), "w") as f:
+            json.dump({"cursor": cursor, "extra": extra or {}}, f)
+        files = {fn: _sha256_file(os.path.join(tmp, fn))
+                 for fn in sorted(os.listdir(tmp))}
+        manifest = {"format": 1, "step": step, "epoch": epoch,
+                    "status": status, "files": files,
+                    "unix_time": time.time()}
+        with open(os.path.join(tmp, "manifest.json"), "w") as f:
+            json.dump(manifest, f)
+        if os.path.isdir(final):     # re-save of the same step (preemption
+            shutil.rmtree(final)     # right after a periodic save)
+        retry_io(lambda: os.replace(tmp, final), cfg.io_retries,
+                 cfg.io_backoff)
+        if self.faults is not None:
+            self.faults.corrupt_checkpoint(step, final)
+        CKPT_SECONDS.observe(time.perf_counter() - t0)
+        self._rotate()
+        return final
+
+    def _rotate(self):
+        cps = self.checkpoints()
+        while len(cps) > max(1, self.config.keep_last):
+            _, path = cps.pop(0)
+            retry_io(lambda p=path: shutil.rmtree(p, ignore_errors=False),
+                     self.config.io_retries, self.config.io_backoff)
+
+    # ----------------------------------------------------------- validate
+    def validate(self, path: str) -> dict:
+        """Manifest + per-file SHA-256 validation. Returns the manifest;
+        raises CorruptCheckpointError naming the failing entry."""
+        man_path = os.path.join(path, "manifest.json")
+        try:
+            with open(man_path) as f:
+                manifest = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            raise CorruptCheckpointError(
+                f"{path}: unreadable manifest ({e})") from e
+        files = manifest.get("files") or {}
+        if "model.zip" not in files:
+            raise CorruptCheckpointError(f"{path}: manifest lists no model.zip")
+        for fn, digest in files.items():
+            fp = os.path.join(path, fn)
+            if not os.path.exists(fp):
+                raise CorruptCheckpointError(f"{path}: missing file {fn}")
+            actual = _sha256_file(fp)
+            if actual != digest:
+                raise CorruptCheckpointError(
+                    f"{path}: checksum mismatch for {fn} (manifest "
+                    f"{digest[:12]}..., actual {actual[:12]}...)")
+        return manifest
+
+    def latest_valid(self):
+        """Newest checkpoint passing validation as (path, manifest), or
+        None. Corrupt checkpoints are QUARANTINED (renamed aside) so a
+        bad newest write can never shadow a good older one forever."""
+        for step, path in reversed(self.checkpoints()):
+            try:
+                return path, self.validate(path)
+            except CorruptCheckpointError as e:
+                self._quarantine(path, str(e))
+        return None
+
+    def _quarantine(self, path: str, reason: str):
+        dst = os.path.join(os.path.dirname(path),
+                           "quarantine_" + os.path.basename(path))
+        if os.path.isdir(dst):
+            shutil.rmtree(dst)
+        os.replace(path, dst)
+        QUARANTINED.inc()
+        warnings.warn(f"quarantined corrupt checkpoint {path}: {reason}",
+                      stacklevel=3)
+
+    # ------------------------------------------------------------ restore
+    def restore(self, model, normalizer=None, count_resume: bool = True):
+        """Load the newest valid checkpoint INTO ``model`` (in place:
+        params, layer states, updater state, step/epoch, device clock)
+        and return ``{"path", "manifest", "cursor", "extra"}`` — or None
+        when no valid checkpoint exists."""
+        found = self.latest_valid()
+        if found is None:
+            return None
+        path, manifest = found
+        cfg = self.config
+        loaded = retry_io(
+            lambda: type(model).load(os.path.join(path, "model.zip"),
+                                     load_updater=True),
+            cfg.io_retries, cfg.io_backoff)
+        model._params = loaded._params
+        model._states = loaded._states
+        model._opt_state = loaded._opt_state
+        model._iteration = loaded._iteration
+        model._epoch = loaded._epoch
+        model._t_dev = None          # clock rebuilds from _iteration
+        extra_payload: dict = {}
+        extra_path = os.path.join(path, "extra.json")
+        if os.path.exists(extra_path):
+            with open(extra_path) as f:
+                extra_payload = json.load(f)
+        norm_path = os.path.join(path, "normalizer.npz")
+        if normalizer is not None and os.path.exists(norm_path):
+            try:
+                from deeplearning4j_tpu.train.serializer import ModelSerializer
+                restored = retry_io(
+                    lambda: ModelSerializer.restoreNormalizer(norm_path),
+                    cfg.io_retries, cfg.io_backoff)
+                for k, v in restored.__dict__.items():
+                    setattr(normalizer, k, v)
+            except Exception as e:
+                warnings.warn(f"resume: could not restore normalizer: {e}",
+                              stacklevel=2)
+        if count_resume:
+            RESUMES.inc()
+        return {"path": path, "manifest": manifest,
+                "cursor": extra_payload.get("cursor"),
+                "extra": extra_payload.get("extra") or {}}
+
+
+# ------------------------------------------------------------- session
+def _device_copy(tree):
+    return jax.tree_util.tree_map(
+        lambda a: a + 0 if isinstance(a, jax.Array) else a, tree)
+
+
+def _find_preprocessor(it):
+    """Walk a wrapper chain (retry/fault/async wrappers all expose
+    ``.base``) for the innermost iterator's preprocessor."""
+    seen = set()
+    while it is not None and id(it) not in seen:
+        seen.add(id(it))
+        pre = getattr(it, "_pre", None)
+        if pre is not None:
+            return pre
+        it = getattr(it, "base", None)
+    return None
+
+
+class TrainingSession:
+    """Per-``fit()`` resilience driver, attached as ``model._resilience``
+    for the duration of the fit. The fit loops call four hooks:
+
+    - ``before_step()`` / ``before_dispatch()`` — device-copy snapshot
+      of (params, states, opt state) when the NaN policy needs one.
+    - ``after_step()`` / ``after_dispatch(losses, k)`` — non-finite
+      detection + recovery, periodic checkpoint, preemption poll.
+    - ``on_epoch_end()`` — epoch-granularity checkpoints.
+    - ``on_preempt()`` — the 'preempted' checkpoint.
+
+    Megastep granularity: with ``steps_per_dispatch=K`` recovery acts on
+    the whole K-step dispatch (a poisoned sub-step skips/rolls back all
+    K — the dispatch is one atomic compiled program).
+    """
+
+    def __init__(self, model, checkpoint: Optional[CheckpointConfig] = None,
+                 nan_policy=None, faults=None, iterator=None):
+        self.model = model
+        self.config = checkpoint
+        self.manager = (CheckpointManager(checkpoint, fault_plan=faults)
+                        if checkpoint is not None else None)
+        if isinstance(nan_policy, NanPolicy):
+            nan_policy = NanRecovery(nan_policy)
+        self.recovery: Optional[NanRecovery] = nan_policy
+        self.faults = faults
+        self.iterator = iterator
+        self.normalizer = _find_preprocessor(iterator)
+        self._signals = []
+        self._sig_handler: Optional[SignalPreemption] = None
+        if faults is not None:
+            sig = faults.preemption_signal()
+            if sig is not None:
+                self._signals.append(sig)
+        self._cursors = deque()
+        self._cursor_at_step = None
+        self._snapshot = None
+        self._skip_reset = False
+        self._next_save = None
+        self._good_steps = 0
+        self._rollbacks_in_row = 0
+        self.resumed = False
+        self.preempted = False
+
+    # ----------------------------------------------------------- lifecycle
+    def start(self):
+        if self.manager is not None:
+            self._sig_handler = SignalPreemption()
+            if self._sig_handler.install():
+                self._signals.append(self._sig_handler)
+            else:
+                self._sig_handler = None
+
+    def close(self):
+        if self._sig_handler is not None:
+            self._sig_handler.uninstall()
+            self._sig_handler = None
+        if getattr(self.model, "_resilience", None) is self:
+            self.model._resilience = None
+
+    def resume(self) -> bool:
+        """Restore the newest valid checkpoint (when ``resume=True``)
+        and seek the data iterator to its saved cursor. Returns True
+        when a checkpoint was restored."""
+        if self.manager is None or not self.config.resume:
+            self._arm_next_save()
+            return False
+        info = self.manager.restore(self.model, normalizer=self.normalizer)
+        if info is None:
+            self._arm_next_save()
+            return False
+        cursor = info.get("cursor")
+        if cursor is not None and self.iterator is not None:
+            try:
+                self.iterator.seek(cursor)
+                self._skip_reset = True
+            except NotImplementedError:
+                warnings.warn(
+                    "resume: iterator does not support seek(); replaying "
+                    "the interrupted epoch from its start", stacklevel=2)
+        res_state = (info.get("extra") or {}).get("resilience") or {}
+        lr_scale = res_state.get("lr_scale", 1.0)
+        upd = self.model.conf.base.updater
+        if lr_scale != getattr(upd, "_lr_scale", 1.0):
+            upd._lr_scale = lr_scale
+            self._bust_step_caches()
+        self._good_steps = int(res_state.get("good_steps", 0))
+        self.resumed = True
+        self.restored = info
+        logger.info("resumed from %s (step %d, status=%s)", info["path"],
+                    self.model._iteration, info["manifest"].get("status"))
+        self._arm_next_save()
+        return True
+
+    def _arm_next_save(self):
+        if self.manager is not None and self.config.every_steps:
+            self._next_save = self.model._iteration + self.config.every_steps
+
+    def consume_skip_reset(self) -> bool:
+        """True exactly once after a cursor seek: the first epoch's
+        ``reset()`` must not wipe the restored position."""
+        if self._skip_reset:
+            self._skip_reset = False
+            return True
+        return False
+
+    # ------------------------------------------------------------- batches
+    def wrap_batches(self, stream):
+        """Record the iterator cursor as each batch is pulled (pull
+        order == apply order, so cursor j is the exact resume point
+        after update step j lands), and run non-iterator fault
+        injection for array/DataSet-fed fits."""
+        it = self.iterator
+        plan = self.faults if it is None else None  # iterator path injects
+        for ds in stream:                           # inside the wrapper
+            if plan is not None and plan._on_pull():
+                from deeplearning4j_tpu.faults import _poison
+                ds = _poison(ds)
+            self._cursors.append(None if it is None else it.cursor())
+            yield ds
+
+    # --------------------------------------------------------------- hooks
+    def before_step(self):
+        rec = self.recovery
+        if rec is not None and rec.policy in (NanPolicy.SKIP_STEP,
+                                              NanPolicy.BACKOFF_LR):
+            m = self.model
+            self._snapshot = (_device_copy(m._params),
+                              _device_copy(m._states),
+                              _device_copy(m._opt_state))
+
+    before_dispatch = before_step
+
+    def after_step(self):
+        self._after(1, self.model._score)
+
+    def after_dispatch(self, losses, steps: int):
+        self._after(steps, losses)
+
+    def _after(self, k: int, losses):
+        for _ in range(min(k, len(self._cursors))):
+            self._cursor_at_step = self._cursors.popleft()
+        if self.recovery is not None:
+            vals = np.asarray(jax.device_get(losses))
+            bad = int(vals.size - np.count_nonzero(np.isfinite(vals)))
+            if bad:
+                self._handle_nonfinite(k, bad)
+            else:
+                self._snapshot = None
+                self._rollbacks_in_row = 0
+                self._recover_lr(k)
+        else:
+            self._snapshot = None
+        m = self.model
+        if self._next_save is not None and m._iteration >= self._next_save:
+            self.checkpoint()
+        if any(s.requested(m._iteration) for s in self._signals):
+            raise PreemptionRequested(m._iteration)
+
+    def on_epoch_end(self):
+        # an epoch-boundary checkpoint must resume at the START of the
+        # next epoch: the last step's cursor points at the exhausted end
+        # of the finished epoch, and seeking there on resume would make
+        # the first resumed epoch iterate zero batches (silently losing
+        # one epoch of training)
+        self._cursor_at_step = None
+        self._cursors.clear()
+        if (self.manager is not None and self.config.every_epochs
+                and self.model._epoch % self.config.every_epochs == 0):
+            self.checkpoint()
+
+    def on_preempt(self):
+        """A PreemptionSignal fired: record it and write the 'preempted'
+        checkpoint — the in-flight (mega)step already completed because
+        signals are only polled at dispatch boundaries."""
+        self.preempted = True
+        self.model._preempted = True
+        PREEMPTIONS.inc()
+        if self.manager is not None:
+            self.checkpoint(status="preempted")
+
+    # --------------------------------------------------------- checkpoints
+    def checkpoint(self, status: str = "complete"):
+        if self.manager is None:
+            return None
+        # the BACKOFF_LR recovery state is training state too: a resume
+        # that silently restored full LR mid-backoff would re-trip the
+        # very instability the backoff was suppressing
+        upd = self.model.conf.base.updater
+        extra = {"resilience": {
+            "lr_scale": float(getattr(upd, "_lr_scale", 1.0)),
+            "good_steps": int(self._good_steps)}}
+        path = self.manager.save(
+            self.model, status=status, cursor=self._cursor_at_step,
+            normalizer=self.normalizer, extra=extra)
+        if self.config.every_steps:
+            self._next_save = self.model._iteration + self.config.every_steps
+        return path
+
+    # ---------------------------------------------------------- nonfinite
+    def _restore_snapshot(self):
+        if self._snapshot is None:
+            return
+        m = self.model
+        m._params, m._states, m._opt_state = self._snapshot
+        self._snapshot = None
+
+    def _bust_step_caches(self):
+        """An LR-scale change is baked into the compiled step at trace
+        time — clear the per-model program caches so the next dispatch
+        recompiles with the new scale."""
+        m = self.model
+        for attr in ("_train_step_cache", "_megastep_cache",
+                     "_tbptt_step_cache"):
+            cache = getattr(m, attr, None)
+            if cache is not None:
+                cache.clear()
+
+    def _recover_lr(self, k: int):
+        rec = self.recovery
+        if rec.policy is not NanPolicy.BACKOFF_LR:
+            return
+        upd = self.model.conf.base.updater
+        scale = getattr(upd, "_lr_scale", 1.0)
+        if scale >= 1.0:
+            return
+        self._good_steps += k
+        if self._good_steps >= rec.cooldown_steps:
+            upd._lr_scale = min(scale / rec.backoff_factor, 1.0)
+            self._good_steps = 0
+            self._bust_step_caches()
+            logger.info("BACKOFF_LR cooldown elapsed: lr scale %.2g -> %.2g",
+                        scale, upd._lr_scale)
+
+    def _handle_nonfinite(self, k: int, bad: int):
+        NONFINITE_STEPS.inc(bad)
+        rec = self.recovery
+        m = self.model
+        where = f"iteration {m._iteration}" if k == 1 else \
+            f"iterations {m._iteration - k + 1}..{m._iteration} " \
+            f"({bad} non-finite)"
+        if rec.policy is NanPolicy.RAISE:
+            raise NumericsPanicError(
+                f"non-finite loss at {where} (NanPolicy.RAISE)")
+        if rec.policy is NanPolicy.SKIP_STEP:
+            self._restore_snapshot()
+            logger.warning("non-finite loss at %s: update skipped "
+                           "(NanPolicy.SKIP_STEP)", where)
+            return
+        if rec.policy is NanPolicy.BACKOFF_LR:
+            self._restore_snapshot()
+            upd = m.conf.base.updater
+            scale = getattr(upd, "_lr_scale", 1.0) * rec.backoff_factor
+            if scale < rec.min_scale:
+                raise NumericsPanicError(
+                    f"non-finite loss at {where}: BACKOFF_LR reached the "
+                    f"lr-scale floor ({rec.min_scale:g}) — training cannot "
+                    "make progress")
+            upd._lr_scale = scale
+            LR_BACKOFFS.inc()
+            self._good_steps = 0
+            self._bust_step_caches()
+            logger.warning("non-finite loss at %s: update skipped, lr scale "
+                           "-> %.2g (NanPolicy.BACKOFF_LR)", where, scale)
+            return
+        # ROLLBACK
+        if self.manager is None:
+            raise NumericsPanicError(
+                f"non-finite loss at {where}: NanPolicy.ROLLBACK requires a "
+                "CheckpointConfig (no checkpoint to restore)")
+        self._rollbacks_in_row += 1
+        if self._rollbacks_in_row > rec.max_rollbacks:
+            raise NumericsPanicError(
+                f"non-finite loss at {where}: {rec.max_rollbacks} "
+                "consecutive rollbacks without a clean step — giving up")
+        info = self.manager.restore(m, normalizer=self.normalizer,
+                                    count_resume=False)
+        if info is None:
+            raise NumericsPanicError(
+                f"non-finite loss at {where}: NanPolicy.ROLLBACK found no "
+                "valid checkpoint to restore")
+        self._snapshot = None
+        ROLLBACKS.inc()
+        logger.warning("non-finite loss at %s: rolled back to %s "
+                       "(NanPolicy.ROLLBACK)", where, info["path"])
+
+
+@contextmanager
+def fit_scope(session: Optional["TrainingSession"], model, epochs: int):
+    """The shared resilience envelope around a fit's epoch loop: yields
+    the number of epochs left to run (``epochs`` minus epochs already
+    completed by a resumed checkpoint), converts a PreemptionRequested
+    unwind into the 'preempted' checkpoint + clean return, and closes
+    the session (restoring signal handlers) on every exit path. Used by
+    MultiLayerNetwork.fit, ComputationGraph.fit, and ParallelWrapper.fit
+    so the recovery protocol cannot drift between the three loops."""
+    n_epochs = epochs if session is None or not session.resumed \
+        else max(epochs - model._epoch, 0)
+    try:
+        yield n_epochs
+    except PreemptionRequested:
+        if session is None:
+            raise
+        session.on_preempt()
+    finally:
+        if session is not None:
+            session.close()
+
+
+def begin_session(model, data, checkpoint=None, nan_policy=None, faults=None):
+    """Build and start a TrainingSession for one ``fit()``:
+
+    - wraps a DataSetIterator source with the fault-injection iterator
+      (when a FaultPlan is given) and the transient-error retry wrapper,
+    - attaches the session as ``model._resilience``,
+    - installs the signal handler and performs auto-resume.
+
+    Returns ``(session, data)`` where ``data`` is the possibly-wrapped
+    iterator the fit loop should consume instead of the original.
+    """
+    iterator = data if isinstance(data, DataSetIterator) else None
+    wrapped = data
+    if iterator is not None:
+        from deeplearning4j_tpu.data.dataset import AsyncDataSetIterator
+        if checkpoint is not None and isinstance(iterator,
+                                                 AsyncDataSetIterator):
+            # the async worker pulls ahead of the applied step, so
+            # cursor() overstates position by up to prefetch+1 batches —
+            # a resumed fit would silently skip those batches
+            warnings.warn(
+                "checkpointing with an AsyncDataSetIterator source: resume "
+                "cursors are APPROXIMATE (the prefetch worker runs ahead of "
+                "the applied step). Pass the un-wrapped iterator for exact "
+                "resume; fit() overlaps host prep via its own prefetch "
+                "paths.", stacklevel=3)
+        if faults is not None:
+            wrapped = faults.wrap_iterator(wrapped)
+        retries = checkpoint.io_retries if checkpoint is not None else 3
+        backoff = checkpoint.io_backoff if checkpoint is not None else 0.05
+        wrapped = RetryingDataSetIterator(wrapped, max_retries=retries,
+                                          backoff=backoff)
+    session = TrainingSession(
+        model, checkpoint=checkpoint, nan_policy=nan_policy, faults=faults,
+        iterator=wrapped if iterator is not None else None)
+    model._resilience = session
+    session.start()
+    try:
+        session.resume()
+    except BaseException:
+        # a failed restore must not leak the installed signal handlers or
+        # leave a dead session attached to the model
+        session.close()
+        raise
+    return session, wrapped

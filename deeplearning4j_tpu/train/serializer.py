@@ -9,13 +9,21 @@ Format: zip{conf.json, arrays.npz} where arrays.npz holds per-layer params
 (``p{i}::name``), layer states (``s{i}::name``), flattened updater-state
 leaves (``u::{j}``), and counters. Arrays are saved as numpy — portable,
 no pickle.
+
+Crash-safety (ISSUE 5): every write goes to a temp file in the target
+directory finalized by ONE ``os.replace`` — a crash mid-write can never
+leave a truncated, unloadable archive under the real name. Every restore
+failure surfaces as a structured :class:`CorruptModelError` naming the
+missing/bad entry instead of a raw ``KeyError``/``BadZipFile``.
 """
 
 from __future__ import annotations
 
 import io
 import json
+import os
 import zipfile
+from contextlib import contextmanager
 from typing import Dict
 
 import jax
@@ -23,10 +31,100 @@ import jax.numpy as jnp
 import numpy as np
 
 
+class CorruptModelError(Exception):
+    """A model/normalizer archive failed to restore: truncated zip,
+    missing entry, CRC mismatch, or unparseable metadata. ``entry``
+    names the offending archive member (None for container-level
+    damage)."""
+
+    def __init__(self, path: str, entry, detail: str):
+        self.path = path
+        self.entry = entry
+        where = f"{path}[{entry}]" if entry else path
+        super().__init__(f"corrupt model archive {where}: {detail}")
+
+
+@contextmanager
+def atomic_write(path: str):
+    """Yield a temp path in ``path``'s directory; on clean exit,
+    ``os.replace`` it over ``path`` (atomic on POSIX — readers see the
+    old file or the new file, never a partial one). On error the temp
+    file is removed and the original is untouched."""
+    tmp = f"{path}.tmp{os.getpid()}"
+    try:
+        yield tmp
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+        raise
+
+
+def write_model_zip(path: str, conf_json: str, meta: dict,
+                    arrays: Dict[str, np.ndarray]) -> None:
+    """Shared atomic writer for the model-archive format (used by
+    ModelSerializer.writeModel and ComputationGraph.save)."""
+    with atomic_write(path) as tmp:
+        with zipfile.ZipFile(tmp, "w") as z:
+            z.writestr("conf.json", conf_json)
+            z.writestr("meta.json", json.dumps(meta))
+            buf = io.BytesIO()
+            np.savez(buf, **arrays) if arrays else np.savez(
+                buf, __empty__=np.zeros(1))
+            z.writestr("arrays.npz", buf.getvalue())
+
+
+def read_model_zip(path: str):
+    """Shared validating reader: returns (conf_json_str, meta_dict,
+    npz_arrays), raising CorruptModelError naming the bad entry."""
+    try:
+        z = zipfile.ZipFile(path)
+    except FileNotFoundError:
+        raise
+    except (zipfile.BadZipFile, OSError) as e:
+        raise CorruptModelError(path, None,
+                                f"not a readable zip ({e})") from e
+    with z:
+        names = set(z.namelist())
+        for req in ("conf.json", "meta.json", "arrays.npz"):
+            if req not in names:
+                raise CorruptModelError(path, req, "entry missing")
+        try:
+            bad = z.testzip()
+        except (zipfile.BadZipFile, OSError) as e:
+            raise CorruptModelError(path, None,
+                                    f"CRC scan failed ({e})") from e
+        if bad is not None:
+            raise CorruptModelError(path, bad, "CRC mismatch (truncated or "
+                                    "bit-flipped write)")
+        conf_json = z.read("conf.json").decode()
+        try:
+            meta = json.loads(z.read("meta.json"))
+        except (json.JSONDecodeError, UnicodeDecodeError) as e:
+            raise CorruptModelError(path, "meta.json",
+                                    f"unparseable ({e})") from e
+        try:
+            arrays = np.load(io.BytesIO(z.read("arrays.npz")))
+        except (ValueError, OSError) as e:
+            raise CorruptModelError(path, "arrays.npz",
+                                    f"unloadable npz ({e})") from e
+    return conf_json, meta, arrays
+
+
+def require_array(arrays, key: str, path: str):
+    """Fetch one npz member, raising CorruptModelError (not KeyError)
+    when the archive lacks it."""
+    if key not in arrays.files:
+        raise CorruptModelError(path, f"arrays.npz::{key}", "entry missing")
+    return arrays[key]
+
+
 class ModelSerializer:
     @staticmethod
     def writeModel(model, path: str, save_updater: bool = True):
-        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
         conf_json = model.conf.to_json()
         meta = {"type": type(model).__name__, "iteration": model._iteration,
                 "epoch": model._epoch, "save_updater": bool(save_updater and
@@ -42,28 +140,25 @@ class ModelSerializer:
             leaves, treedef = jax.tree_util.tree_flatten(model._opt_state)
             for j, leaf in enumerate(leaves):
                 arrays[f"u::{j}"] = np.asarray(leaf)
-        with zipfile.ZipFile(path, "w") as z:
-            z.writestr("conf.json", conf_json)
-            z.writestr("meta.json", json.dumps(meta))
-            buf = io.BytesIO()
-            np.savez(buf, **arrays) if arrays else np.savez(buf, __empty__=np.zeros(1))
-            z.writestr("arrays.npz", buf.getvalue())
+        write_model_zip(path, conf_json, meta, arrays)
 
     @staticmethod
     def restoreMultiLayerNetwork(path: str, load_updater: bool = True):
         from deeplearning4j_tpu.nn.config import MultiLayerConfiguration
         from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
-        with zipfile.ZipFile(path) as z:
-            conf = MultiLayerConfiguration.from_json(z.read("conf.json").decode())
-            meta = json.loads(z.read("meta.json"))
-            arrays = np.load(io.BytesIO(z.read("arrays.npz")))
+        conf_json, meta, arrays = read_model_zip(path)
+        try:
+            conf = MultiLayerConfiguration.from_json(conf_json)
+        except Exception as e:
+            raise CorruptModelError(path, "conf.json",
+                                    f"unparseable configuration ({e})") from e
         net = MultiLayerNetwork(conf)
         net.init()
         for k in arrays.files:
             if k == "__empty__":
                 continue
             kind, _, name = k.partition("::")
-            if kind.startswith("p"):
+            if kind.startswith("p") and kind != "p":
                 net._params[int(kind[1:])][name] = jnp.asarray(arrays[k])
             elif kind.startswith("s") and kind != "s":
                 net._states[int(kind[1:])][name] = jnp.asarray(arrays[k])
@@ -72,7 +167,8 @@ class ModelSerializer:
         if load_updater and meta.get("save_updater"):
             net._ensure_opt_state()
             leaves, treedef = jax.tree_util.tree_flatten(net._opt_state)
-            new_leaves = [jnp.asarray(arrays[f"u::{j}"]) for j in range(len(leaves))]
+            new_leaves = [jnp.asarray(require_array(arrays, f"u::{j}", path))
+                          for j in range(len(leaves))]
             net._opt_state = jax.tree_util.tree_unflatten(treedef, new_leaves)
         return net
 
@@ -80,14 +176,31 @@ class ModelSerializer:
     @staticmethod
     def writeNormalizer(norm, path: str):
         state = norm.state() if hasattr(norm, "state") else norm.__dict__
-        np.savez(path, __class__=np.asarray(type(norm).__name__),
-                 **{k: np.asarray(v) for k, v in state.items() if v is not None})
+        with atomic_write(path) as tmp:
+            # write through a file object: np.savez(path) appends ".npz"
+            # to extension-less paths, which would break the final replace
+            with open(tmp, "wb") as f:
+                np.savez(f, __class__=np.asarray(type(norm).__name__),
+                         **{k: np.asarray(v) for k, v in state.items()
+                            if v is not None})
 
     @staticmethod
     def restoreNormalizer(path: str):
         from deeplearning4j_tpu.data import dataset as D
-        data = np.load(path, allow_pickle=False)
-        cls = getattr(D, str(data["__class__"]))
+        try:
+            data = np.load(path, allow_pickle=False)
+        except FileNotFoundError:
+            raise
+        except (ValueError, OSError) as e:
+            raise CorruptModelError(path, None,
+                                    f"unloadable normalizer npz ({e})") from e
+        if "__class__" not in data.files:
+            raise CorruptModelError(path, "__class__", "entry missing")
+        cls = getattr(D, str(data["__class__"]), None)
+        if cls is None:
+            raise CorruptModelError(path, "__class__",
+                                    f"unknown normalizer class "
+                                    f"{data['__class__']!r}")
         norm = cls()
         for k in data.files:
             if k != "__class__":

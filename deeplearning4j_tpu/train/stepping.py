@@ -189,15 +189,21 @@ def record_megastep(model, losses, steps: int, batch_size: int) -> None:
         # instead of K tiny indexing dispatches per megastep
         model._iteration += steps
         model._score = losses[steps - 1]
-        return
-    for j in range(steps):
-        model._score = losses[j]
-        model._iteration += 1
-        for lst in model._listeners:
-            if hasattr(lst, "onIterationStart"):
-                lst.onIterationStart(model, model._iteration)
-            if hasattr(lst, "iterationDone"):
-                lst.iterationDone(model, model._iteration, model._epoch)
+    else:
+        for j in range(steps):
+            model._score = losses[j]
+            model._iteration += 1
+            for lst in model._listeners:
+                if hasattr(lst, "onIterationStart"):
+                    lst.onIterationStart(model, model._iteration)
+                if hasattr(lst, "iterationDone"):
+                    lst.iterationDone(model, model._iteration, model._epoch)
+    # resilience seam (train.resilience): non-finite recovery, periodic
+    # checkpoint, and preemption all act at dispatch granularity — the
+    # in-flight megastep always completes before any of them fire
+    res = getattr(model, "_resilience", None)
+    if res is not None:
+        res.after_dispatch(losses, steps)
 
 
 def fit_epoch_multistep(model, batches: Iterable, steps: int,

@@ -43,7 +43,12 @@ class IUpdater:
         self.learning_rate = resolve(self.DEFAULT_LR if learning_rate is None else learning_rate)
 
     def lr_at(self, t, epoch=0):
-        return self.learning_rate.valueAt(t, epoch)
+        # _lr_scale is the NanPolicy.BACKOFF_LR recovery knob
+        # (train.resilience): baked into the compiled step at trace time,
+        # so the resilience layer busts the step caches when it changes
+        lr = self.learning_rate.valueAt(t, epoch)
+        scale = getattr(self, "_lr_scale", 1.0)
+        return lr if scale == 1.0 else lr * scale
 
     def init_state(self, param) -> State:
         return {}

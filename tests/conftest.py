@@ -31,6 +31,10 @@ def pytest_configure(config):
         "markers",
         "quick: fast smoke tier covering every subsystem "
         "(`pytest -m quick`, target <120s — the CI gate)")
+    config.addinivalue_line(
+        "markers",
+        "chaos: seeded fault-injection sweeps through the resilience "
+        "layer (`pytest -m chaos`; fast, CPU-backend, runs under tier-1)")
 
 
 @pytest.fixture(scope="session")

@@ -1,0 +1,909 @@
+"""Fault-tolerant training (ISSUE 5): auto-checkpoint/resume, preemption,
+NaN recovery policies, transient-I/O retry — every recovery path pinned
+by a DETERMINISTIC injected fault (deeplearning4j_tpu.faults).
+
+The hard guarantee under test: ``fit(N)`` == ``fit(k)`` + preemption +
+resume, BIT-EXACT for params, updater state, and the step-RNG clock —
+on MultiLayerNetwork, ComputationGraph, and steps_per_dispatch>1
+megastep runs.
+"""
+
+import json
+import os
+import signal
+import zipfile
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.dataset import (AsyncDataSetIterator, DataSet,
+                                             DevicePrefetcher,
+                                             ListDataSetIterator,
+                                             NormalizerStandardize,
+                                             RetryingDataSetIterator,
+                                             TransientDataError)
+from deeplearning4j_tpu.faults import FaultPlan
+from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                   NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.layers import DenseLayer, DropoutLayer, OutputLayer
+from deeplearning4j_tpu.train import updaters
+from deeplearning4j_tpu.train.earlystopping import (
+    DataSetLossCalculator, EarlyStoppingConfiguration, EarlyStoppingTrainer,
+    LocalFileModelSaver, MaxEpochsTerminationCondition)
+from deeplearning4j_tpu.train.resilience import (CheckpointConfig,
+                                                 CheckpointManager,
+                                                 CorruptCheckpointError,
+                                                 NanPolicy, NanRecovery,
+                                                 StepPreemption)
+from deeplearning4j_tpu.train.serializer import (CorruptModelError,
+                                                 ModelSerializer)
+from deeplearning4j_tpu.utils.environment import NumericsPanicError
+
+NIN, NOUT, BATCH, NBATCH = 6, 3, 4, 10
+
+
+def mlp(seed=42, lr=0.01, dropout=False):
+    b = (NeuralNetConfiguration.Builder().seed(seed)
+         .updater(updaters.Adam(lr)).list()
+         .layer(DenseLayer(nOut=8, activation="relu")))
+    if dropout:
+        b = b.layer(DropoutLayer(0.5))
+    conf = (b.layer(OutputLayer(nOut=NOUT, lossFunction="mcxent",
+                                activation="softmax"))
+            .setInputType(InputType.feedForward(NIN))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def graph_net(seed=7):
+    b = (NeuralNetConfiguration.Builder().seed(seed)
+         .updater(updaters.Adam(0.01)).graphBuilder())
+    b.addInputs("in").setInputTypes(InputType.feedForward(NIN))
+    b.addLayer("d1", DenseLayer(nOut=8, activation="relu"), "in")
+    b.addLayer("out", OutputLayer(nOut=NOUT, lossFunction="mcxent",
+                                  activation="softmax"), "d1")
+    b.setOutputs("out")
+    return ComputationGraph(b.build()).init()
+
+
+def dataset(n=NBATCH * BATCH, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, NIN).astype(np.float32)
+    y = np.eye(NOUT, dtype=np.float32)[rng.randint(0, NOUT, n)]
+    return DataSet(x, y)
+
+
+def iterator(seed=0, shuffle=False):
+    return ListDataSetIterator(dataset(seed=seed), batch_size=BATCH,
+                               shuffle=shuffle)
+
+
+def leaves_equal(a, b):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y)) for x, y in zip(la, lb))
+
+
+def assert_training_state_equal(a, b):
+    assert np.array_equal(np.asarray(a.params()), np.asarray(b.params())), \
+        "params not bit-exact"
+    assert leaves_equal(a._opt_state, b._opt_state), "opt state not bit-exact"
+    assert a._iteration == b._iteration
+    assert int(a._ensure_clock()) == int(b._ensure_clock()), \
+        "step-RNG clock diverged"
+
+
+# ===================================================================== resume
+class TestResumeEquivalence:
+    def _run(self, build, tmp_path, k=1, preempt_at=6):
+        """fit(10) vs fit->preempt@6->resume(4); returns (straight, resumed)."""
+        straight = build()
+        straight.fit(iterator(), epochs=1, steps_per_dispatch=k)
+        d = str(tmp_path / "ckpts")
+        pre = build()
+        pre.fit(iterator(), epochs=1, steps_per_dispatch=k,
+                checkpoint=CheckpointConfig(d, every_steps=2),
+                faults=FaultPlan(preempt_at_step=preempt_at))
+        assert pre._preempted and pre._iteration == preempt_at
+        res = build()
+        res.fit(iterator(), epochs=1, steps_per_dispatch=k,
+                checkpoint=CheckpointConfig(d, resume=True))
+        assert res._iteration == NBATCH
+        return straight, res
+
+    def test_multilayer_bit_exact(self, tmp_path):
+        a, b = self._run(mlp, tmp_path)
+        assert_training_state_equal(a, b)
+
+    def test_multilayer_dropout_rng_bit_exact(self, tmp_path):
+        # dropout keys come from fold_in(seed, t): resume restores t, so
+        # the post-resume dropout masks are the straight run's exactly
+        a, b = self._run(lambda: mlp(dropout=True), tmp_path)
+        assert_training_state_equal(a, b)
+
+    def test_graph_bit_exact(self, tmp_path):
+        a, b = self._run(graph_net, tmp_path)
+        assert_training_state_equal(a, b)
+
+    def test_megastep_bit_exact(self, tmp_path):
+        a, b = self._run(mlp, tmp_path, k=2)
+        assert_training_state_equal(a, b)
+
+    def test_preempted_manifest_status_and_cursor(self, tmp_path):
+        d = str(tmp_path / "c")
+        net = mlp()
+        net.fit(iterator(), epochs=1,
+                checkpoint=CheckpointConfig(d, every_steps=3),
+                faults=FaultPlan(preempt_at_step=7))
+        mgr = CheckpointManager(CheckpointConfig(d))
+        path, manifest = mgr.latest_valid()
+        assert manifest["status"] == "preempted"
+        assert manifest["step"] == 7
+        with open(os.path.join(path, "extra.json")) as f:
+            cursor = json.load(f)["cursor"]
+        assert cursor == {"pos": 7 * BATCH, "epoch": 0}
+
+    def test_shuffled_iterator_cursor_resume(self, tmp_path):
+        # seek() rebuilds the seeded shuffle order for the stored epoch
+        d = str(tmp_path / "c")
+        build = lambda: mlp()
+        a = build()
+        a.fit(iterator(shuffle=True), epochs=1)
+        pre = build()
+        pre.fit(iterator(shuffle=True), epochs=1,
+                checkpoint=CheckpointConfig(d, every_steps=2),
+                faults=FaultPlan(preempt_at_step=4))
+        res = build()
+        res.fit(iterator(shuffle=True), epochs=1,
+                checkpoint=CheckpointConfig(d, resume=True))
+        assert_training_state_equal(a, res)
+
+    def test_resume_with_empty_dir_is_fresh_run(self, tmp_path):
+        d = str(tmp_path / "nothing")
+        a = mlp()
+        a.fit(iterator(), epochs=1, checkpoint=CheckpointConfig(d, resume=True))
+        b = mlp()
+        b.fit(iterator(), epochs=1)
+        assert_training_state_equal(a, b)
+
+    def test_multi_epoch_resume_runs_remaining_epochs(self, tmp_path):
+        d = str(tmp_path / "c")
+        a = mlp()
+        a.fit(iterator(), epochs=3)
+        pre = mlp()
+        pre.fit(iterator(), epochs=3, checkpoint=CheckpointConfig(d, every_steps=5),
+                faults=FaultPlan(preempt_at_step=15))   # mid-epoch 1
+        assert pre._iteration == 15
+        res = mlp()
+        res.fit(iterator(), epochs=3, checkpoint=CheckpointConfig(d, resume=True))
+        assert res._iteration == 3 * NBATCH
+        assert_training_state_equal(a, res)
+
+
+# ============================================================== NaN policies
+class TestNanPolicies:
+    def test_raise(self):
+        net = mlp()
+        with pytest.raises(NumericsPanicError, match="iteration 3"):
+            net.fit(iterator(), nan_policy=NanPolicy.RAISE,
+                    faults=FaultPlan(nan_grads_at=[3]))
+
+    def test_skip_step_bit_exact_vs_manual_skip(self):
+        # SKIP_STEP drops the poisoned update but consumes the iteration
+        # (t advances): reproduce by hand and compare bit-exact
+        batches = dataset().batchBy(BATCH)
+        a = mlp()
+        a.fit(iterator(), nan_policy=NanPolicy.SKIP_STEP,
+              faults=FaultPlan(nan_grads_at=[3]))
+        assert a._iteration == NBATCH
+        b = mlp()
+        for j, ds in enumerate(batches):
+            if j == 2:                      # batch 3 never lands...
+                b._iteration += 1           # ...but its step number is spent
+                b._t_dev = b._ensure_clock() + 1
+                continue
+            b._fit_one(ds)
+        assert_training_state_equal(a, b)
+        assert np.isfinite(np.asarray(a.params())).all()
+
+    def test_skip_step_megastep_dispatch_granularity(self):
+        # a poisoned sub-step skips the WHOLE K-step dispatch (the
+        # compiled program is atomic): steps 3..4 both roll back
+        a = mlp()
+        a.fit(iterator(), steps_per_dispatch=2,
+              nan_policy=NanPolicy.SKIP_STEP,
+              faults=FaultPlan(nan_grads_at=[3]))
+        assert a._iteration == NBATCH
+        assert np.isfinite(np.asarray(a.params())).all()
+
+    def test_backoff_lr_halves_then_recovers(self):
+        net = mlp()
+        net.fit(iterator(),
+                nan_policy=NanRecovery(NanPolicy.BACKOFF_LR,
+                                       cooldown_steps=100),  # no recovery yet
+                faults=FaultPlan(nan_grads_at=[3]))
+        assert getattr(net.conf.base.updater, "_lr_scale", 1.0) == 0.5
+        assert np.isfinite(np.asarray(net.params())).all()
+        net.conf.base.updater._lr_scale = 1.0   # don't leak into other tests
+
+    def test_backoff_lr_recovers_after_cooldown(self):
+        net = mlp()
+        net.fit(iterator(),
+                nan_policy=NanRecovery(NanPolicy.BACKOFF_LR, cooldown_steps=3),
+                faults=FaultPlan(nan_grads_at=[3]))
+        # 7 clean steps after the backoff > cooldown: scale recovered
+        assert getattr(net.conf.base.updater, "_lr_scale", 1.0) == 1.0
+
+    def test_backoff_lr_scale_survives_resume(self, tmp_path):
+        # the halved LR is training state: a resume restoring full LR
+        # would re-trip the instability the backoff was suppressing
+        d = str(tmp_path / "c")
+        pre = mlp()
+        pre.fit(iterator(),
+                checkpoint=CheckpointConfig(d, every_steps=2),
+                nan_policy=NanRecovery(NanPolicy.BACKOFF_LR,
+                                       cooldown_steps=100),
+                faults=FaultPlan(nan_grads_at=[3], preempt_at_step=6))
+        assert getattr(pre.conf.base.updater, "_lr_scale", 1.0) == 0.5
+        pre.conf.base.updater._lr_scale = 1.0    # fresh conf in resumed run
+        res = mlp()
+        res.fit(iterator(),
+                checkpoint=CheckpointConfig(d, resume=True),
+                nan_policy=NanRecovery(NanPolicy.BACKOFF_LR,
+                                       cooldown_steps=100))
+        assert getattr(res.conf.base.updater, "_lr_scale", 1.0) == 0.5
+        res.conf.base.updater._lr_scale = 1.0    # don't leak across tests
+
+    def test_rollback_restores_last_checkpoint(self, tmp_path):
+        d = str(tmp_path / "c")
+        net = mlp()
+        net.fit(iterator(), checkpoint=CheckpointConfig(d, every_steps=2),
+                nan_policy=NanPolicy.ROLLBACK,
+                faults=FaultPlan(nan_grads_at=[5]))
+        # rolled 5 -> 4, then the remaining 5 batches: 9 total
+        assert net._iteration == 9
+        assert np.isfinite(np.asarray(net.params())).all()
+
+    def test_rollback_without_checkpoint_raises(self):
+        net = mlp()
+        with pytest.raises(NumericsPanicError, match="ROLLBACK requires"):
+            net.fit(iterator(), nan_policy=NanPolicy.ROLLBACK,
+                    faults=FaultPlan(nan_grads_at=[3]))
+
+    def test_nonfinite_metric_counted(self):
+        from deeplearning4j_tpu.train.resilience import NONFINITE_STEPS
+        before = NONFINITE_STEPS.value
+        net = mlp()
+        net.fit(iterator(), nan_policy=NanPolicy.SKIP_STEP,
+                faults=FaultPlan(nan_grads_at=[2, 6]))
+        assert NONFINITE_STEPS.value - before == 2
+
+
+# =============================================================== preemption
+class TestPreemption:
+    def test_mid_megastep_finishes_dispatch_then_checkpoints(self, tmp_path):
+        d = str(tmp_path / "c")
+        net = mlp()
+        net.fit(iterator(), steps_per_dispatch=4,
+                checkpoint=CheckpointConfig(d),
+                faults=FaultPlan(preempt_at_step=2))
+        # the signal fired during the first 4-step dispatch: it completes
+        # before the preemption is honored
+        assert net._iteration == 4
+        _, manifest = CheckpointManager(CheckpointConfig(d)).latest_valid()
+        assert manifest["status"] == "preempted" and manifest["step"] == 4
+
+    def test_sigterm_checkpoints_and_returns(self, tmp_path):
+        d = str(tmp_path / "c")
+        net = mlp()
+
+        class Bomb:
+            def iterationDone(self, model, iteration, epoch):
+                if iteration == 3:
+                    os.kill(os.getpid(), signal.SIGTERM)
+        net.setListeners(Bomb())
+        net.fit(iterator(), epochs=1, checkpoint=CheckpointConfig(d))
+        assert net._preempted and net._iteration < NBATCH
+        _, manifest = CheckpointManager(CheckpointConfig(d)).latest_valid()
+        assert manifest["status"] == "preempted"
+        # handlers restored after fit
+        assert signal.getsignal(signal.SIGTERM) in (signal.SIG_DFL,
+                                                    signal.Handlers.SIG_DFL)
+
+    def test_step_preemption_signal_api(self):
+        sig = StepPreemption(5)
+        assert not sig.requested(4)
+        assert sig.requested(5) and sig.requested(6)
+
+
+# ============================================================== checkpoints
+class TestCheckpointManager:
+    def test_rotation_keep_last(self, tmp_path):
+        d = str(tmp_path / "c")
+        net = mlp()
+        net.fit(iterator(), checkpoint=CheckpointConfig(d, every_steps=2,
+                                                        keep_last=2))
+        mgr = CheckpointManager(CheckpointConfig(d))
+        steps = [s for s, _ in mgr.checkpoints()]
+        assert steps == [8, 10]
+
+    def test_corrupt_checkpoint_quarantined_resume_uses_older(self, tmp_path):
+        d = str(tmp_path / "c")
+        pre = mlp()
+        pre.fit(iterator(), epochs=1,
+                checkpoint=CheckpointConfig(d, every_steps=2, keep_last=10),
+                faults=FaultPlan(checkpoint_corrupt_at=[6],
+                                 preempt_at_step=6))
+        # the preempted save re-wrote step 6 cleanly over the corrupt one;
+        # corrupt it again by hand so resume really faces damage
+        target = os.path.join(d, "ckpt_0000000006", "model.zip")
+        with open(target, "r+b") as f:
+            f.seek(os.path.getsize(target) // 2)
+            f.write(b"\x00" * 64)
+        with pytest.warns(UserWarning, match="quarantined corrupt checkpoint"):
+            res = mlp()
+            res.fit(iterator(), epochs=1,
+                    checkpoint=CheckpointConfig(d, resume=True))
+        assert res._iteration == NBATCH
+        entries = os.listdir(d)
+        assert any(e.startswith("quarantine_ckpt_0000000006") for e in entries)
+        # the older step-4 checkpoint carried the resume
+        mgr = CheckpointManager(CheckpointConfig(d))
+        assert 4 in [s for s, _ in mgr.checkpoints()]
+
+    def test_validate_names_bad_file(self, tmp_path):
+        d = str(tmp_path / "c")
+        net = mlp()
+        net.fit(iterator(), checkpoint=CheckpointConfig(d, every_steps=5))
+        mgr = CheckpointManager(CheckpointConfig(d))
+        path = mgr.checkpoints()[-1][1]
+        with open(os.path.join(path, "model.zip"), "ab") as f:
+            f.write(b"garbage")
+        with pytest.raises(CorruptCheckpointError, match="model.zip"):
+            mgr.validate(path)
+
+    def test_write_failure_retried(self, tmp_path):
+        d = str(tmp_path / "c")
+        net = mlp()
+        net.fit(iterator(),
+                checkpoint=CheckpointConfig(d, every_steps=4, io_backoff=0.01),
+                faults=FaultPlan(checkpoint_write_fail_at=[4]))
+        mgr = CheckpointManager(CheckpointConfig(d))
+        steps = [s for s, _ in mgr.checkpoints()]
+        assert 4 in steps               # the failed write succeeded on retry
+        for _, p in mgr.checkpoints():
+            mgr.validate(p)
+
+    def test_normalizer_round_trip(self, tmp_path):
+        d = str(tmp_path / "c")
+        it = iterator()
+        norm = NormalizerStandardize()
+        norm.fit(it.data)
+        it.setPreProcessor(norm)
+        net = mlp()
+        net.fit(it, checkpoint=CheckpointConfig(d, every_steps=5))
+        path = CheckpointManager(CheckpointConfig(d)).checkpoints()[-1][1]
+        assert os.path.exists(os.path.join(path, "normalizer.npz"))
+        it2 = iterator()
+        norm2 = NormalizerStandardize()
+        it2.setPreProcessor(norm2)       # un-fit: resume must fill it in
+        res = mlp()
+        res.fit(it2, checkpoint=CheckpointConfig(d, resume=True))
+        np.testing.assert_array_equal(norm2.mean, norm.mean)
+        np.testing.assert_array_equal(norm2.std, norm.std)
+
+    def test_every_epochs(self, tmp_path):
+        d = str(tmp_path / "c")
+        net = mlp()
+        net.fit(iterator(), epochs=2,
+                checkpoint=CheckpointConfig(d, every_epochs=1))
+        steps = [s for s, _ in CheckpointManager(
+            CheckpointConfig(d)).checkpoints()]
+        assert steps == [NBATCH, 2 * NBATCH]
+
+    def test_epoch_boundary_resume_trains_all_remaining_epochs(self, tmp_path):
+        # an epoch-end checkpoint must NOT carry the exhausted iterator
+        # cursor: resuming from it would seek past the data and silently
+        # run the first resumed epoch with zero batches
+        d = str(tmp_path / "c")
+        a = mlp()
+        a.fit(iterator(), epochs=3)
+        partial = mlp()
+        partial.fit(iterator(), epochs=1,
+                    checkpoint=CheckpointConfig(d, every_epochs=1))
+        res = mlp()
+        res.fit(iterator(), epochs=3,
+                checkpoint=CheckpointConfig(d, resume=True))
+        assert res._iteration == 3 * NBATCH
+        assert_training_state_equal(a, res)
+
+
+# ============================================================ data pipeline
+class _FlakyIterator(ListDataSetIterator):
+    """Raises a transient error on chosen pull indices (once each)."""
+
+    def __init__(self, *a, fail_at=(), transient=True, **kw):
+        super().__init__(*a, **kw)
+        self._fail_at = set(fail_at)
+        self._transient = transient
+        self._pulls = 0
+
+    def next(self):
+        self._pulls += 1
+        if self._pulls in self._fail_at:
+            self._fail_at.discard(self._pulls)
+            self._pulls -= 1
+            if self._transient:
+                raise TransientDataError(f"flaky pull {self._pulls + 1}")
+            raise IOError("permanent failure")
+        return super().next()
+
+
+class TestDataRetry:
+    def test_fit_retries_transient_iterator_error_bit_exact(self):
+        from deeplearning4j_tpu.data.dataset import _DATA_RETRIES
+        before = _DATA_RETRIES.value
+        a = mlp()
+        a.fit(iterator(), faults=FaultPlan(data_error_at=[4]))
+        assert a._iteration == NBATCH
+        assert _DATA_RETRIES.value > before
+        b = mlp()
+        b.fit(iterator())
+        assert_training_state_equal(a, b)   # the retry delivered batch 4
+
+    def test_fit_permanent_error_propagates(self):
+        net = mlp()
+        with pytest.raises(IOError, match="permanent"):
+            net.fit(iterator(),
+                    faults=FaultPlan(data_error_at=[4],
+                                     data_error_transient=False))
+
+    def test_retrying_iterator_direct(self):
+        it = RetryingDataSetIterator(
+            _FlakyIterator(dataset(), batch_size=BATCH, fail_at=[2, 5]),
+            max_retries=2, backoff=0.001)
+        n = 0
+        while it.hasNext():
+            it.next()
+            n += 1
+        assert n == NBATCH
+
+    def test_retrying_iterator_gives_up(self):
+        it = RetryingDataSetIterator(
+            _FlakyIterator(dataset(), batch_size=BATCH, fail_at=[2],
+                           transient=False),
+            max_retries=3, backoff=0.001)
+        it.next()
+        with pytest.raises(IOError):
+            it.next()
+
+    def test_async_iterator_retries_transient(self):
+        base = _FlakyIterator(dataset(), batch_size=BATCH, fail_at=[3])
+        it = AsyncDataSetIterator(base, max_retries=2, retry_backoff=0.001)
+        got = 0
+        while it.hasNext():
+            it.next()
+            got += 1
+        it.close()
+        assert got == NBATCH
+
+    def test_async_close_propagates_undelivered_error(self):
+        base = _FlakyIterator(dataset(), batch_size=BATCH, fail_at=[2],
+                              transient=False)
+        it = AsyncDataSetIterator(base, prefetch=8)
+        it.next()                        # consume one good batch
+        import time
+        time.sleep(0.2)                  # let the worker hit the failure
+        with pytest.raises(IOError, match="permanent"):
+            it.close()
+        it.close()                       # double close: idempotent, no raise
+
+    def test_async_error_delivered_via_next_not_reraised_on_close(self):
+        base = _FlakyIterator(dataset(), batch_size=BATCH, fail_at=[1],
+                              transient=False)
+        it = AsyncDataSetIterator(base)
+        with pytest.raises(IOError):
+            while it.hasNext():
+                it.next()
+        it.close()                       # already delivered: no raise
+
+    def test_prefetcher_close_propagates_undelivered_error(self):
+        def stream():
+            yield dataset().batchBy(BATCH)[0]
+            raise IOError("boom in worker")
+        pf = DevicePrefetcher(stream(), prefetch=4)
+        next(iter(pf))
+        import time
+        time.sleep(0.2)
+        with pytest.raises(IOError, match="boom"):
+            pf.close()
+        pf.close()                       # idempotent
+
+    def test_prefetcher_retry_on_iterator_source(self):
+        base = _FlakyIterator(dataset(), batch_size=BATCH, fail_at=[3])
+        pf = DevicePrefetcher(base, steps_per_dispatch=1, max_retries=2,
+                              retry_backoff=0.001)
+        items = list(pf)
+        assert len(items) == NBATCH
+
+
+# =========================================================== early stopping
+class TestEarlyStoppingResume:
+    def _trainer(self, net, d, max_epochs, ckpt):
+        val = iterator(seed=99)
+        cfg = (EarlyStoppingConfiguration.Builder()
+               .scoreCalculator(DataSetLossCalculator(val))
+               .epochTerminationConditions(
+                   MaxEpochsTerminationCondition(max_epochs))
+               .modelSaver(LocalFileModelSaver(os.path.join(d, "best")))
+               .build())
+        return EarlyStoppingTrainer(cfg, net, iterator(), checkpoint=ckpt)
+
+    def test_resume_keeps_best_score_state(self, tmp_path):
+        d = str(tmp_path)
+        ckdir = os.path.join(d, "ck")
+        t1 = self._trainer(mlp(), d, 2, CheckpointConfig(ckdir))
+        r1 = t1.fit()
+        assert r1.total_epochs == 2 and len(r1.score_vs_epoch) == 2
+        # resumed trainer continues at epoch 3 with the best state intact
+        t2 = self._trainer(mlp(), d, 4,
+                           CheckpointConfig(ckdir, resume=True))
+        r2 = t2.fit()
+        assert r2.total_epochs == 4
+        assert set(r2.score_vs_epoch) == {1, 2, 3, 4}
+        for e, s in r1.score_vs_epoch.items():
+            assert r2.score_vs_epoch[e] == pytest.approx(s)
+        assert r2.best_score <= r1.best_score
+        assert r2.getBestModel() is not None
+
+    def test_resume_with_in_memory_saver_warns_and_returns_final(self,
+                                                                 tmp_path):
+        # the default InMemoryModelSaver cannot reload a best model from a
+        # dead process: the resumed run must warn and fall back to the
+        # final model instead of crashing at getBestModel()
+        from deeplearning4j_tpu.train.earlystopping import InMemoryModelSaver
+        ckdir = str(tmp_path / "ck")
+        val = iterator(seed=99)
+
+        def trainer(max_epochs, ckpt):
+            cfg = (EarlyStoppingConfiguration.Builder()
+                   .scoreCalculator(DataSetLossCalculator(val))
+                   .epochTerminationConditions(
+                       MaxEpochsTerminationCondition(max_epochs))
+                   .modelSaver(InMemoryModelSaver())
+                   .build())
+            return EarlyStoppingTrainer(cfg, mlp(), iterator(),
+                                        checkpoint=ckpt)
+        trainer(2, CheckpointConfig(ckdir)).fit()
+        # make the restored best unbeatable so the resumed run never saves
+        mgr = CheckpointManager(CheckpointConfig(ckdir))
+        path = mgr.checkpoints()[-1][1]
+        extra_path = os.path.join(path, "extra.json")
+        with open(extra_path) as f:
+            payload = json.load(f)
+        payload["extra"]["earlystopping"]["best_score"] = -1e9
+        with open(extra_path, "w") as f:
+            json.dump(payload, f)
+        man_path = os.path.join(path, "manifest.json")
+        with open(man_path) as f:
+            manifest = json.load(f)
+        from deeplearning4j_tpu.train.resilience import _sha256_file
+        manifest["files"]["extra.json"] = _sha256_file(extra_path)
+        with open(man_path, "w") as f:
+            json.dump(manifest, f)
+        with pytest.warns(UserWarning, match="cannot reload the best MODEL"):
+            r = trainer(3, CheckpointConfig(ckdir, resume=True)).fit()
+        assert r.getBestModel() is not None     # final-model fallback
+
+    def test_resume_with_missing_best_zip_falls_back_to_final(self, tmp_path):
+        # LocalFileModelSaver pointed at a directory with no bestModel.zip
+        # (fresh machine): the resumed run must return the final model,
+        # not crash in getBestModel()
+        import shutil
+        d = str(tmp_path)
+        ckdir = os.path.join(d, "ck")
+        t1 = self._trainer(mlp(), d, 2, CheckpointConfig(ckdir))
+        t1.fit()
+        shutil.rmtree(os.path.join(d, "best"))
+        # make the restored best unbeatable so no new save happens
+        mgr = CheckpointManager(CheckpointConfig(ckdir))
+        path = mgr.checkpoints()[-1][1]
+        extra_path = os.path.join(path, "extra.json")
+        with open(extra_path) as f:
+            payload = json.load(f)
+        payload["extra"]["earlystopping"]["best_score"] = -1e9
+        with open(extra_path, "w") as f:
+            json.dump(payload, f)
+        man_path = os.path.join(path, "manifest.json")
+        with open(man_path) as f:
+            manifest = json.load(f)
+        from deeplearning4j_tpu.train.resilience import _sha256_file
+        manifest["files"]["extra.json"] = _sha256_file(extra_path)
+        with open(man_path, "w") as f:
+            json.dump(manifest, f)
+        with pytest.warns(UserWarning, match="cannot reload the best MODEL"):
+            r = self._trainer(mlp(), d, 3,
+                              CheckpointConfig(ckdir, resume=True)).fit()
+        assert r.getBestModel() is not None
+
+    def test_async_iterator_source_warns_approximate_cursor(self, tmp_path):
+        net = mlp()
+        it = AsyncDataSetIterator(iterator())
+        with pytest.warns(UserWarning, match="APPROXIMATE"):
+            net.fit(it, epochs=1,
+                    checkpoint=CheckpointConfig(str(tmp_path / "c")))
+        it.close()
+
+    def test_uninterrupted_equals_resumed(self, tmp_path):
+        d1, d2 = str(tmp_path / "a"), str(tmp_path / "b")
+        ra = self._trainer(mlp(), d1, 4, None).fit()
+        t1 = self._trainer(mlp(), d2, 2,
+                           CheckpointConfig(os.path.join(d2, "ck")))
+        t1.fit()
+        rb = self._trainer(mlp(), d2, 4,
+                           CheckpointConfig(os.path.join(d2, "ck"),
+                                            resume=True)).fit()
+        assert rb.best_epoch == ra.best_epoch
+        assert rb.best_score == pytest.approx(ra.best_score)
+        for e in ra.score_vs_epoch:
+            assert rb.score_vs_epoch[e] == pytest.approx(
+                ra.score_vs_epoch[e])
+
+
+# ================================================================ serializer
+class TestSerializerRobustness:
+    def test_write_model_atomic_no_tmp_left(self, tmp_path):
+        p = str(tmp_path / "m.zip")
+        net = mlp()
+        net.save(p)
+        assert zipfile.ZipFile(p).testzip() is None
+        assert [f for f in os.listdir(tmp_path)] == ["m.zip"]
+
+    def test_restore_truncated_zip_structured_error(self, tmp_path):
+        p = str(tmp_path / "m.zip")
+        net = mlp()
+        net.save(p)
+        with open(p, "r+b") as f:
+            f.truncate(os.path.getsize(p) // 2)
+        with pytest.raises(CorruptModelError):
+            ModelSerializer.restoreMultiLayerNetwork(p)
+
+    def test_restore_missing_entry_named(self, tmp_path):
+        p = str(tmp_path / "m.zip")
+        with zipfile.ZipFile(p, "w") as z:
+            z.writestr("conf.json", "{}")
+            z.writestr("meta.json", "{}")
+        with pytest.raises(CorruptModelError, match="arrays.npz"):
+            ModelSerializer.restoreMultiLayerNetwork(p)
+
+    def test_restore_crc_damage_named(self, tmp_path):
+        p = str(tmp_path / "m.zip")
+        net = mlp()
+        net.save(p)
+        size = os.path.getsize(p)
+        with open(p, "r+b") as f:
+            f.seek(size // 2)
+            chunk = f.read(32)
+            f.seek(size // 2)
+            f.write(bytes(b ^ 0xFF for b in chunk))
+        with pytest.raises(CorruptModelError):
+            ModelSerializer.restoreMultiLayerNetwork(p)
+
+    def test_graph_load_corrupt_structured_error(self, tmp_path):
+        p = str(tmp_path / "g.zip")
+        g = graph_net()
+        g.save(p)
+        with open(p, "r+b") as f:
+            f.truncate(os.path.getsize(p) // 3)
+        with pytest.raises(CorruptModelError):
+            ComputationGraph.load(p)
+
+    def test_normalizer_atomic_and_structured_error(self, tmp_path):
+        p = str(tmp_path / "n.npz")
+        norm = NormalizerStandardize()
+        norm.fit(dataset())
+        ModelSerializer.writeNormalizer(norm, p)
+        back = ModelSerializer.restoreNormalizer(p)
+        np.testing.assert_array_equal(back.mean, norm.mean)
+        with open(p, "wb") as f:
+            f.write(b"not an npz")
+        with pytest.raises(CorruptModelError):
+            ModelSerializer.restoreNormalizer(p)
+
+
+# ======================================================== sharded checkpoint
+class TestShardedChecksums:
+    def _tree(self):
+        rng = np.random.RandomState(0)
+        return {"w": jax.numpy.asarray(rng.randn(8, 4).astype(np.float32)),
+                "b": jax.numpy.asarray(rng.randn(4).astype(np.float32))}
+
+    def test_round_trip_with_checksums(self, tmp_path):
+        from deeplearning4j_tpu.parallel.checkpoint import (load_sharded,
+                                                            save_sharded)
+        d = str(tmp_path / "s")
+        tree = self._tree()
+        save_sharded(d, tree, step=3)
+        with open(os.path.join(d, "manifest.json")) as f:
+            manifest = json.load(f)
+        for entry in manifest["leaves"].values():
+            for v in entry["shards"].values():
+                assert len(v["sha256"]) == 64
+        out, step = load_sharded(d, tree)
+        assert step == 3
+        assert leaves_equal(out, tree)
+
+    def test_corrupt_shard_rejected(self, tmp_path):
+        from deeplearning4j_tpu.parallel.checkpoint import (load_sharded,
+                                                            save_sharded)
+        d = str(tmp_path / "s")
+        tree = self._tree()
+        save_sharded(d, tree, step=1)
+        # rewrite the shard file with different data: checksums mismatch
+        shard = os.path.join(d, "shards_p0.npz")
+        data = dict(np.load(shard))
+        data = {k: v + 1 for k, v in data.items()}
+        np.savez(shard, **data)
+        with pytest.raises(CorruptCheckpointError, match="checksum mismatch"):
+            load_sharded(d, tree)
+
+    def test_newer_sub_manifest_step_rejected(self, tmp_path):
+        from deeplearning4j_tpu.parallel.checkpoint import (load_sharded,
+                                                            save_sharded)
+        d = str(tmp_path / "s")
+        tree = self._tree()
+        save_sharded(d, tree, step=5)
+        with open(os.path.join(d, "manifest_p0.json"), "w") as f:
+            json.dump({"step": 7, "leaves": {}}, f)
+        with pytest.raises(CorruptCheckpointError, match="step 7"):
+            load_sharded(d, tree)
+
+    def test_older_stale_sub_manifest_ignored(self, tmp_path):
+        # leftovers from an earlier save with a larger process count must
+        # not make a complete, checksum-clean checkpoint unloadable
+        from deeplearning4j_tpu.parallel.checkpoint import (load_sharded,
+                                                            save_sharded)
+        d = str(tmp_path / "s")
+        tree = self._tree()
+        save_sharded(d, tree, step=10)
+        with open(os.path.join(d, "manifest_p3.json"), "w") as f:
+            json.dump({"step": 4, "leaves": {}}, f)
+        out, step = load_sharded(d, tree)
+        assert step == 10 and leaves_equal(out, tree)
+
+    def test_single_process_save_cleans_stale_sub_manifests(self, tmp_path):
+        from deeplearning4j_tpu.parallel.checkpoint import save_sharded
+        d = str(tmp_path / "s")
+        os.makedirs(d)
+        with open(os.path.join(d, "manifest_p5.json"), "w") as f:
+            json.dump({"step": 1, "leaves": {}}, f)
+        save_sharded(d, self._tree(), step=2)
+        assert not os.path.exists(os.path.join(d, "manifest_p5.json"))
+
+    def test_legacy_manifest_without_checksums_loads(self, tmp_path):
+        from deeplearning4j_tpu.parallel.checkpoint import (load_sharded,
+                                                            save_sharded)
+        d = str(tmp_path / "s")
+        tree = self._tree()
+        save_sharded(d, tree, step=2)
+        man = os.path.join(d, "manifest.json")
+        with open(man) as f:
+            manifest = json.load(f)
+        for entry in manifest["leaves"].values():     # downgrade format
+            entry["shards"] = {k: v["file"]
+                               for k, v in entry["shards"].items()}
+        with open(man, "w") as f:
+            json.dump(manifest, f)
+        out, _ = load_sharded(d, tree)
+        assert leaves_equal(out, tree)
+
+
+# ==================================================================== cursor
+class TestIteratorCursor:
+    def test_list_iterator_cursor_seek(self):
+        it = iterator()
+        for _ in range(3):
+            it.next()
+        c = it.cursor()
+        first = it.next()
+        it2 = iterator()
+        it2.seek(c)
+        np.testing.assert_array_equal(it2.next().features, first.features)
+
+    def test_shuffled_cursor_rebuilds_order(self):
+        it = iterator(shuffle=True)
+        for _ in range(4):
+            it.next()
+        c = it.cursor()
+        rest = [it.next().features for _ in range(3)]
+        it2 = iterator(shuffle=True)
+        it2.seek(c)
+        for want in rest:
+            np.testing.assert_array_equal(it2.next().features, want)
+
+    def test_base_iterator_defaults(self):
+        from deeplearning4j_tpu.data.dataset import DataSetIterator
+        it = DataSetIterator()
+        assert it.cursor() is None
+        with pytest.raises(NotImplementedError):
+            it.seek({"pos": 0})
+
+
+# ============================================================ parallel wrapper
+class TestParallelWrapperResilience:
+    """Data-parallel fit over the 8-device virtual mesh: resume restores
+    BEFORE replication, so the restored params distribute like fresh
+    ones and the resumed run stays bit-exact."""
+
+    def _iter(self):
+        return ListDataSetIterator(dataset(n=80, seed=5), batch_size=8)
+
+    def test_wrapper_resume_bit_exact(self, tmp_path):
+        from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+        d = str(tmp_path / "c")
+        a = mlp()
+        ParallelWrapper(a).fit(self._iter(), epochs=1)
+        pre = mlp()
+        ParallelWrapper(pre).fit(self._iter(), epochs=1,
+                                 checkpoint=CheckpointConfig(d, every_steps=2),
+                                 faults=FaultPlan(preempt_at_step=6))
+        assert pre._preempted and pre._iteration == 6
+        res = mlp()
+        ParallelWrapper(res).fit(self._iter(), epochs=1,
+                                 checkpoint=CheckpointConfig(d, resume=True))
+        assert res._iteration == 10
+        assert np.array_equal(np.asarray(a.params()), np.asarray(res.params()))
+
+    def test_wrapper_megastep_resume_bit_exact(self, tmp_path):
+        from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+        d = str(tmp_path / "c")
+        a = mlp()
+        ParallelWrapper(a).fit(self._iter(), epochs=1, steps_per_dispatch=2)
+        pre = mlp()
+        ParallelWrapper(pre).fit(self._iter(), epochs=1, steps_per_dispatch=2,
+                                 checkpoint=CheckpointConfig(d, every_steps=2),
+                                 faults=FaultPlan(preempt_at_step=6))
+        res = mlp()
+        ParallelWrapper(res).fit(self._iter(), epochs=1, steps_per_dispatch=2,
+                                 checkpoint=CheckpointConfig(d, resume=True))
+        assert res._iteration == 10
+        assert np.array_equal(np.asarray(a.params()), np.asarray(res.params()))
+
+
+# ===================================================================== chaos
+@pytest.mark.chaos
+class TestChaosSweep:
+    """Seeded FaultPlan sweep: whatever combination of NaN batches, flaky
+    pulls, and checkpoint corruption a seed draws, a SKIP_STEP +
+    checkpointed fit must finish all steps with finite params."""
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_seeded_sweep(self, seed, tmp_path):
+        plan = FaultPlan.seeded(seed, horizon=NBATCH, n_nan=1,
+                                n_data_errors=1)
+        net = mlp()
+        net.fit(iterator(), epochs=1,
+                checkpoint=CheckpointConfig(str(tmp_path / "c"),
+                                            every_steps=3, io_backoff=0.01),
+                nan_policy=NanPolicy.SKIP_STEP, faults=plan)
+        assert net._iteration == NBATCH
+        assert np.isfinite(np.asarray(net.params())).all()
+
+    @pytest.mark.parametrize("seed", range(2))
+    def test_seeded_preemption_resume(self, seed, tmp_path):
+        plan = FaultPlan.seeded(seed, horizon=NBATCH - 2, n_nan=0,
+                                n_data_errors=1, preempt=True)
+        d = str(tmp_path / "c")
+        pre = mlp()
+        pre.fit(iterator(), epochs=1,
+                checkpoint=CheckpointConfig(d, every_steps=2),
+                nan_policy=NanPolicy.SKIP_STEP, faults=plan)
+        assert pre._preempted
+        res = mlp()
+        res.fit(iterator(), epochs=1,
+                checkpoint=CheckpointConfig(d, resume=True))
+        assert res._iteration == NBATCH
+        assert np.isfinite(np.asarray(res.params())).all()
