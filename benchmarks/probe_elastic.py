@@ -1,0 +1,144 @@
+"""Probe: elastic-layer costs — async vs sync checkpointing, and
+time-to-recover from a device loss.
+
+Two acceptance numbers for ISSUE 6:
+
+(a) **Async checkpoint overhead.** PR 5's synchronous writes cost 1.8%
+    at ``every_steps=200``; the async writer must make ``every_steps=50``
+    cost LESS than that — 4x the checkpoint frequency for less fit-time
+    than the old sync path, because serialization/fsync run on the
+    background writer while the fit dispatches.
+(b) **Time-to-recover.** An 8-device elastic fit loses half its devices
+    at a fixed step; recovery time (resume barrier + coordinated
+    checkpoint + mesh rebuild + restore) is read from the
+    ``dl4j_elastic_recovery_seconds`` histogram.
+
+Prints ONE JSON line::
+
+  {"probe": "elastic", "iters": ...,
+   "baseline_sec_per_iter": ...,
+   "sync_every_200": {"sec_per_iter": ..., "overhead_ratio": ...},
+   "sync_every_50":  {...}, "async_every_50": {...},
+   "async_beats_sync200": true,
+   "recovery": {"devices": "8->4", "recover_seconds": ...,
+                "fit_seconds": ...}}
+
+``overhead_ratio`` = mode/baseline - 1. Absolute numbers are CPU-backend
+step times; the regression signals are the ratios and the recovery time.
+
+Run: python benchmarks/probe_elastic.py [--iters N]
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def build():
+    from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.train import updaters
+    conf = (NeuralNetConfiguration.Builder().seed(42)
+            .updater(updaters.Adam(0.01)).list()
+            .layer(DenseLayer(nOut=64, activation="relu"))
+            .layer(DenseLayer(nOut=64, activation="relu"))
+            .layer(OutputLayer(nOut=10, lossFunction="mcxent",
+                               activation="softmax"))
+            .setInputType(InputType.feedForward(32))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def batches(n, batch=32, nin=32, nout=10, seed=0):
+    from deeplearning4j_tpu.data.dataset import DataSet, ListDataSetIterator
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n * batch, nin).astype(np.float32)
+    y = np.eye(nout, dtype=np.float32)[rng.randint(0, nout, n * batch)]
+    return ListDataSetIterator(DataSet(x, y), batch_size=batch)
+
+
+def run_mode(iters: int, every_steps: int, warmup: int,
+             async_write: bool) -> float:
+    from deeplearning4j_tpu.train.resilience import CheckpointConfig
+    net = build()
+    net.fit(batches(warmup, seed=1), epochs=1)      # compile + warm caches
+    it = batches(iters)
+    with tempfile.TemporaryDirectory() as d:
+        cfg = CheckpointConfig(d, every_steps=every_steps, keep_last=2,
+                               async_write=async_write)
+        net.score()                                 # sync before the clock
+        t0 = time.perf_counter()
+        net.fit(it, epochs=1, checkpoint=cfg)
+        net.score()
+        return (time.perf_counter() - t0) / iters
+
+
+def run_recovery():
+    """8-device elastic fit, 4 devices die at step 10 of 40; recovery
+    wall time comes from the dl4j_elastic_recovery_seconds histogram."""
+    import jax
+    from deeplearning4j_tpu.faults import FaultPlan
+    from deeplearning4j_tpu.parallel import ElasticConfig, ParallelWrapper
+    from deeplearning4j_tpu.parallel.elastic import RECOVERY_SECONDS
+    from deeplearning4j_tpu.train.resilience import CheckpointConfig
+    assert len(jax.devices()) == 8
+    net = build()
+    plan = FaultPlan(device_loss_at_step=10, lose_devices=[4, 5, 6, 7])
+    before_sum, before_n = RECOVERY_SECONDS.sum, RECOVERY_SECONDS.count
+    with tempfile.TemporaryDirectory() as d:
+        t0 = time.perf_counter()
+        ParallelWrapper(net).fit(
+            batches(40), epochs=1, checkpoint=CheckpointConfig(d),
+            elastic=ElasticConfig(), faults=plan)
+        fit_seconds = time.perf_counter() - t0
+    assert RECOVERY_SECONDS.count == before_n + 1
+    return {"devices": "8->4",
+            "recover_seconds": round(RECOVERY_SECONDS.sum - before_sum, 4),
+            "fit_seconds": round(fit_seconds, 3)}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=600,
+                    help="measured training steps per checkpoint mode")
+    ap.add_argument("--warmup", type=int, default=20)
+    ap.add_argument("--repeats", type=int, default=3,
+                    help="runs per mode; best-of is reported (CPU-backend "
+                         "step times are noisy at the ms scale)")
+    args = ap.parse_args()
+
+    def best(every, is_async):
+        return min(run_mode(args.iters, every, args.warmup, is_async)
+                   for _ in range(max(args.repeats, 1)))
+
+    base = best(0, False)
+    out = {"probe": "elastic", "iters": args.iters,
+           "baseline_sec_per_iter": round(base, 6)}
+    for label, every, is_async in (("sync_every_200", 200, False),
+                                   ("sync_every_50", 50, False),
+                                   ("async_every_50", 50, True)):
+        t = best(every, is_async)
+        out[label] = {"sec_per_iter": round(t, 6),
+                      "overhead_ratio": round(t / base - 1.0, 4)}
+    out["async_beats_sync200"] = (
+        out["async_every_50"]["overhead_ratio"]
+        < out["sync_every_200"]["overhead_ratio"])
+    out["recovery"] = run_recovery()
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

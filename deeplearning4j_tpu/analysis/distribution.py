@@ -123,10 +123,13 @@ class MeshSpec:
     partition-spec-tuple} (or a ShardingRule instance; entries may be an
     axis name, ``None``, or a tuple of axis names per dim). ``pipeline``:
     a :class:`PipelineSpec`. ``hbm_gb``: per-device parameter budget for
-    E104 (``None`` disables)."""
+    E104 (``None`` disables). ``devices``: the physical device count,
+    when known — declares the axes-product-vs-hardware consistency
+    check (E102), which the elastic shrink revalidation relies on."""
 
     def __init__(self, axes: Dict[str, int], data_axis: str = "data",
-                 sharding=None, pipeline=None, hbm_gb: float = DEFAULT_HBM_GB):
+                 sharding=None, pipeline=None, hbm_gb: float = DEFAULT_HBM_GB,
+                 devices: Optional[int] = None):
         self.axes = {str(k): int(v) for k, v in dict(axes).items()}
         for name, size in self.axes.items():
             if size < 1:
@@ -135,6 +138,12 @@ class MeshSpec:
         self.sharding = sharding
         self.pipeline = PipelineSpec.coerce(pipeline)
         self.hbm_gb = hbm_gb
+        # optional PHYSICAL device count: when declared (DeviceMesh.spec()
+        # does, and the elastic shrink revalidation does), _lint_axes
+        # checks the axes product against it (E102) — a mesh declaration
+        # that no longer matches the surviving hardware is exactly the
+        # misconfiguration an elastic resume must catch before replicating
+        self.devices = None if devices is None else int(devices)
 
     @staticmethod
     def parse(text: str) -> "MeshSpec":
@@ -410,6 +419,19 @@ def _lint_batch(mesh: MeshSpec, batch_size) -> List[Diagnostic]:
 
 def _lint_axes(mesh: MeshSpec) -> List[Diagnostic]:
     diags = []
+    if mesh.devices is not None:
+        product = 1
+        for n in mesh.axes.values():
+            product *= n
+        if product != mesh.devices:
+            diags.append(Diagnostic(
+                "DL4J-E102", Severity.ERROR, "mesh",
+                f"mesh axes {dict(mesh.axes)} multiply to {product} "
+                f"device(s) but {mesh.devices} are declared — the mesh "
+                f"cannot be built on this device set",
+                fix_hint="resize an axis so the product matches the "
+                         "physical device count (after an elastic shrink, "
+                         "the data axis must equal the survivor count)"))
     missing = []
     for _pat, spec in _normalize_rules(mesh.sharding):
         missing.extend(a for a in _spec_axes(spec) if a not in mesh.axes)

@@ -23,6 +23,18 @@ seedable*, behind the seams the real failures would hit:
 - **Synthetic preemption at step k** — a pluggable
   :class:`~deeplearning4j_tpu.train.resilience.PreemptionSignal` that
   fires once step k completes, standing in for SIGTERM.
+- **Device loss at step k** — from step k on, the planned device indices
+  read as DEAD to :class:`~deeplearning4j_tpu.parallel.elastic.
+  DeviceMonitor` probes (persistent, not one-shot: a dead chip stays
+  dead), driving the elastic mesh-shrink path end to end.
+- **Hung dispatch at step k** — the dispatch for step k stalls before
+  reaching the device: ``hang_seconds`` set stalls that long (a
+  straggler the watchdog's soft deadline must record), ``hang_seconds=
+  None`` stalls until :meth:`FaultPlan.release_hangs` (the watchdog's
+  hard deadline must fire and the dispatch reads as never-completed).
+- **Slow replica at step k** — a shorter stall (``slow_seconds``)
+  modelling one replica lagging the collective; the straggler
+  histogram, not the timeout path, must account for it.
 
 Every fault fires exactly once per planned step index (so a retried
 pull succeeds, like a real transient), and :meth:`FaultPlan.seeded`
@@ -37,6 +49,8 @@ apply order through the megabatch grouping and the prefetcher).
 from __future__ import annotations
 
 import os
+import threading
+import time
 from typing import Iterable, Optional, Set
 
 import numpy as np
@@ -68,7 +82,13 @@ class FaultPlan:
                  data_error_transient: bool = True,
                  checkpoint_write_fail_at: Iterable[int] = (),
                  checkpoint_corrupt_at: Iterable[int] = (),
-                 preempt_at_step: Optional[int] = None):
+                 preempt_at_step: Optional[int] = None,
+                 device_loss_at_step: Optional[int] = None,
+                 lose_devices: Iterable[int] = (),
+                 hung_dispatch_at: Iterable[int] = (),
+                 hang_seconds: Optional[float] = 0.2,
+                 slow_replica_at: Iterable[int] = (),
+                 slow_seconds: float = 0.1):
         self.seed = seed
         self.nan_grads_at = _as_step_set(nan_grads_at)
         self.data_error_at = _as_step_set(data_error_at)
@@ -76,31 +96,57 @@ class FaultPlan:
         self.checkpoint_write_fail_at = _as_step_set(checkpoint_write_fail_at)
         self.checkpoint_corrupt_at = _as_step_set(checkpoint_corrupt_at)
         self.preempt_at_step = preempt_at_step
+        self.device_loss_at_step = device_loss_at_step
+        self.lose_devices = frozenset(int(d) for d in lose_devices)
+        self.hung_dispatch_at = _as_step_set(hung_dispatch_at)
+        self.hang_seconds = hang_seconds
+        self.slow_replica_at = _as_step_set(slow_replica_at)
+        self.slow_seconds = float(slow_seconds)
         # consumed-state: each fault fires once
         self._nan_pending = set(self.nan_grads_at)
         self._data_pending = set(self.data_error_at)
         self._ckpt_fail_pending = set(self.checkpoint_write_fail_at)
         self._ckpt_corrupt_pending = set(self.checkpoint_corrupt_at)
+        self._hang_pending = set(self.hung_dispatch_at)
+        self._slow_pending = set(self.slow_replica_at)
+        self._hang_release = threading.Event()
         self._pull_index = 0
 
     @classmethod
     def seeded(cls, seed: int, horizon: int, n_nan: int = 1,
                n_data_errors: int = 1, preempt: bool = False,
-               corrupt_checkpoint: bool = False) -> "FaultPlan":
+               corrupt_checkpoint: bool = False, device_loss: int = 0,
+               device_pool: Iterable[int] = ()) -> "FaultPlan":
         """Derive a whole plan from one seed: fault steps are drawn
         without replacement from ``[2, horizon]`` (step 1 is left clean
         so every run performs at least one good update first). The chaos
-        sweep (``pytest -m chaos``) runs this across a seed range."""
+        sweep (``pytest -m chaos``) runs this across a seed range.
+        ``device_loss=n`` additionally kills n devices drawn from
+        ``device_pool`` at a drawn step (elastic-shrink sweeps)."""
         rng = np.random.RandomState(seed)
-        n_faults = n_nan + n_data_errors + (1 if preempt else 0)
+        n_faults = n_nan + n_data_errors + (1 if preempt else 0) \
+            + (1 if device_loss else 0)
         lo = 2
         pool = rng.permutation(np.arange(lo, max(horizon + 1, lo + n_faults)))
         picks = [int(p) for p in pool[:n_faults]]
         nan_at = picks[:n_nan]
         data_at = picks[n_nan:n_nan + n_data_errors]
-        preempt_at = picks[-1] if preempt else None
+        pos = n_nan + n_data_errors
+        loss_at, lose = None, ()
+        if device_loss:
+            loss_at = picks[pos]
+            pos += 1
+            ids = sorted(int(d) for d in device_pool)
+            if device_loss >= len(ids):
+                raise ValueError(
+                    f"device_loss={device_loss} would kill the whole "
+                    f"device_pool ({len(ids)} devices)")
+            lose = [ids[int(i)] for i in
+                    rng.choice(len(ids), size=device_loss, replace=False)]
+        preempt_at = picks[pos] if preempt else None
         return cls(seed=seed, nan_grads_at=nan_at, data_error_at=data_at,
                    preempt_at_step=preempt_at,
+                   device_loss_at_step=loss_at, lose_devices=lose,
                    checkpoint_corrupt_at=(
                        [int(rng.randint(lo, horizon + 1))]
                        if corrupt_checkpoint else ()))
@@ -162,6 +208,41 @@ class FaultPlan:
             f.write(bytes(b ^ 0xFF for b in chunk))
         return True
 
+    # --------------------------------------------------------- device seams
+    def dead_devices(self, step: Optional[int] = None) -> Set[int]:
+        """Device indices reading as DEAD at update step ``step`` —
+        persistent from ``device_loss_at_step`` on (a lost chip stays
+        lost). ``step=None`` asks "as of now" (inference-side probes):
+        the loss applies whenever one is planned at all."""
+        if self.device_loss_at_step is None:
+            return set()
+        if step is not None and step < self.device_loss_at_step:
+            return set()
+        return set(self.lose_devices)
+
+    def dispatch_hold(self, step: int) -> bool:
+        """Called (in the dispatch thread) as update step ``step`` is
+        about to dispatch: stalls for the planned hang/straggler delay.
+        Returns False when the dispatch must be SKIPPED — a hard hang
+        (``hang_seconds=None``) aborted by :meth:`release_hangs`, i.e.
+        a dispatch that never completed."""
+        if step in self._slow_pending:
+            self._slow_pending.discard(step)
+            time.sleep(self.slow_seconds)
+        if step in self._hang_pending:
+            self._hang_pending.discard(step)
+            if self.hang_seconds is None:
+                self._hang_release.wait()
+                return False
+            time.sleep(self.hang_seconds)
+        return True
+
+    def release_hangs(self):
+        """Unblock any hard-hung dispatch (``hang_seconds=None``): the
+        holder returns WITHOUT dispatching, modelling a dispatch the
+        watchdog abandoned that never reaches the device."""
+        self._hang_release.set()
+
     # ------------------------------------------------------ preemption seam
     def preemption_signal(self):
         """A StepPreemption for the planned synthetic preemption, or
@@ -177,7 +258,11 @@ class FaultPlan:
                 f"{' transient' if self.data_error_transient else ' permanent'}, "
                 f"ckpt_fail={sorted(self.checkpoint_write_fail_at)}, "
                 f"ckpt_corrupt={sorted(self.checkpoint_corrupt_at)}, "
-                f"preempt={self.preempt_at_step})")
+                f"preempt={self.preempt_at_step}, "
+                f"device_loss={self.device_loss_at_step}:"
+                f"{sorted(self.lose_devices)}, "
+                f"hung={sorted(self.hung_dispatch_at)}, "
+                f"slow={sorted(self.slow_replica_at)})")
 
 
 def _poison(ds):

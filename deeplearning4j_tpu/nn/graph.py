@@ -682,6 +682,9 @@ class ComputationGraph:
             self._train_step_cache[sig] = self._make_train_step(sig)
         step = self._train_step_cache[sig]
         dummy = [jnp.zeros((1,))] * len(labels)
+        # fence read at dispatch ENTRY: any elastic recovery landing after
+        # this point voids the whole dispatch, hooks included
+        gen = _stepping.fence_generation(self)
         res = getattr(self, "_resilience", None)
         if res is not None:
             res.before_step()
@@ -699,10 +702,14 @@ class ComputationGraph:
                 "train:step", "dl4j_train_step_seconds",
                 "Compiled train-step dispatch time per iteration",
                 iteration=self._iteration + 1):
-            self._params, self._states, self._opt_state, self._t_dev, loss = \
-                step(self._params, self._states, self._opt_state,
-                     self._ensure_clock(), ins, labels,
-                     lmasks if lmasks is not None else dummy)
+            out = step(self._params, self._states, self._opt_state,
+                       self._ensure_clock(), ins, labels,
+                       lmasks if lmasks is not None else dummy)
+        with _stepping.dispatch_commit(self, gen) as ok:
+            if not ok:      # elastic recovery rolled this step back while
+                return      # the dispatch was hung: discard, no bookkeeping
+            self._params, self._states, self._opt_state, self._t_dev, loss \
+                = out
         # on-device; score() converts lazily (per-step host sync is ~20x the
         # step cost through a high-latency device link)
         self._score = loss
@@ -741,6 +748,7 @@ class ComputationGraph:
         if (sig, k) not in self._megastep_cache:
             self._megastep_cache[(sig, k)] = self._make_train_step(sig, steps=k)
         step = self._megastep_cache[(sig, k)]
+        gen = _stepping.fence_generation(self)  # dispatch entry (see _fit_one)
         res = getattr(self, "_resilience", None)
         if res is not None:
             res.before_dispatch()
@@ -751,10 +759,14 @@ class ComputationGraph:
                 "train:megastep", "dl4j_train_step_seconds",
                 "Compiled train-step dispatch time per iteration",
                 iteration=self._iteration + 1, steps=k):
-            self._params, self._states, self._opt_state, self._t_dev, losses = \
-                step(self._params, self._states, self._opt_state,
-                     self._ensure_clock(), ins, labels,
-                     lmasks if lmasks is not None else dummy)
+            out = step(self._params, self._states, self._opt_state,
+                       self._ensure_clock(), ins, labels,
+                       lmasks if lmasks is not None else dummy)
+        with _stepping.dispatch_commit(self, gen) as ok:
+            if not ok:
+                return      # abandoned dispatch: see dispatch_commit
+            self._params, self._states, self._opt_state, self._t_dev, \
+                losses = out
         _stepping.record_megastep(self, losses, k,
                                   int(next(iter(ins.values())).shape[1]))
 

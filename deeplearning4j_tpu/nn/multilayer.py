@@ -363,6 +363,10 @@ class MultiLayerNetwork:
         per batch (pinned by an equivalence test). The TBPTT path keeps
         its segment-level dispatch — ``steps_per_dispatch`` does not
         apply to it (megastep x TBPTT composition is a ROADMAP item).
+        Checkpoint/resume and NaN policies DO compose with TBPTT:
+        segment steps count as update steps, recovery and checkpoints
+        act at batch boundaries (where no RNN segment state is carried),
+        and resume is bit-exact.
 
         Fault tolerance (``train.resilience``): ``checkpoint=
         CheckpointConfig(dir, every_steps=..., resume=True)`` gives the
@@ -383,11 +387,6 @@ class MultiLayerNetwork:
         if checkpoint is not None or nan_policy is not None \
                 or faults is not None:
             from deeplearning4j_tpu.train import resilience as _resilience
-            if tbptt_len is not None:
-                raise NotImplementedError(
-                    "checkpoint/nan_policy/faults are not supported with a "
-                    "TBPTT-configured fit yet (segment-level accounting is a "
-                    "ROADMAP follow-up)")
             session, data = _resilience.begin_session(
                 self, data, checkpoint, nan_policy, faults)
 
@@ -416,7 +415,7 @@ class MultiLayerNetwork:
                     # batch from the (possibly async) iterator is the input
                     # pipeline's bill, not the device's
                     if tbptt_len is not None:
-                        for ds in _prof.iter_with_data_wait(batches()):
+                        for ds in _prof.iter_with_data_wait(epoch_stream()):
                             if ds.features.ndim == 3:
                                 self.fitTBPTT(ds, tbptt_len)
                             else:        # non-sequence batch: nothing to
@@ -454,6 +453,9 @@ class MultiLayerNetwork:
             self._train_step_cache[sig] = self._make_train_step(*sig)
         step = self._train_step_cache[sig]
         dummy = jnp.zeros((1,))
+        # fence read at dispatch ENTRY: any elastic recovery landing after
+        # this point voids the whole dispatch, hooks included
+        gen = _stepping.fence_generation(self)
         res = getattr(self, "_resilience", None)
         if res is not None:
             res.before_step()
@@ -476,11 +478,15 @@ class MultiLayerNetwork:
                 "train:step", "dl4j_train_step_seconds",
                 "Compiled train-step dispatch time per iteration",
                 iteration=self._iteration + 1):
-            self._params, self._states, self._opt_state, self._t_dev, loss = \
-                step(self._params, self._states, self._opt_state,
-                     self._ensure_clock(), x, y,
-                     fmask if fmask is not None else dummy,
-                     lmask if lmask is not None else dummy)
+            out = step(self._params, self._states, self._opt_state,
+                       self._ensure_clock(), x, y,
+                       fmask if fmask is not None else dummy,
+                       lmask if lmask is not None else dummy)
+        with _stepping.dispatch_commit(self, gen) as ok:
+            if not ok:      # elastic recovery rolled this step back while
+                return      # the dispatch was hung: discard, no bookkeeping
+            self._params, self._states, self._opt_state, self._t_dev, loss \
+                = out
         # keep the loss on-device: a float() here would block on the whole
         # step through the (high-latency) host<->device link every iteration;
         # score() converts lazily when someone actually asks
@@ -515,6 +521,7 @@ class MultiLayerNetwork:
         if (sig, k) not in self._megastep_cache:
             self._megastep_cache[(sig, k)] = self._make_train_step(*sig, steps=k)
         step = self._megastep_cache[(sig, k)]
+        gen = _stepping.fence_generation(self)  # dispatch entry (see _fit_one)
         res = getattr(self, "_resilience", None)
         if res is not None:
             res.before_dispatch()
@@ -525,11 +532,15 @@ class MultiLayerNetwork:
                 "train:megastep", "dl4j_train_step_seconds",
                 "Compiled train-step dispatch time per iteration",
                 iteration=self._iteration + 1, steps=k):
-            self._params, self._states, self._opt_state, self._t_dev, losses = \
-                step(self._params, self._states, self._opt_state,
-                     self._ensure_clock(), x, y,
-                     fmask if fmask is not None else dummy,
-                     lmask if lmask is not None else dummy)
+            out = step(self._params, self._states, self._opt_state,
+                       self._ensure_clock(), x, y,
+                       fmask if fmask is not None else dummy,
+                       lmask if lmask is not None else dummy)
+        with _stepping.dispatch_commit(self, gen) as ok:
+            if not ok:
+                return      # abandoned dispatch: see dispatch_commit
+            self._params, self._states, self._opt_state, self._t_dev, \
+                losses = out
         _stepping.record_megastep(self, losses, k, int(x.shape[1]))
 
     # ----------------------------------------------------------------- score
@@ -692,9 +703,21 @@ class MultiLayerNetwork:
     def fitTBPTT(self, ds: DataSet, tbptt_length: int):
         """Truncated BPTT (ref: BackpropType.TruncatedBPTT + tBPTTLength):
         the sequence is split into segments; RNN state carries across
-        segments (detached), gradients stop at segment boundaries."""
+        segments (detached), gradients stop at segment boundaries.
+
+        Resilience (ISSUE 6 carried follow-up): one BATCH is the
+        recovery unit — ``ceil(T/L)`` segment update steps dispatch as a
+        group, then the session hooks see all segment losses at once
+        (segment-level step accounting, batch-level cursor accounting:
+        ``pulls=1``). Checkpoints therefore land on batch boundaries,
+        where the carried RNN segment state is empty, which is what
+        makes a TBPTT resume bit-exact."""
         T = ds.features.shape[2]
+        res = getattr(self, "_resilience", None)
+        if res is not None:
+            res.before_dispatch()
         seg_states = [None] * len(self.layers)
+        losses = []
         for start in range(0, T, tbptt_length):
             sl = slice(start, start + tbptt_length)
             feats = ds.features[:, :, sl]
@@ -703,6 +726,10 @@ class MultiLayerNetwork:
             lmask = ds.labels_mask[:, sl] if ds.labels_mask is not None else None
             seg_states = self._fit_one_tbptt(
                 DataSet(feats, labels, fmask, lmask), seg_states)
+            losses.append(self._score)
+        if res is not None:
+            res.after_dispatch(jnp.stack([jnp.asarray(l) for l in losses]),
+                               len(losses), pulls=1)
         return self
 
     def _make_tbptt_step(self, with_lmask: bool):

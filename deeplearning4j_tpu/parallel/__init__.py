@@ -15,9 +15,20 @@ from deeplearning4j_tpu.parallel.init import (  # noqa: F401
     initializeDistributed,
     shutdownDistributed,
 )
+from deeplearning4j_tpu.parallel.elastic import (  # noqa: F401
+    CoordinationService,
+    DeviceLossError,
+    DeviceMonitor,
+    DispatchTimeoutError,
+    DispatchWatchdog,
+    ElasticConfig,
+    ElasticShrinkError,
+    InProcessCoordinator,
+)
 from deeplearning4j_tpu.parallel.mesh import DeviceMesh, ShardingRule  # noqa: F401
 from deeplearning4j_tpu.parallel.sequence import ring_attention  # noqa: F401
 from deeplearning4j_tpu.parallel.wrapper import (  # noqa: F401
+    InferenceFailedError,
     InferenceObservable,
     ParallelInference,
     ParallelWrapper,

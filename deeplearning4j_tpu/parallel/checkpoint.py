@@ -9,7 +9,10 @@ tree structure, global shapes, and which file holds which shard index.
 Loading is the mirror: each process reads only the shards its target
 sharding makes addressable and assembles them with
 ``jax.make_array_from_single_device_arrays`` — no gather, no full-array
-host materialization on any single host.
+host materialization on any single host. The target sharding need NOT
+match the saved one: a device slice with no exact saved shard is
+stitched from the shards that cover it (elastic mesh shrink/grow
+resumes a checkpoint written under the old topology).
 
 Layout on disk::
 
@@ -112,6 +115,54 @@ def save_sharded(directory: str, tree, step: int = 0):
 
 def _shard_digest(data: np.ndarray) -> str:
     return hashlib.sha256(np.ascontiguousarray(data).tobytes()).hexdigest()
+
+
+def _parse_key(key: str) -> Tuple[Tuple[int, int], ...]:
+    """Inverse of :func:`_index_key`: ``"0:4;0:8"`` -> ((0, 4), (0, 8))."""
+    return tuple(tuple(int(x) for x in part.split(":"))
+                 for part in key.split(";"))
+
+
+def _assemble_slice(name: str, entry: Dict[str, Any],
+                    index: Tuple[slice, ...], shape: Tuple[int, ...],
+                    shard_data) -> np.ndarray:
+    """Stitch the requested global slice from whatever shards the
+    checkpoint holds — the RESHARD path: a checkpoint saved under one
+    mesh layout loads under another (elastic shrink: 8-way batch shards
+    reassemble into 4 wider ones; grow: wide shards slice down). Raises
+    FileNotFoundError when the saved shards don't cover the request."""
+    want = tuple((s.start or 0, s.stop if s.stop is not None else dim)
+                 for s, dim in zip(index, shape))
+    out = np.empty(tuple(hi - lo for lo, hi in want),
+                   dtype=np.dtype(entry["dtype"]))
+    covered = 0
+    for key in entry["shards"]:
+        have = _parse_key(key)
+        inter = tuple((max(wl, hl), min(wh, hh))
+                      for (wl, wh), (hl, hh) in zip(want, have))
+        if any(lo >= hi for lo, hi in inter):
+            continue
+        src = shard_data(name, key)
+        src_idx = tuple(slice(lo - hl, hi - hl)
+                        for (lo, hi), (hl, _hh) in zip(inter, have))
+        dst_idx = tuple(slice(lo - wl, hi - wl)
+                        for (lo, hi), (wl, _wh) in zip(inter, want))
+        out[dst_idx] = src[src_idx]
+        vol = 1
+        for lo, hi in inter:
+            vol *= hi - lo
+        covered += vol
+    total = 1
+    for lo, hi in want:
+        total *= hi - lo
+    if covered != total:
+        # shards are disjoint boxes, so covered volume == requested volume
+        # iff the request is fully tiled
+        raise FileNotFoundError(
+            f"checkpoint shards for {name} cover only {covered}/{total} "
+            f"elements of requested slice {want} (saved under an "
+            f"incompatible sharding/topology)")
+    return out
 
 
 def _shard_entry(entry_shards: Dict[str, Any], key: str):
@@ -275,11 +326,14 @@ def load_sharded(directory: str, target_tree, mesh=None, specs=None):
         index_map = sharding.addressable_devices_indices_map(shape)
         for device, index in index_map.items():
             key = _index_key(index, shape)
-            if key not in entry["shards"]:
-                raise FileNotFoundError(
-                    f"checkpoint {directory} has no shard {key} of {name} "
-                    f"(saved with a different sharding/topology?)")
-            dev_arrays.append(jax.device_put(shard_data(name, key), device))
+            if key in entry["shards"]:
+                data = shard_data(name, key)
+            else:
+                # mesh layout changed since the save (elastic shrink/
+                # grow): stitch this device's slice from the saved shards
+                data = _assemble_slice(name, entry, index, shape,
+                                       shard_data)
+            dev_arrays.append(jax.device_put(data, device))
             devices.append(device)
         arr = jax.make_array_from_single_device_arrays(shape, sharding,
                                                        dev_arrays)

@@ -82,6 +82,21 @@ class ShardedDataSetIterator(DataSetIterator):
         b = self.base.batch()
         return None if b is None else b // self.process_count
 
+    # -- checkpoint/resume cursor protocol (train.resilience) --
+    def cursor(self):
+        """Base cursor — but None while a batch sits buffered by
+        ``hasNext()``'s look-ahead (the base has advanced past a batch
+        this rank hasn't served; a cursor taken then would skip it on
+        resume). The resilience layer records cursors right after
+        ``next()``, where nothing is buffered."""
+        if self._pending is not None:
+            return None
+        return self.base.cursor()
+
+    def seek(self, cursor) -> None:
+        self._pending = None
+        self.base.seek(cursor)
+
 
 def make_global_view(local_array, mesh: Mesh, spec: P = None):
     """Assemble each process's local batch slice into one global jax.Array

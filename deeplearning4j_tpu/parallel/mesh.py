@@ -55,12 +55,20 @@ class DeviceMesh:
     def axis_names(self):
         return self.mesh.axis_names
 
+    @property
+    def devices(self) -> list:
+        """Flat list of the mesh's jax devices (axis-major order) — the
+        set the elastic layer health-probes and shrinks from."""
+        return list(np.asarray(self.mesh.devices).flat)
+
     def spec(self, **kw) -> "Any":
         """Jax-free declaration of this mesh for the static distribution
         analyzer (:class:`analysis.distribution.MeshSpec`) — pass it (or
         this DeviceMesh directly) to ``model.validate(mesh=...)``.
         Keywords forward to MeshSpec (``sharding=``, ``pipeline=``,
-        ``hbm_gb=``)."""
+        ``hbm_gb=``). The physical device count is declared so the
+        axes-vs-devices consistency lint (E102) can fire."""
+        kw.setdefault("devices", self.size())
         from deeplearning4j_tpu.analysis.distribution import MeshSpec
         return MeshSpec(dict(self.mesh.shape), **kw)
 

@@ -54,7 +54,7 @@ class ParallelWrapper:
 
     def fit(self, iterator: DataSetIterator, epochs: int = 1,
             steps_per_dispatch: int = 1, checkpoint=None, nan_policy=None,
-            faults=None):
+            faults=None, elastic=None):
         """``steps_per_dispatch=K`` composes the data-parallel path with
         the K-step lax.scan megastep: each megabatch is staged as
         ``[K, B, ...]`` arrays batch-sharded over the mesh's ``data`` axis
@@ -68,7 +68,24 @@ class ParallelWrapper:
         the mesh like freshly initialized ones. With resilience active
         the K=1 AsyncDataSetIterator auto-wrap is skipped so checkpoint
         cursors stay exact (the async worker prefetches ahead of the
-        applied step)."""
+        applied step).
+
+        ``elastic=ElasticConfig(...)`` (or ``elastic=True`` for the
+        defaults) turns on elastic multi-device training
+        (parallel.elastic): device health probes between dispatches, a
+        dispatch watchdog, and on device loss a coordinated checkpoint +
+        mesh shrink onto the survivors + bit-exact resume. Requires
+        ``checkpoint=``; ``self.mesh`` reflects the shrunk mesh after a
+        recovery."""
+        if elastic is not None and elastic is not False:
+            from deeplearning4j_tpu.parallel import elastic as _elastic
+            cfg = elastic if isinstance(elastic, _elastic.ElasticConfig) \
+                else _elastic.ElasticConfig()
+            return _elastic.fit_elastic(
+                self, iterator, epochs=epochs,
+                steps_per_dispatch=steps_per_dispatch,
+                checkpoint=checkpoint, nan_policy=nan_policy, faults=faults,
+                config=cfg)
         model = self.model
         if not model._initialized:
             model.init()
@@ -214,6 +231,25 @@ class ParallelWrapper:
         return self
 
 
+_INFERENCE_REPLICA_FAILURES = _prof.get_registry().counter(
+    "dl4j_inference_replica_failures_total",
+    "Inference forwards that raised or exceeded replica_timeout (each "
+    "marks the serving replica set unhealthy and is retried on the "
+    "survivors up to max_retries)")
+
+
+class InferenceFailedError(RuntimeError):
+    """An inference batch failed every attempt. ``attempts`` counts the
+    forwards tried; ``last_error`` is the final failure."""
+
+    def __init__(self, attempts: int, last_error: BaseException):
+        self.attempts = attempts
+        self.last_error = last_error
+        super().__init__(
+            f"inference failed after {attempts} attempt(s); last error: "
+            f"{type(last_error).__name__}: {last_error}")
+
+
 class InferenceObservable:
     """Future-like handle for one inference request (ref: ObservablesProvider)."""
 
@@ -240,14 +276,35 @@ class InferenceObservable:
 class ParallelInference:
     """Batched inference server object (ref: ParallelInference,
     InferenceMode.BATCHED): queue requests, coalesce up to batchLimit,
-    run ONE sharded forward over the mesh, fan results back out."""
+    run ONE sharded forward over the mesh, fan results back out.
+
+    Robustness (ISSUE 6): a forward that raises — or exceeds
+    ``replica_timeout`` seconds — marks the replica set unhealthy: the
+    mesh devices are health-probed, dead ones dropped (the mesh
+    rebuilds on the survivors), and the SAME coalesced batch is retried
+    on the surviving replicas up to ``max_retries`` times
+    (``dl4j_inference_replica_failures_total`` counts the failures).
+    After exhaustion every request in the batch fails with a structured
+    :class:`InferenceFailedError` instead of a raw backend exception.
+    """
 
     def __init__(self, model, mesh: DeviceMesh = None, batch_limit: int = 32,
-                 queue_timeout_ms: float = 5.0):
+                 queue_timeout_ms: float = 5.0, max_retries: int = 2,
+                 replica_timeout: float = None, faults=None):
         self.model = model
         self.mesh = mesh or DeviceMesh.data_parallel()
         self.batch_limit = batch_limit
         self.timeout = queue_timeout_ms / 1000.0
+        self.max_retries = int(max_retries)
+        self.replica_timeout = replica_timeout
+        self._faults = faults
+        self._watchdog = None
+        if replica_timeout:
+            from deeplearning4j_tpu.parallel.elastic import DispatchWatchdog
+            # warmup: the first forwards compile; their wall time says
+            # nothing about replica health
+            self._watchdog = DispatchWatchdog(deadline=replica_timeout,
+                                              grace=replica_timeout)
         self._queue: "queue.Queue" = queue.Queue()
         self._shutdown = False
         self._worker = threading.Thread(target=self._serve, daemon=True)
@@ -291,8 +348,7 @@ class ParallelInference:
                     pad = np.zeros((bucket - total,) + feats.shape[1:],
                                    feats.dtype)
                     feats = np.concatenate([feats, pad], axis=0)
-                with self.mesh:
-                    out = np.asarray(self.model.output(feats))[:total]
+                out = self._forward(feats)[:total]
                 pos = 0
                 for (x, obs), n in zip(batch, sizes):
                     obs._complete(out[pos:pos + n])
@@ -300,6 +356,70 @@ class ParallelInference:
             except Exception as e:  # fail the requests, keep the server alive
                 for _, obs in batch:
                     obs._fail(e)
+
+    # ------------------------------------------------------- fault handling
+    def _forward_once(self, feats) -> np.ndarray:
+        with self.mesh:
+            return np.asarray(self.model.output(feats))
+
+    def _forward(self, feats) -> np.ndarray:
+        """One coalesced batch through the sharded forward, with bounded
+        retry on a surviving replica set after a failure or timeout."""
+        last = None
+        attempts = 0
+        for _ in range(self.max_retries + 1):
+            attempts += 1
+            try:
+                if self._watchdog is not None:
+                    return self._watchdog.run(
+                        lambda: self._forward_once(feats), attempts)
+                return self._forward_once(feats)
+            except Exception as e:
+                last = e
+                _INFERENCE_REPLICA_FAILURES.inc()
+                warnings.warn(
+                    f"inference replica failure (attempt {attempts}): "
+                    f"{type(e).__name__}: {e} — probing devices and "
+                    "retrying on the survivors", stacklevel=2)
+                self._drop_dead_replicas()
+        raise InferenceFailedError(attempts, last)
+
+    def _drop_dead_replicas(self):
+        """Health-probe the serving mesh; rebuild it on the survivors
+        when devices are dead (the retried forward then runs only on
+        replicas that still answer)."""
+        from deeplearning4j_tpu.parallel.elastic import (DEVICE_LOST,
+                                                         DeviceMonitor)
+        devices = self.mesh.devices
+        health = DeviceMonitor(plan=self._faults).probe(devices)
+        if not health.dead:
+            return
+        if self.mesh.size("model") * self.mesh.size("seq") > 1:
+            # a tensor/sequence-parallel mesh cannot drop devices — each
+            # holds an unreplicated shard; rebuilding it data-parallel
+            # would break the model's sharding (mirrors the training
+            # path's shrink guard)
+            warnings.warn(
+                f"inference: device(s) {sorted(health.dead)} are dead but "
+                "the serving mesh has model/seq axes — cannot shrink a "
+                "tensor-parallel mesh; retrying on the full mesh",
+                stacklevel=2)
+            return
+        surviving = [d for d in devices if d.id not in health.dead]
+        if not surviving:
+            warnings.warn("inference: every serving device is dead — "
+                          "keeping the mesh, the next retry will fail "
+                          "structurally", stacklevel=2)
+            return
+        DEVICE_LOST.inc(len(health.dead))
+        warnings.warn(
+            f"inference: dropping dead device(s) {sorted(health.dead)}; "
+            f"serving continues on {len(surviving)} replica(s)",
+            stacklevel=2)
+        self.mesh = DeviceMesh.create(data=len(surviving), model=1, seq=1,
+                                      devices=surviving)
+        if self._watchdog is not None:
+            self._watchdog.begin_attempt()  # the shrunk forward recompiles
 
     def shutdown(self):
         self._shutdown = True

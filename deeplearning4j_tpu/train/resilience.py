@@ -58,8 +58,10 @@ import hashlib
 import json
 import logging
 import os
+import queue as _queue
 import shutil
 import signal as _signal
+import sys
 import threading
 import time
 import warnings
@@ -104,6 +106,11 @@ QUARANTINED = _REG.counter(
 LR_BACKOFFS = _REG.counter(
     "dl4j_lr_backoffs_total",
     "Learning-rate halvings performed by NanPolicy.BACKOFF_LR")
+CKPT_ASYNC_QUEUE = _REG.gauge(
+    "dl4j_checkpoint_async_queue_depth",
+    "Snapshots queued for the background checkpoint writer (a "
+    "persistently full queue means the writer cannot keep up with "
+    "every_steps and save() is applying backpressure)")
 
 
 class CorruptCheckpointError(RuntimeError):
@@ -116,6 +123,13 @@ class PreemptionRequested(Exception):
     """Internal control flow: a PreemptionSignal fired; the fit loop
     unwinds to its boundary, writes the 'preempted' checkpoint, and
     returns cleanly."""
+
+
+class AsyncCheckpointError(RuntimeError):
+    """A background checkpoint write failed after its I/O retries. The
+    error is raised on the TRAINING thread at the next fit step (or at
+    fit exit) — a fit that believes it is checkpointing must not
+    silently run bare."""
 
 
 # --------------------------------------------------------------- I/O retry
@@ -171,7 +185,18 @@ class NanRecovery:
 @dataclass
 class CheckpointConfig:
     """Where/when/how to checkpoint. ``every_steps=0`` disables periodic
-    saves (preemption and ``every_epochs`` still checkpoint)."""
+    saves (preemption and ``every_epochs`` still checkpoint).
+
+    ``async_write=True`` moves serialization + fsync off the training
+    thread: ``save()`` takes a device-side snapshot (one cheap on-device
+    copy per buffer, safe against the compiled step's donation) and
+    enqueues it for a background writer; the fit step continues while
+    the writer serializes. The queue is bounded (``async_queue``) so a
+    slow disk applies backpressure instead of accumulating snapshots in
+    device memory, writer failures surface as
+    :class:`AsyncCheckpointError` on the next fit step, and resume/
+    rollback reads flush the queue first so they always see the newest
+    write."""
 
     dir: str
     every_steps: int = 0
@@ -180,6 +205,8 @@ class CheckpointConfig:
     keep_last: int = 3
     io_retries: int = 3
     io_backoff: float = 0.05
+    async_write: bool = False
+    async_queue: int = 2
 
 
 # ---------------------------------------------------------- preemption
@@ -261,6 +288,7 @@ class CheckpointManager:
     def __init__(self, config: CheckpointConfig, fault_plan=None):
         self.config = config
         self.faults = fault_plan
+        self._writer: Optional[_AsyncWriter] = None
         os.makedirs(config.dir, exist_ok=True)
 
     # ------------------------------------------------------------- naming
@@ -283,6 +311,21 @@ class CheckpointManager:
     # --------------------------------------------------------------- save
     def save(self, model, status: str = "complete", cursor=None,
              normalizer=None, extra: Optional[dict] = None) -> str:
+        """Write one checkpoint. With ``async_write`` the state is
+        snapshotted on device and the serialization/fsync happens on the
+        background writer; the returned path is where the checkpoint
+        WILL land (call :meth:`flush` to wait for it)."""
+        if self.config.async_write:
+            self.raise_async_errors()
+            snap = _StateSnapshot(model)
+            if self._writer is None:
+                self._writer = _AsyncWriter(self, self.config.async_queue)
+            self._writer.submit((snap, status, cursor, normalizer, extra))
+            return os.path.join(self.config.dir, self._name(snap._iteration))
+        return self._write(model, status, cursor, normalizer, extra)
+
+    def _write(self, model, status: str = "complete", cursor=None,
+               normalizer=None, extra: Optional[dict] = None) -> str:
         cfg = self.config
         step, epoch = int(model._iteration), int(model._epoch)
         t0 = time.perf_counter()
@@ -335,6 +378,31 @@ class CheckpointManager:
             retry_io(lambda p=path: shutil.rmtree(p, ignore_errors=False),
                      self.config.io_retries, self.config.io_backoff)
 
+    # ----------------------------------------------------- async lifecycle
+    def flush(self):
+        """Block until every queued async write has been attempted (a
+        failed attempt is reported by :meth:`raise_async_errors`, not
+        here). No-op for sync managers."""
+        if self._writer is not None:
+            self._writer.flush()
+            CKPT_ASYNC_QUEUE.set(0)
+
+    def raise_async_errors(self):
+        """Re-raise the FIRST background-write failure (once) as
+        AsyncCheckpointError on the calling thread."""
+        w = self._writer
+        if w is not None and w.error is not None:
+            err, w.error = w.error, None
+            raise AsyncCheckpointError(
+                f"background checkpoint write failed: {err}") from err
+
+    def close_writer(self):
+        """Flush and stop the background writer (idempotent)."""
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+            CKPT_ASYNC_QUEUE.set(0)
+
     # ----------------------------------------------------------- validate
     def validate(self, path: str) -> dict:
         """Manifest + per-file SHA-256 validation. Returns the manifest;
@@ -364,6 +432,7 @@ class CheckpointManager:
         """Newest checkpoint passing validation as (path, manifest), or
         None. Corrupt checkpoints are QUARANTINED (renamed aside) so a
         bad newest write can never shadow a good older one forever."""
+        self.flush()    # async writer: never resume past a queued write
         for step, path in reversed(self.checkpoints()):
             try:
                 return path, self.validate(path)
@@ -382,12 +451,31 @@ class CheckpointManager:
                       stacklevel=3)
 
     # ------------------------------------------------------------ restore
-    def restore(self, model, normalizer=None, count_resume: bool = True):
-        """Load the newest valid checkpoint INTO ``model`` (in place:
+    def valid_at_step(self, step: int):
+        """The checkpoint for exactly ``step`` as (path, manifest), or
+        None when absent/corrupt (a corrupt one is quarantined). The
+        elastic resume barrier restores THE AGREED step — the newest
+        local checkpoint may be ahead of what every participant can
+        reach."""
+        self.flush()
+        for s, path in self.checkpoints():
+            if s == int(step):
+                try:
+                    return path, self.validate(path)
+                except CorruptCheckpointError as e:
+                    self._quarantine(path, str(e))
+                return None
+        return None
+
+    def restore(self, model, normalizer=None, count_resume: bool = True,
+                step: Optional[int] = None):
+        """Load the newest valid checkpoint — or, with ``step=``, the
+        checkpoint for exactly that step — INTO ``model`` (in place:
         params, layer states, updater state, step/epoch, device clock)
         and return ``{"path", "manifest", "cursor", "extra"}`` — or None
         when no valid checkpoint exists."""
-        found = self.latest_valid()
+        found = self.latest_valid() if step is None \
+            else self.valid_at_step(step)
         if found is None:
             return None
         path, manifest = found
@@ -427,9 +515,93 @@ class CheckpointManager:
 
 
 # ------------------------------------------------------------- session
+@jax.jit
+def _copy_leaves(leaves):
+    # + 0 under ONE jit: a real on-device copy per buffer (immune to the
+    # compiled step's donation), dispatched as a single program
+    return [a + 0 for a in leaves]
+
+
 def _device_copy(tree):
-    return jax.tree_util.tree_map(
-        lambda a: a + 0 if isinstance(a, jax.Array) else a, tree)
+    """On-device snapshot of a pytree's jax.Array leaves in ONE dispatch
+    (a per-leaf ``a + 0`` costs a host dispatch per buffer — ~10ms of
+    training-thread time per snapshot on a small MLP, which would eat
+    the async writer's entire win)."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    idx = [i for i, a in enumerate(leaves) if isinstance(a, jax.Array)]
+    if idx:
+        copies = _copy_leaves([leaves[i] for i in idx])
+        for i, c in zip(idx, copies):
+            leaves[i] = c
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+class _StateSnapshot:
+    """Device-side snapshot of one model's full training state, duck-
+    typed for the model classes' ``save()`` (``ModelSerializer.
+    writeModel`` / ``ComputationGraph.save`` only touch ``conf``,
+    ``_params``/``_states``/``_opt_state``, and the counters). The
+    on-device ``a + 0`` copies are enqueued asynchronously and — unlike
+    aliases — survive the compiled step's buffer donation; the writer
+    thread's ``np.asarray`` pulls block there, off the critical path."""
+
+    def __init__(self, model):
+        self._model_cls = type(model)
+        self._serial_type = type(model).__name__   # archive meta["type"]
+        self.conf = model.conf
+        self._params = _device_copy(model._params)
+        self._states = _device_copy(model._states)
+        self._opt_state = _device_copy(model._opt_state)
+        self._iteration = int(model._iteration)
+        self._epoch = int(model._epoch)
+
+    def save(self, path: str, save_updater: bool = True):
+        self._model_cls.save(self, path, save_updater)
+
+
+class _AsyncWriter:
+    """Bounded-queue background checkpoint writer. ``submit`` blocks
+    when the queue is full (backpressure beats unbounded device-memory
+    snapshots); the first write failure is parked in ``error`` for
+    :meth:`CheckpointManager.raise_async_errors`."""
+
+    _STOP = object()
+
+    def __init__(self, manager: "CheckpointManager", depth: int):
+        self.manager = manager
+        self.queue: "_queue.Queue" = _queue.Queue(maxsize=max(1, int(depth)))
+        self.error: Optional[BaseException] = None
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="dl4j-ckpt-writer")
+        self._thread.start()
+
+    def submit(self, job):
+        self.queue.put(job)
+        CKPT_ASYNC_QUEUE.set(self.queue.qsize())
+
+    def _loop(self):
+        while True:
+            job = self.queue.get()
+            try:
+                if job is self._STOP:
+                    return
+                snap, status, cursor, normalizer, extra = job
+                self.manager._write(snap, status=status, cursor=cursor,
+                                    normalizer=normalizer, extra=extra)
+            except BaseException as e:
+                if self.error is None:
+                    self.error = e
+            finally:
+                self.queue.task_done()
+                CKPT_ASYNC_QUEUE.set(self.queue.qsize())
+
+    def flush(self):
+        self.queue.join()
+
+    def close(self):
+        if self._thread.is_alive():
+            self.queue.put(self._STOP)
+            self._thread.join(timeout=30.0)
 
 
 def _find_preprocessor(it):
@@ -498,12 +670,28 @@ class TrainingSession:
             else:
                 self._sig_handler = None
 
-    def close(self):
+    def close(self, raise_errors: bool = True):
+        """End-of-fit teardown: restore signal handlers, detach from the
+        model, and drain the async checkpoint writer. ``raise_errors=
+        False`` (used while another exception is already unwinding)
+        demotes a writer failure to a warning instead of masking the
+        primary error."""
         if self._sig_handler is not None:
             self._sig_handler.uninstall()
             self._sig_handler = None
         if getattr(self.model, "_resilience", None) is self:
             self.model._resilience = None
+        if self.manager is not None:
+            try:
+                self.manager.flush()
+                self.manager.raise_async_errors()
+            except BaseException as e:
+                if raise_errors:
+                    raise
+                warnings.warn(f"async checkpoint writer failed during "
+                              f"teardown: {e}", stacklevel=2)
+            finally:
+                self.manager.close_writer()
 
     def resume(self) -> bool:
         """Restore the newest valid checkpoint (when ``resume=True``)
@@ -581,12 +769,22 @@ class TrainingSession:
     def after_step(self):
         self._after(1, self.model._score)
 
-    def after_dispatch(self, losses, steps: int):
-        self._after(steps, losses)
+    def after_dispatch(self, losses, steps: int, pulls: int = None):
+        """``steps`` update steps landed in one dispatch. ``pulls`` is
+        how many BATCH PULLS they consumed — equal to ``steps`` for
+        megasteps (K batches -> K steps, the default) but 1 for a TBPTT
+        batch (1 batch -> ceil(T/L) segment steps), so the cursor queue
+        stays aligned with the iterator."""
+        self._after(steps, losses, pulls)
 
-    def _after(self, k: int, losses):
-        for _ in range(min(k, len(self._cursors))):
+    def _after(self, k: int, losses, pulls: int = None):
+        for _ in range(min(k if pulls is None else pulls,
+                           len(self._cursors))):
             self._cursor_at_step = self._cursors.popleft()
+        if self.manager is not None:
+            # a background write that failed must surface HERE, on the
+            # training thread, not rot silently in the writer
+            self.manager.raise_async_errors()
         if self.recovery is not None:
             vals = np.asarray(jax.device_get(losses))
             bad = int(vals.size - np.count_nonzero(np.isfinite(vals)))
@@ -732,6 +930,19 @@ class TrainingSession:
                        "(NanPolicy.ROLLBACK)", where, info["path"])
 
 
+def epoch_target(session: Optional["TrainingSession"], model,
+                 epochs: int) -> int:
+    """Absolute epoch index a fit should run to: ``epochs`` counts from
+    zero for a RESUMED session (the restored checkpoint already banked
+    ``model._epoch`` of them) and from the model's current epoch
+    otherwise. One definition, shared by :func:`fit_scope` and the
+    elastic driver's shrink-retry loop, so the accounting cannot
+    drift."""
+    if session is not None and session.resumed:
+        return epochs
+    return model._epoch + epochs
+
+
 @contextmanager
 def fit_scope(session: Optional["TrainingSession"], model, epochs: int):
     """The shared resilience envelope around a fit's epoch loop: yields
@@ -741,8 +952,7 @@ def fit_scope(session: Optional["TrainingSession"], model, epochs: int):
     the session (restoring signal handlers) on every exit path. Used by
     MultiLayerNetwork.fit, ComputationGraph.fit, and ParallelWrapper.fit
     so the recovery protocol cannot drift between the three loops."""
-    n_epochs = epochs if session is None or not session.resumed \
-        else max(epochs - model._epoch, 0)
+    n_epochs = max(epoch_target(session, model, epochs) - model._epoch, 0)
     try:
         yield n_epochs
     except PreemptionRequested:
@@ -751,7 +961,9 @@ def fit_scope(session: Optional["TrainingSession"], model, epochs: int):
         session.on_preempt()
     finally:
         if session is not None:
-            session.close()
+            # surface a failed async checkpoint write at fit exit — unless
+            # another exception is already unwinding (don't mask it)
+            session.close(raise_errors=sys.exc_info()[1] is None)
 
 
 def begin_session(model, data, checkpoint=None, nan_policy=None, faults=None):

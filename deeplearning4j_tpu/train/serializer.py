@@ -126,7 +126,10 @@ class ModelSerializer:
     @staticmethod
     def writeModel(model, path: str, save_updater: bool = True):
         conf_json = model.conf.to_json()
-        meta = {"type": type(model).__name__, "iteration": model._iteration,
+        # _serial_type: snapshot proxies (resilience._StateSnapshot) name
+        # the REAL model class so async and sync archives are identical
+        meta = {"type": getattr(model, "_serial_type", type(model).__name__),
+                "iteration": model._iteration,
                 "epoch": model._epoch, "save_updater": bool(save_updater and
                                                            model._opt_state is not None)}
         arrays: Dict[str, np.ndarray] = {}

@@ -31,6 +31,7 @@ Pieces:
 
 from __future__ import annotations
 
+from contextlib import contextmanager
 from typing import Iterable, Iterator, List, Union
 
 import jax
@@ -55,6 +56,33 @@ TRAIN_ITERATIONS = _prof.get_registry().counter(
     "dl4j_train_iterations_total",
     "Update steps performed by compiled train dispatches (a K-step "
     "megastep advances this by K)")
+
+
+def fence_generation(model):
+    """Entry half of the elastic dispatch-commit fence: the generation
+    observed before dispatching (None when no fence is attached —
+    non-elastic fits pay only this getattr)."""
+    fence = getattr(model, "_dispatch_fence", None)
+    return None if fence is None else fence.generation
+
+
+@contextmanager
+def dispatch_commit(model, gen):
+    """Commit gate for a finished dispatch. Yields True when the
+    dispatch may commit its outputs; False when the elastic layer
+    bumped the fence while this dispatch was in flight (a watchdog-
+    abandoned thread that un-hung after a mesh shrink) — the caller
+    must DISCARD the result: the restored checkpoint state must not be
+    overwritten, and no bookkeeping (iteration, listeners, checkpoint
+    hooks) may run for a step the recovery already rolled back.
+    The commit happens under the fence lock, mutually exclusive with
+    the shrink path's bump+restore."""
+    fence = getattr(model, "_dispatch_fence", None)
+    if fence is None:
+        yield True
+        return
+    with fence.lock:
+        yield fence.generation == gen
 
 
 class MegaBatch:

@@ -33,8 +33,11 @@ def pytest_configure(config):
         "(`pytest -m quick`, target <120s — the CI gate)")
     config.addinivalue_line(
         "markers",
-        "chaos: seeded fault-injection sweeps through the resilience "
-        "layer (`pytest -m chaos`; fast, CPU-backend, runs under tier-1)")
+        "chaos: seeded fault-injection sweeps through the resilience and "
+        "elastic layers (`pytest -m chaos`). DELIBERATELY a fast marker, "
+        "not a slow one: tier-1 runs `-m 'not slow'`, so every chaos "
+        "sweep — including the elastic device-loss/hung-dispatch sweeps — "
+        "is part of the default gate")
 
 
 @pytest.fixture(scope="session")

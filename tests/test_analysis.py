@@ -608,6 +608,21 @@ class TestDistributionDiagnostics:
         r2 = conf.validate(mesh="data=2,pipe=4", pipeline=PipelineSpec(2))
         assert "DL4J-E102" in r2.codes()
 
+    def test_e102_axes_product_vs_declared_devices(self):
+        # ISSUE 6: a mesh declaration that no longer matches the physical
+        # device count (the elastic-shrink misconfiguration) is an E102
+        from deeplearning4j_tpu.analysis.distribution import MeshSpec
+        report = _mlp_conf().validate(
+            mesh=MeshSpec({"data": 8}, devices=4))
+        assert "DL4J-E102" in report.codes()
+        assert "DL4J-E102" not in _mlp_conf().validate(
+            mesh=MeshSpec({"data": 4}, devices=4)).codes()
+        # DeviceMesh.spec() declares its own (consistent) device count
+        from deeplearning4j_tpu.parallel import DeviceMesh
+        spec = DeviceMesh.data_parallel().spec()
+        assert spec.devices == 8
+        assert "DL4J-E102" not in _mlp_conf().validate(mesh=spec).codes()
+
     def test_e103_tie_split_across_stages(self):
         conf = (_builder().list()
                 .layer(DenseLayer(nOut=8, tiedWith="emb"))

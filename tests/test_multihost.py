@@ -202,19 +202,53 @@ class TestShardedCheckpointSingleProcess:
         np.testing.assert_array_equal(np.asarray(restored["W"]),
                                       np.asarray(full))
 
-    def test_topology_mismatch_reported(self, tmp_path):
+    def test_topology_change_reshards_on_load(self, tmp_path):
+        # ISSUE 6: a checkpoint saved under one mesh layout loads under
+        # another — each target shard is stitched from the saved shards
+        # (the elastic shrink/grow resume path)
         import jax
         import jax.numpy as jnp
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
         from deeplearning4j_tpu.parallel import checkpoint as ckpt
 
         mesh = Mesh(np.array(jax.devices()).reshape(8), ("data",))
-        sharded = jax.device_put(jnp.zeros((8, 4)),
+        rng = np.random.RandomState(3)
+        full = rng.randn(8, 4).astype(np.float32)
+        sharded = jax.device_put(jnp.asarray(full),
                                  NamedSharding(mesh, P("data")))
         d = str(tmp_path / "ck2")
         ckpt.save_sharded(d, {"W": sharded})
-        # a REPLICATED target needs the full array in one shard — saved
-        # 8-way, so this topology change must fail loudly, not silently
+        # replicated target: the full array assembles from the 8 shards
         repl = jax.device_put(jnp.zeros((8, 4)), NamedSharding(mesh, P()))
-        with pytest.raises(FileNotFoundError, match="different sharding"):
+        restored, _ = ckpt.load_sharded(d, {"W": repl})
+        np.testing.assert_array_equal(np.asarray(restored["W"]), full)
+        # 4-device shrunk mesh: each wider shard stitches from two saved
+        half = Mesh(np.array(jax.devices()[:4]).reshape(4), ("data",))
+        tgt = jax.device_put(jnp.zeros((8, 4)), NamedSharding(half, P("data")))
+        restored, _ = ckpt.load_sharded(d, {"W": tgt})
+        np.testing.assert_array_equal(np.asarray(restored["W"]), full)
+        assert len(restored["W"].sharding.device_set) == 4
+
+    def test_uncoverable_topology_still_fails_loudly(self, tmp_path):
+        # shards that genuinely can't tile the requested slice (a shard
+        # missing from the manifest) must raise, never return garbage
+        import jax
+        import jax.numpy as jnp
+        import json
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        from deeplearning4j_tpu.parallel import checkpoint as ckpt
+
+        mesh = Mesh(np.array(jax.devices()).reshape(8), ("data",))
+        sharded = jax.device_put(jnp.zeros((8, 4)),
+                                 NamedSharding(mesh, P("data")))
+        d = str(tmp_path / "ck4")
+        ckpt.save_sharded(d, {"W": sharded})
+        man = os.path.join(d, "manifest.json")
+        with open(man) as f:
+            manifest = json.load(f)
+        manifest["leaves"]["W"]["shards"].pop("0:1;0:4")
+        with open(man, "w") as f:
+            json.dump(manifest, f)
+        repl = jax.device_put(jnp.zeros((8, 4)), NamedSharding(mesh, P()))
+        with pytest.raises(FileNotFoundError, match="cover only"):
             ckpt.load_sharded(d, {"W": repl})

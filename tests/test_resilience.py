@@ -873,6 +873,185 @@ class TestParallelWrapperResilience:
         assert np.array_equal(np.asarray(a.params()), np.asarray(res.params()))
 
 
+# ======================================================= async checkpointing
+class TestAsyncCheckpointing:
+    """ISSUE 6 tentpole (3): snapshot on device -> serialize/fsync on a
+    background writer, bounded queue, errors propagated into the next
+    fit step."""
+
+    def _cfg(self, d, **kw):
+        kw.setdefault("every_steps", 2)
+        return CheckpointConfig(d, async_write=True, **kw)
+
+    def test_async_checkpoints_validate_and_rotate(self, tmp_path):
+        d = str(tmp_path / "c")
+        net = mlp()
+        net.fit(iterator(), checkpoint=self._cfg(d, keep_last=2))
+        mgr = CheckpointManager(CheckpointConfig(d))
+        steps = [s for s, _ in mgr.checkpoints()]
+        assert steps == [8, 10]         # writer flushed at fit exit
+        for _, p in mgr.checkpoints():
+            mgr.validate(p)
+
+    def test_async_resume_bit_exact(self, tmp_path):
+        d = str(tmp_path / "c")
+        straight = mlp()
+        straight.fit(iterator(), epochs=1)
+        pre = mlp()
+        pre.fit(iterator(), epochs=1, checkpoint=self._cfg(d),
+                faults=FaultPlan(preempt_at_step=6))
+        assert pre._preempted and pre._iteration == 6
+        res = mlp()
+        res.fit(iterator(), epochs=1,
+                checkpoint=CheckpointConfig(d, resume=True))
+        assert res._iteration == NBATCH
+        assert_training_state_equal(straight, res)
+
+    def test_snapshot_isolated_from_donation(self, tmp_path):
+        # the snapshot must deep-copy on device: the step that runs WHILE
+        # the writer serializes donates (deletes) the live buffers, so an
+        # aliasing snapshot would checkpoint freed memory. Pin by checking
+        # the checkpoint for step k holds step-k params even though
+        # training ran on past it before the writer caught up.
+        d = str(tmp_path / "c")
+        net = mlp()
+        net.fit(iterator(), checkpoint=self._cfg(d, every_steps=4,
+                                                 keep_last=10))
+        mgr = CheckpointManager(CheckpointConfig(d))
+        path = dict(mgr.checkpoints())[4]
+        loaded = MultiLayerNetwork.load(os.path.join(path, "model.zip"))
+        replay = mlp()
+        for ds in list(iterator())[:4]:
+            replay._fit_one(ds)
+        assert np.array_equal(np.asarray(loaded.params()),
+                              np.asarray(replay.params()))
+
+    def test_writer_failure_surfaces_in_fit(self, tmp_path):
+        from deeplearning4j_tpu.train.resilience import AsyncCheckpointError
+        d = str(tmp_path / "c")
+        net = mlp()
+        with pytest.raises(AsyncCheckpointError, match="background "
+                                                       "checkpoint write"):
+            net.fit(iterator(),
+                    checkpoint=self._cfg(d, io_retries=0),
+                    faults=FaultPlan(checkpoint_write_fail_at=[2]))
+
+    def test_write_failure_retried_in_writer_thread(self, tmp_path):
+        # transient write error + io_retries: the WRITER retries and the
+        # fit never notices
+        d = str(tmp_path / "c")
+        net = mlp()
+        net.fit(iterator(),
+                checkpoint=self._cfg(d, every_steps=4, io_backoff=0.01),
+                faults=FaultPlan(checkpoint_write_fail_at=[4]))
+        mgr = CheckpointManager(CheckpointConfig(d))
+        assert 4 in [s for s, _ in mgr.checkpoints()]
+
+    def test_queue_depth_gauge_registered(self):
+        from deeplearning4j_tpu.train.resilience import CKPT_ASYNC_QUEUE
+        assert CKPT_ASYNC_QUEUE.value >= 0
+
+    def test_async_archive_meta_type_matches_sync(self, tmp_path):
+        # the snapshot proxy must not leak its own class name into the
+        # archive: async and sync checkpoints are byte-compatible formats
+        d = str(tmp_path / "c")
+        net = mlp()
+        net.fit(iterator(), checkpoint=self._cfg(d, every_steps=5))
+        path = CheckpointManager(CheckpointConfig(d)).checkpoints()[-1][1]
+        with zipfile.ZipFile(os.path.join(path, "model.zip")) as z:
+            meta = json.loads(z.read("meta.json"))
+        assert meta["type"] == "MultiLayerNetwork"
+
+
+# ======================================================== TBPTT x resilience
+class TestTbpttResilience:
+    """Carried PR-5 follow-up: segment-level step accounting + batch-level
+    cursor accounting make ``backpropType('tbptt')`` fits resume
+    bit-exactly instead of being guarded off."""
+
+    SEGS = 3    # T=12, L=4
+
+    def _net(self, seed=11):
+        from deeplearning4j_tpu.nn.layers import LSTM, RnnOutputLayer
+        conf = (NeuralNetConfiguration.Builder().seed(seed)
+                .updater(updaters.Sgd(0.05)).list()
+                .layer(LSTM(nOut=6))
+                .layer(RnnOutputLayer(nOut=2, lossFunction="mcxent"))
+                .setInputType(InputType.recurrent(3, 12))
+                .backpropType("tbptt", 4)
+                .build())
+        return MultiLayerNetwork(conf).init(seed=seed)
+
+    def _iter(self, n=24, seed=0):
+        rng = np.random.RandomState(seed)
+        feats = rng.rand(n, 3, 12).astype(np.float32)
+        labs = np.zeros((n, 2, 12), np.float32)
+        labs[::2, 0] = 1.0
+        labs[1::2, 1] = 1.0
+        return ListDataSetIterator(DataSet(feats, labs), batch_size=4)
+
+    def test_resume_bit_exact(self, tmp_path):
+        d = str(tmp_path / "c")
+        straight = self._net()
+        straight.fit(self._iter(), epochs=1)    # 6 batches x 3 segs = 18
+        pre = self._net()
+        pre.fit(self._iter(), epochs=1,
+                checkpoint=CheckpointConfig(d, every_steps=2),
+                faults=FaultPlan(preempt_at_step=9))
+        assert pre._preempted and pre._iteration == 9   # batch boundary
+        res = self._net()
+        res.fit(self._iter(), epochs=1,
+                checkpoint=CheckpointConfig(d, resume=True))
+        assert res._iteration == 6 * self.SEGS
+        assert_training_state_equal(straight, res)
+
+    def test_checkpoints_land_on_batch_boundaries(self, tmp_path):
+        # every_steps=2 but 3 segment-steps per batch: saves fire at the
+        # first batch boundary past the mark, where no RNN segment state
+        # is carried (what makes the resume exact)
+        d = str(tmp_path / "c")
+        net = self._net()
+        net.fit(self._iter(), epochs=1,
+                checkpoint=CheckpointConfig(d, every_steps=2, keep_last=99))
+        steps = [s for s, _ in
+                 CheckpointManager(CheckpointConfig(d)).checkpoints()]
+        assert steps and all(s % self.SEGS == 0 for s in steps)
+        # the saved cursor is the matching batch-boundary position
+        mgr = CheckpointManager(CheckpointConfig(d))
+        for step, path in mgr.checkpoints():
+            with open(os.path.join(path, "extra.json")) as f:
+                cursor = json.load(f)["cursor"]
+            assert cursor["pos"] == (step // self.SEGS) * 4
+
+    def test_nan_policy_skip_drops_whole_batch(self, tmp_path):
+        # batch 2 poisoned -> its 3 segment updates all skip (the batch is
+        # the recovery unit), training finishes finite
+        net = self._net()
+        net.fit(self._iter(), epochs=1, nan_policy=NanPolicy.SKIP_STEP,
+                faults=FaultPlan(nan_grads_at=[2]))
+        assert net._iteration == 6 * self.SEGS
+        assert np.isfinite(np.asarray(net.params())).all()
+        from deeplearning4j_tpu.train.resilience import NONFINITE_STEPS
+        assert NONFINITE_STEPS.value > 0
+
+    def test_non_sequence_batches_still_single_step(self, tmp_path):
+        # the W002 fallback path (non-sequence batch under a TBPTT
+        # config) keeps working with a session attached
+        conf = (NeuralNetConfiguration.Builder().seed(3)
+                .updater(updaters.Sgd(0.1)).list()
+                .layer(DenseLayer(nOut=8, activation="relu"))
+                .layer(OutputLayer(nOut=NOUT, lossFunction="mcxent",
+                                   activation="softmax"))
+                .setInputType(InputType.feedForward(NIN))
+                .backpropType("tbptt", 4)
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        net.fit(iterator(), epochs=1,
+                checkpoint=CheckpointConfig(str(tmp_path / "c"),
+                                            every_steps=3))
+        assert net._iteration == NBATCH
+
+
 # ===================================================================== chaos
 @pytest.mark.chaos
 class TestChaosSweep:
