@@ -27,7 +27,9 @@ The ``detail`` field carries the full BASELINE.md metric set:
 
 Run: ``python bench.py`` (``--quick`` = small configs for CI;
 ``--skip-resnet`` / ``--skip-gemm`` / ``--skip-extra-cnn`` /
-``--skip-scaling`` to bisect; ``--reps N`` to change the draw count).
+``--skip-scaling`` to bisect; ``--reps N`` to change the draw count;
+``--serving`` folds the ``benchmarks/probe_serving.py`` traffic-mix
+probe — throughput vs p99 + shed rates — into ``detail.serving``).
 """
 
 import json
@@ -388,6 +390,29 @@ class DataPipelineBench:
                     self.h2d_mbps * 1e6 / img_bytes, 1)}
 
 
+def bench_serving(quick: bool = False):
+    """Serving traffic-mix probe (benchmarks/probe_serving.py) in a
+    subprocess — it owns its device flags and sheds load on purpose, so
+    its jax state must not contaminate the training benchmarks."""
+    import os
+    import subprocess
+    here = os.path.dirname(os.path.abspath(__file__))
+    cmd = [sys.executable, os.path.join(here, "benchmarks",
+                                        "probe_serving.py")]
+    if quick:
+        cmd += ["--n", "100", "--batch-limit", "16"]
+    # a hung probe / empty output / bad JSON degrades to an error entry —
+    # it must not abort the training benches that already ran
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=900, cwd=here)
+        if proc.returncode != 0:
+            return {"error": (proc.stderr or proc.stdout).strip()[-500:]}
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def bench_dp_scaling(bert_1chip_samples_per_sec, quick: bool = False):
     """DP scaling across real devices only (BASELINE.md scaling row)."""
     n = len(jax.devices())
@@ -505,6 +530,8 @@ def main(argv):
             / detail["resnet50"]["img_per_sec"], 4)
     if "--skip-scaling" not in argv:
         detail["dp_scaling"] = bench_dp_scaling(bert["samples_per_sec"], quick)
+    if "--serving" in argv:
+        detail["serving"] = bench_serving(quick)
 
     print(json.dumps({
         "metric": "bert_base_seq128_train_samples_per_sec_per_chip",

@@ -1,0 +1,191 @@
+"""Probe: serving throughput vs p99 at fixed traffic mixes, with shed
+rate — the ISSUE 7 serving acceptance numbers.
+
+Three seeded traffic mixes (the same ``ServingLoad`` generator the
+``pytest -m chaos`` sweeps use, so a probe regression reproduces as a
+test):
+
+- **steady**: Poisson arrivals at ~0.8x measured capacity — the
+  baseline throughput/latency point; shed rate should be ~0.
+- **burst**: a quiet floor punctuated by zero-gap volleys — admission
+  control must shed with ``ServerOverloadedError`` instead of letting
+  queue latency grow unboundedly.
+- **deadline**: half the requests carry a deadline tighter than one
+  service time — they are shed BEFORE dispatch and must not rot p99
+  for the loose-deadline traffic.
+
+Also reports ``recompiles_after_warmup`` (the zero-steady-state-compile
+pin, measured through the W201 churn detector) and the AOT warmup cost.
+
+Prints ONE JSON line::
+
+  {"probe": "serving", "n_devices": ..., "batch_limit": ...,
+   "buckets": [...], "warmup_seconds": ...,
+   "uncontended": {"p50_ms": ..., "p99_ms": ...},
+   "capacity_rps": ...,
+   "mixes": {"steady": {"offered_rps": ..., "throughput_rps": ...,
+                        "p50_ms": ..., "p99_ms": ...,
+                        "shed_rate": ..., "shed_overload": ...,
+                        "shed_deadline": ..., "completed": ...}, ...},
+   "recompiles_after_warmup": 0}
+
+Run: python benchmarks/probe_serving.py [--n N] [--batch-limit B]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+NIN, NOUT = 32, 10
+
+
+def build():
+    from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    conf = (NeuralNetConfiguration.Builder().seed(42).list()
+            .layer(DenseLayer(nOut=128, activation="relu"))
+            .layer(DenseLayer(nOut=128, activation="relu"))
+            .layer(OutputLayer(nOut=NOUT, lossFunction="mcxent",
+                               activation="softmax"))
+            .setInputType(InputType.feedForward(NIN))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def pct(sorted_vals, q):
+    if not sorted_vals:
+        return None
+    return sorted_vals[min(int(len(sorted_vals) * q), len(sorted_vals) - 1)]
+
+
+def run_mix(server, load, mix_name):
+    from deeplearning4j_tpu.serving import (DeadlineExceededError,
+                                            ServerOverloadedError,
+                                            ServingRequest)
+    t0 = time.perf_counter()
+    results = load.replay(server.submit, (NIN,))
+    lat, completed, shed_over, shed_dead, failed = [], 0, 0, 0, 0
+    for _spec, h in results:
+        if isinstance(h, ServerOverloadedError):
+            shed_over += 1
+            continue
+        assert isinstance(h, ServingRequest), h
+        try:
+            h.get(60.0)
+            completed += 1
+            lat.append(h.resolved_at - h.enqueued_at)
+        except DeadlineExceededError:
+            shed_dead += 1
+        except Exception:
+            failed += 1
+    wall = time.perf_counter() - t0
+    lat.sort()
+    n = len(results)
+    return {
+        "n": n,
+        "offered_rps": round(n / max(load.duration(), 1e-9), 1),
+        "throughput_rps": round(completed / wall, 1),
+        "p50_ms": round(pct(lat, 0.50) * 1e3, 3) if lat else None,
+        "p99_ms": round(pct(lat, 0.99) * 1e3, 3) if lat else None,
+        "completed": completed,
+        "shed_overload": shed_over,
+        "shed_deadline": shed_dead,
+        "failed": failed,
+        "shed_rate": round((shed_over + shed_dead) / n, 4),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=400,
+                    help="requests per traffic mix")
+    ap.add_argument("--batch-limit", type=int, default=32)
+    ap.add_argument("--max-queue", type=int, default=64)
+    args = ap.parse_args()
+
+    import jax
+
+    from deeplearning4j_tpu.faults import ServingLoad
+    from deeplearning4j_tpu.serving import ModelServer
+
+    net = build()
+    server = ModelServer(net, batch_limit=args.batch_limit,
+                         max_queue=args.max_queue, coalesce_ms=1.0)
+    t0 = time.perf_counter()
+    server.warmup([(NIN,)])
+    warmup_s = time.perf_counter() - t0
+
+    # uncontended latency: sequential single-row requests
+    unc = []
+    for i in range(30):
+        r = server.submit(np.random.RandomState(i).randn(
+            1, NIN).astype(np.float32))
+        r.get(30.0)
+        unc.append(r.resolved_at - r.enqueued_at)
+    unc.sort()
+
+    # measured capacity: how fast full batches drain back to back
+    t0 = time.perf_counter()
+    full_batches = 20
+    for i in range(full_batches):
+        server.output(np.random.RandomState(i).randn(
+            args.batch_limit, NIN).astype(np.float32), timeout=60)
+    capacity_rps = full_batches * args.batch_limit \
+        / (time.perf_counter() - t0)
+
+    # capacity_rps is ROW throughput at full coalesced batches; convert
+    # to a request rate for the generators (max_rows=2 -> 1.5 rows/req)
+    avg_rows = 1.5
+    req_capacity = capacity_rps / avg_rows
+    service_ms = args.batch_limit / capacity_rps * 1e3
+    mixes = {}
+    mixes["steady"] = run_mix(server, ServingLoad.seeded(
+        1, mix="steady", n=args.n, rps=0.6 * req_capacity, max_rows=2),
+        "steady")
+    # volleys sized to overwhelm the queue but leave a quiet floor
+    # (the generator clamps n_bursts*burst_size <= n)
+    mixes["burst"] = run_mix(server, ServingLoad.seeded(
+        2, mix="burst", n=args.n, rps=0.3 * req_capacity,
+        n_bursts=4, burst_size=min(args.max_queue * 2, args.n // 8),
+        max_rows=2), "burst")
+    mixes["deadline"] = run_mix(server, ServingLoad.seeded(
+        3, mix="deadline", n=args.n, rps=0.6 * req_capacity, max_rows=2,
+        tight_deadline=service_ms / 4e3, loose_deadline=10.0,
+        deadline_frac=0.5), "deadline")
+
+    recompiles = server.recompiles_after_warmup()
+    server.close()
+
+    print(json.dumps({
+        "probe": "serving",
+        "n_devices": len(jax.devices()),
+        "batch_limit": args.batch_limit,
+        "max_queue": args.max_queue,
+        "buckets": server.buckets(),
+        "warmup_seconds": round(warmup_s, 3),
+        "uncontended": {"p50_ms": round(pct(unc, 0.5) * 1e3, 3),
+                        "p99_ms": round(pct(unc, 0.99) * 1e3, 3)},
+        "capacity_rps": round(capacity_rps, 1),
+        "mixes": mixes,
+        "recompiles_after_warmup": recompiles,
+    }))
+    if recompiles != 0:
+        print(f"# FAIL: {recompiles} steady-state recompile(s) after "
+              "warmup", file=sys.stderr)
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -20,6 +20,10 @@ validation is the same shape of tool):
   axis, ``E103`` pipeline-split weight tie, ``E104`` per-device HBM
   budget, ``W104`` replicated giant, ``W105`` pipeline FLOP imbalance,
   ``W106`` sub-MXU shard, ``W107`` per-layer collective volume.
+- :mod:`serving` — serving-config lints (``ModelServer.validate()`` /
+  :func:`lint_serving`): ``E110`` bucket vs. data-axis divisibility,
+  ``E111`` serving HBM budget (params + largest-bucket activations),
+  ``W110`` pathological bucket ladder.
 - :mod:`samediff` — recorded-op-graph lints (``sd.validate()``): shape
   propagation over ``_Node`` graphs plus ``E151`` undefined input,
   ``E152`` shape conflict, ``E153`` bad loss variable, ``W151`` dangling
@@ -50,10 +54,11 @@ from deeplearning4j_tpu.analysis.diagnostics import (DIAGNOSTIC_CODES,
                                                      normalize_code)
 from deeplearning4j_tpu.analysis.distribution import MeshSpec, PipelineSpec
 from deeplearning4j_tpu.analysis.samediff import analyze_samediff
+from deeplearning4j_tpu.analysis.serving import lint_serving
 
 __all__ = [
     "analyze", "analyze_samediff", "Diagnostic", "Severity",
     "ValidationReport", "ModelValidationError", "DIAGNOSTIC_CODES",
     "MeshSpec", "PipelineSpec", "normalize_code", "RecompileChurnDetector",
-    "get_churn_detector", "array_fingerprint",
+    "get_churn_detector", "array_fingerprint", "lint_serving",
 ]

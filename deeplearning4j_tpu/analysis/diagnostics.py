@@ -87,6 +87,17 @@ DIAGNOSTIC_CODES = {
                  "it non-divisible, forcing padding)",
     "DL4J-W107": "collective volume: a single layer's estimated gradient "
                  "allreduce payload per step exceeds the threshold",
+    # E11x/W11x serving-config lints (analysis/serving.py): validate the
+    # bucket ladder x mesh x HBM budget before warmup burns the compiles.
+    "DL4J-E110": "serving bucket/mesh mismatch: a batch bucket does not "
+                 "divide the serving mesh's data axis, so the sharded "
+                 "dispatch cannot place it",
+    "DL4J-E111": "serving HBM budget exceeded: replicated params plus the "
+                 "largest bucket's activation estimate exceed the "
+                 "per-device budget (OOM at peak coalesced load)",
+    "DL4J-W110": "serving bucket ladder: duplicate buckets or more buckets "
+                 "than the threshold — each bucket x input shape is one "
+                 "compiled program (warmup time, executable-cache HBM)",
     # E15x/W15x SameDiff graph lints (analysis/samediff.py).
     "DL4J-E151": "undefined graph input: an op node consumes a name no "
                  "variable, constant, placeholder, or node output defines",

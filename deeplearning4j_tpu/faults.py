@@ -36,6 +36,24 @@ seedable*, behind the seams the real failures would hit:
   modelling one replica lagging the collective; the straggler
   histogram, not the timeout path, must account for it.
 
+Serving fault kinds (ISSUE 7 — the model server's degradation paths):
+
+- **Replica fault at serving batch k** (``serve_fail_at``) — the k-th
+  dispatched batch's forward raises once, standing in for a transient
+  XLA/runtime error; the retry-on-survivors path must recover.
+- **Replica loss mid-serve** (``serve_device_loss_at_batch``) — from
+  batch k on, any forward touching the planned-dead devices raises AND
+  the devices read as DEAD to DeviceMonitor probes, until the serving
+  mesh shrinks onto the survivors (then forwards succeed again).
+- **Slow / hung forward** — reuse ``slow_replica_at`` /
+  ``hung_dispatch_at`` with the index meaning *serving batch*: the
+  server's DispatchWatchdog consumes them through the same
+  ``dispatch_hold`` seam the training loop uses.
+- **Request bursts / deadline storms** — workload-side, not
+  server-side: :class:`ServingLoad` generates seeded arrival schedules
+  (steady / burst / deadline-storm mixes) shared by the chaos tests and
+  ``benchmarks/probe_serving.py``.
+
 Every fault fires exactly once per planned step index (so a retried
 pull succeeds, like a real transient), and :meth:`FaultPlan.seeded`
 derives a whole plan from one integer seed for sweep-style chaos tests
@@ -88,7 +106,9 @@ class FaultPlan:
                  hung_dispatch_at: Iterable[int] = (),
                  hang_seconds: Optional[float] = 0.2,
                  slow_replica_at: Iterable[int] = (),
-                 slow_seconds: float = 0.1):
+                 slow_seconds: float = 0.1,
+                 serve_fail_at: Iterable[int] = (),
+                 serve_device_loss_at_batch: Optional[int] = None):
         self.seed = seed
         self.nan_grads_at = _as_step_set(nan_grads_at)
         self.data_error_at = _as_step_set(data_error_at)
@@ -102,6 +122,8 @@ class FaultPlan:
         self.hang_seconds = hang_seconds
         self.slow_replica_at = _as_step_set(slow_replica_at)
         self.slow_seconds = float(slow_seconds)
+        self.serve_fail_at = _as_step_set(serve_fail_at)
+        self.serve_device_loss_at_batch = serve_device_loss_at_batch
         # consumed-state: each fault fires once
         self._nan_pending = set(self.nan_grads_at)
         self._data_pending = set(self.data_error_at)
@@ -109,6 +131,8 @@ class FaultPlan:
         self._ckpt_corrupt_pending = set(self.checkpoint_corrupt_at)
         self._hang_pending = set(self.hung_dispatch_at)
         self._slow_pending = set(self.slow_replica_at)
+        self._serve_fail_pending = set(self.serve_fail_at)
+        self._serve_loss_active = False
         self._hang_release = threading.Event()
         self._pull_index = 0
 
@@ -150,6 +174,43 @@ class FaultPlan:
                    checkpoint_corrupt_at=(
                        [int(rng.randint(lo, horizon + 1))]
                        if corrupt_checkpoint else ()))
+
+    @classmethod
+    def seeded_serving(cls, seed: int, horizon: int, n_fail: int = 1,
+                       n_slow: int = 0, n_hang: int = 0,
+                       slow_seconds: float = 0.05,
+                       hang_seconds: Optional[float] = 0.2,
+                       device_loss: int = 0,
+                       device_pool: Iterable[int] = ()) -> "FaultPlan":
+        """A serving-side plan from one seed: fault *batch indices* are
+        drawn without replacement from ``[2, horizon]`` (batch 1 is left
+        clean so warmup-adjacent traffic always lands once). ``n_fail``
+        injects transient replica faults, ``n_slow``/``n_hang`` stall
+        forwards through the watchdog's dispatch_hold seam, and
+        ``device_loss=n`` kills n devices from ``device_pool`` at a
+        drawn batch (the mesh-shrink path)."""
+        rng = np.random.RandomState(seed)
+        n_faults = n_fail + n_slow + n_hang + (1 if device_loss else 0)
+        lo = 2
+        pool = rng.permutation(np.arange(lo, max(horizon + 1, lo + n_faults)))
+        picks = [int(p) for p in pool[:n_faults]]
+        fail_at = picks[:n_fail]
+        slow_at = picks[n_fail:n_fail + n_slow]
+        hang_at = picks[n_fail + n_slow:n_fail + n_slow + n_hang]
+        loss_at, lose = None, ()
+        if device_loss:
+            loss_at = picks[n_fail + n_slow + n_hang]
+            ids = sorted(int(d) for d in device_pool)
+            if device_loss >= len(ids):
+                raise ValueError(
+                    f"device_loss={device_loss} would kill the whole "
+                    f"device_pool ({len(ids)} devices)")
+            lose = [ids[int(i)] for i in
+                    rng.choice(len(ids), size=device_loss, replace=False)]
+        return cls(seed=seed, serve_fail_at=fail_at,
+                   slow_replica_at=slow_at, slow_seconds=slow_seconds,
+                   hung_dispatch_at=hang_at, hang_seconds=hang_seconds,
+                   serve_device_loss_at_batch=loss_at, lose_devices=lose)
 
     # ----------------------------------------------------------- data seams
     def wrap_iterator(self, iterator: DataSetIterator) -> DataSetIterator:
@@ -212,13 +273,38 @@ class FaultPlan:
     def dead_devices(self, step: Optional[int] = None) -> Set[int]:
         """Device indices reading as DEAD at update step ``step`` —
         persistent from ``device_loss_at_step`` on (a lost chip stays
-        lost). ``step=None`` asks "as of now" (inference-side probes):
-        the loss applies whenever one is planned at all."""
+        lost). ``step=None`` asks "as of now" (inference/serving-side
+        probes): the loss applies whenever a training loss is planned at
+        all, or once a planned serving loss has fired."""
         if self.device_loss_at_step is None:
+            if self._serve_loss_active:
+                return set(self.lose_devices)
             return set()
         if step is not None and step < self.device_loss_at_step:
             return set()
         return set(self.lose_devices)
+
+    # -------------------------------------------------------- serving seams
+    def serving_forward(self, batch_index: int, device_ids) -> None:
+        """Called by the model server as serving batch ``batch_index``
+        (1-based) is about to forward on ``device_ids``: raises the
+        planned replica fault (once) or the planned device-loss error
+        (every forward that still touches a dead device — the server
+        must shrink the mesh before forwards succeed again)."""
+        if batch_index in self._serve_fail_pending:
+            self._serve_fail_pending.discard(batch_index)
+            raise RuntimeError(
+                f"injected replica fault at serving batch {batch_index} "
+                f"(FaultPlan seed={self.seed})")
+        if self.serve_device_loss_at_batch is not None \
+                and batch_index >= self.serve_device_loss_at_batch:
+            self._serve_loss_active = True
+            dead = set(self.lose_devices) & {int(d) for d in device_ids}
+            if dead:
+                raise RuntimeError(
+                    f"injected device loss at serving batch {batch_index}: "
+                    f"device(s) {sorted(dead)} are dead "
+                    f"(FaultPlan seed={self.seed})")
 
     def dispatch_hold(self, step: int) -> bool:
         """Called (in the dispatch thread) as update step ``step`` is
@@ -262,7 +348,9 @@ class FaultPlan:
                 f"device_loss={self.device_loss_at_step}:"
                 f"{sorted(self.lose_devices)}, "
                 f"hung={sorted(self.hung_dispatch_at)}, "
-                f"slow={sorted(self.slow_replica_at)})")
+                f"slow={sorted(self.slow_replica_at)}, "
+                f"serve_fail={sorted(self.serve_fail_at)}, "
+                f"serve_loss={self.serve_device_loss_at_batch})")
 
 
 def _poison(ds):
@@ -314,3 +402,116 @@ class _FaultInjectionIterator(DataSetIterator):
 
     def seek(self, cursor):
         self.base.seek(cursor)
+
+
+# ------------------------------------------------------------ serving load
+class RequestSpec:
+    """One planned serving request: ``at`` seconds after replay start,
+    ``rows`` feature rows, optional ``deadline`` seconds."""
+
+    __slots__ = ("at", "rows", "deadline")
+
+    def __init__(self, at: float, rows: int, deadline: Optional[float]):
+        self.at = float(at)
+        self.rows = int(rows)
+        self.deadline = deadline
+
+    def __repr__(self):
+        return (f"RequestSpec(at={self.at:.4f}, rows={self.rows}, "
+                f"deadline={self.deadline})")
+
+
+class ServingLoad:
+    """Seeded, deterministic request-arrival schedule for the model
+    server — the workload half of the serving fault kinds. The same
+    generator drives the chaos sweeps (``pytest -m chaos``) and the
+    ``benchmarks/probe_serving.py`` traffic mixes, so a probe regression
+    reproduces as a test.
+
+    Mixes:
+
+    - ``steady``: exponential inter-arrival gaps at ``rps`` (a Poisson
+      process), uniform row counts in ``[1, max_rows]``.
+    - ``burst``: a quiet floor at ``rps`` punctuated by ``n_bursts``
+      zero-gap volleys of ``burst_size`` requests — the admission-
+      control stressor (a full queue must shed, not block).
+    - ``deadline``: the steady process, but ``deadline_frac`` of the
+      requests carry a tight ``tight_deadline`` and the rest a loose
+      one — the deadline-storm stressor (expired requests must be shed
+      before dispatch without rotting the batch for the rest).
+    """
+
+    MIXES = ("steady", "burst", "deadline")
+
+    def __init__(self, specs):
+        self.specs = list(specs)
+
+    def __len__(self):
+        return len(self.specs)
+
+    def __iter__(self):
+        return iter(self.specs)
+
+    def duration(self) -> float:
+        return self.specs[-1].at if self.specs else 0.0
+
+    @classmethod
+    def seeded(cls, seed: int, mix: str = "steady", n: int = 200,
+               rps: float = 500.0, max_rows: int = 4,
+               n_bursts: int = 4, burst_size: int = 32,
+               tight_deadline: float = 0.005, loose_deadline: float = 2.0,
+               deadline_frac: float = 0.5) -> "ServingLoad":
+        if mix not in cls.MIXES:
+            raise ValueError(f"unknown mix {mix!r} (expected one of "
+                             f"{cls.MIXES})")
+        rng = np.random.RandomState(seed)
+        specs = []
+        t = 0.0
+        if mix == "burst":
+            # exactly n requests, always: an oversized volley plan is
+            # clamped instead of silently generating more than n (and
+            # collapsing every volley into one mega-burst at t~0)
+            n_bursts = max(1, min(n_bursts, n))
+            burst_size = min(burst_size, max(n // n_bursts, 1))
+            floor = n - n_bursts * burst_size
+            burst_at = sorted(rng.uniform(0.0, max(floor, n_bursts) / rps,
+                                          size=n_bursts))
+            for i in range(floor):
+                t += rng.exponential(1.0 / rps)
+                specs.append(RequestSpec(t, 1 + rng.randint(max_rows), None))
+            for b in burst_at:
+                for _ in range(burst_size):
+                    specs.append(RequestSpec(
+                        b, 1 + rng.randint(max_rows), None))
+            specs.sort(key=lambda s: s.at)
+        else:
+            for i in range(n):
+                t += rng.exponential(1.0 / rps)
+                deadline = None
+                if mix == "deadline":
+                    deadline = tight_deadline \
+                        if rng.uniform() < deadline_frac else loose_deadline
+                specs.append(RequestSpec(t, 1 + rng.randint(max_rows),
+                                         deadline))
+        return cls(specs)
+
+    def replay(self, submit, feature_shape, dtype=np.float32,
+               time_scale: float = 1.0, rng_seed: int = 0):
+        """Drive ``submit(x, deadline=...)`` honoring the arrival
+        offsets (scaled by ``time_scale``). Returns the list of
+        ``(spec, handle_or_exception)`` pairs — admission rejections are
+        captured, not raised, so callers can assert on the outcome
+        partition. Feature values are seeded for reproducibility."""
+        rng = np.random.RandomState(rng_seed)
+        t0 = time.monotonic()
+        out = []
+        for spec in self.specs:
+            delay = spec.at * time_scale - (time.monotonic() - t0)
+            if delay > 0:
+                time.sleep(delay)
+            x = rng.randn(spec.rows, *feature_shape).astype(dtype)
+            try:
+                out.append((spec, submit(x, deadline=spec.deadline)))
+            except Exception as e:  # admission errors are outcomes here
+                out.append((spec, e))
+        return out

@@ -30,6 +30,7 @@ from deeplearning4j_tpu.parallel.sequence import ring_attention  # noqa: F401
 from deeplearning4j_tpu.parallel.wrapper import (  # noqa: F401
     InferenceFailedError,
     InferenceObservable,
+    InferenceShutdownError,
     ParallelInference,
     ParallelWrapper,
 )

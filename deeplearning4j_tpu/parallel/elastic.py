@@ -170,6 +170,41 @@ class DeviceMonitor:
         return health
 
 
+def shrink_mesh_on_dead(mesh: DeviceMesh, plan=None,
+                        context: str = "serving") -> Optional[DeviceMesh]:
+    """Probe ``mesh``'s devices and return a data-parallel survivor
+    mesh when some are dead — or ``None`` when the mesh must stay as it
+    is: no deaths, a tensor/sequence-parallel mesh (each device holds
+    an unreplicated shard, so dropping one would break the model's
+    sharding — mirrors the training shrink guard), or no survivors at
+    all. Shared by :class:`~deeplearning4j_tpu.parallel.wrapper.
+    ParallelInference` and ``serving.ModelServer`` so the two serving
+    paths cannot drift; emits the operator-facing warnings either way
+    (``context`` prefixes them)."""
+    devices = mesh.devices
+    health = DeviceMonitor(plan=plan).probe(devices)
+    if not health.dead:
+        return None
+    if mesh.size("model") * mesh.size("seq") > 1:
+        warnings.warn(
+            f"{context}: device(s) {sorted(health.dead)} are dead but the "
+            "mesh has model/seq axes — cannot shrink a tensor-parallel "
+            "mesh; retrying on the full mesh", stacklevel=3)
+        return None
+    surviving = [d for d in devices if d.id not in health.dead]
+    if not surviving:
+        warnings.warn(
+            f"{context}: every device is dead — keeping the mesh, the "
+            "next retry will fail structurally", stacklevel=3)
+        return None
+    DEVICE_LOST.inc(len(health.dead))
+    warnings.warn(
+        f"{context}: dropping dead device(s) {sorted(health.dead)}; "
+        f"continuing on {len(surviving)} replica(s)", stacklevel=3)
+    return DeviceMesh.create(data=len(surviving), model=1, seq=1,
+                             devices=surviving)
+
+
 class DispatchFence:
     """Commit fence between the elastic recovery path and abandoned
     dispatch threads. ``fit_elastic`` attaches one to the model as
@@ -226,10 +261,14 @@ class DispatchWatchdog:
         self.timeouts = 0
         self.stragglers = 0
 
-    def begin_attempt(self):
+    def begin_attempt(self, count: Optional[int] = None):
         """The next ``warmup`` dispatches will compile (fresh program /
-        fresh mesh): run them unsupervised."""
-        self._lenient = max(self._lenient, self.warmup)
+        fresh mesh): run them unsupervised. ``count`` overrides the
+        leniency for callers whose steady-state ``warmup`` is 0 (the
+        model server AOT-compiles everything, but a mesh rebuild still
+        legitimately compiles once)."""
+        self._lenient = max(self._lenient,
+                            self.warmup if count is None else int(count))
 
     def _hold(self, step: int) -> bool:
         """Fault seam: returns False when the planned hang says the

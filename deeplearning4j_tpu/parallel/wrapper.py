@@ -250,6 +250,18 @@ class InferenceFailedError(RuntimeError):
             f"{type(last_error).__name__}: {last_error}")
 
 
+class InferenceShutdownError(RuntimeError):
+    """The ParallelInference instance was closed while this request was
+    still pending (queued, never dispatched). Retriable against another
+    replica — the request was not executed."""
+
+    retriable = True
+
+    def __init__(self):
+        super().__init__("ParallelInference closed: request was pending "
+                         "and has not been executed — retry elsewhere")
+
+
 class InferenceObservable:
     """Future-like handle for one inference request (ref: ObservablesProvider)."""
 
@@ -286,17 +298,33 @@ class ParallelInference:
     (``dl4j_inference_replica_failures_total`` counts the failures).
     After exhaustion every request in the batch fails with a structured
     :class:`InferenceFailedError` instead of a raw backend exception.
+
+    Superseded by :class:`deeplearning4j_tpu.serving.ModelServer`
+    (ISSUE 7) — bounded admission with structured overload errors,
+    per-request deadlines, AOT bucket warmup, a circuit breaker, and
+    graceful drain. This class is kept for reference API parity; it
+    shares the bounded-queue + close() semantics:
+
+    - the request queue is bounded (``max_queue``); a full queue raises
+      :class:`~deeplearning4j_tpu.serving.ServerOverloadedError`
+      instead of blocking the producer unboundedly.
+    - ``close()`` (also the context-manager exit; ``shutdown()`` is the
+      reference-named alias) stops the worker and fails every pending
+      request with :class:`InferenceShutdownError` — callers blocked in
+      ``get(timeout)`` unblock immediately instead of timing out.
     """
 
     def __init__(self, model, mesh: DeviceMesh = None, batch_limit: int = 32,
                  queue_timeout_ms: float = 5.0, max_retries: int = 2,
-                 replica_timeout: float = None, faults=None):
+                 replica_timeout: float = None, faults=None,
+                 max_queue: int = 256):
         self.model = model
         self.mesh = mesh or DeviceMesh.data_parallel()
         self.batch_limit = batch_limit
         self.timeout = queue_timeout_ms / 1000.0
         self.max_retries = int(max_retries)
         self.replica_timeout = replica_timeout
+        self.max_queue = int(max_queue)
         self._faults = faults
         self._watchdog = None
         if replica_timeout:
@@ -305,7 +333,8 @@ class ParallelInference:
             # nothing about replica health
             self._watchdog = DispatchWatchdog(deadline=replica_timeout,
                                               grace=replica_timeout)
-        self._queue: "queue.Queue" = queue.Queue()
+        self._queue: "queue.Queue" = queue.Queue(maxsize=self.max_queue)
+        self._submit_lock = threading.Lock()
         self._shutdown = False
         self._worker = threading.Thread(target=self._serve, daemon=True)
         self._worker.start()
@@ -316,7 +345,18 @@ class ParallelInference:
 
     def submit(self, x) -> InferenceObservable:
         obs = InferenceObservable()
-        self._queue.put((np.asarray(x), obs))
+        # the lock serializes against close(): no request can slip into
+        # the queue after close() drained it (it would hang forever)
+        with self._submit_lock:
+            if self._shutdown:
+                raise InferenceShutdownError()
+            try:
+                self._queue.put_nowait((np.asarray(x), obs))
+            except queue.Full:
+                from deeplearning4j_tpu.serving.errors import \
+                    ServerOverloadedError
+                raise ServerOverloadedError(self._queue.qsize(),
+                                            self.max_queue) from None
         return obs
 
     def _serve(self):
@@ -388,39 +428,38 @@ class ParallelInference:
         """Health-probe the serving mesh; rebuild it on the survivors
         when devices are dead (the retried forward then runs only on
         replicas that still answer)."""
-        from deeplearning4j_tpu.parallel.elastic import (DEVICE_LOST,
-                                                         DeviceMonitor)
-        devices = self.mesh.devices
-        health = DeviceMonitor(plan=self._faults).probe(devices)
-        if not health.dead:
+        from deeplearning4j_tpu.parallel.elastic import shrink_mesh_on_dead
+        new_mesh = shrink_mesh_on_dead(self.mesh, plan=self._faults,
+                                       context="inference")
+        if new_mesh is None:
             return
-        if self.mesh.size("model") * self.mesh.size("seq") > 1:
-            # a tensor/sequence-parallel mesh cannot drop devices — each
-            # holds an unreplicated shard; rebuilding it data-parallel
-            # would break the model's sharding (mirrors the training
-            # path's shrink guard)
-            warnings.warn(
-                f"inference: device(s) {sorted(health.dead)} are dead but "
-                "the serving mesh has model/seq axes — cannot shrink a "
-                "tensor-parallel mesh; retrying on the full mesh",
-                stacklevel=2)
-            return
-        surviving = [d for d in devices if d.id not in health.dead]
-        if not surviving:
-            warnings.warn("inference: every serving device is dead — "
-                          "keeping the mesh, the next retry will fail "
-                          "structurally", stacklevel=2)
-            return
-        DEVICE_LOST.inc(len(health.dead))
-        warnings.warn(
-            f"inference: dropping dead device(s) {sorted(health.dead)}; "
-            f"serving continues on {len(surviving)} replica(s)",
-            stacklevel=2)
-        self.mesh = DeviceMesh.create(data=len(surviving), model=1, seq=1,
-                                      devices=surviving)
+        self.mesh = new_mesh
         if self._watchdog is not None:
             self._watchdog.begin_attempt()  # the shrunk forward recompiles
 
+    def close(self, timeout: float = 5.0):
+        """Stop the worker and fail every still-pending request with
+        :class:`InferenceShutdownError` (previously they silently sat
+        in an unbounded queue until their own ``get(timeout)`` gave
+        up). Idempotent; also the context-manager exit."""
+        with self._submit_lock:
+            if self._shutdown:
+                return
+            self._shutdown = True
+        self._worker.join(timeout=timeout)
+        while True:
+            try:
+                _x, obs = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            obs._fail(InferenceShutdownError())
+
     def shutdown(self):
-        self._shutdown = True
-        self._worker.join(timeout=1.0)
+        """Reference-named alias for :meth:`close`."""
+        self.close()
+
+    def __enter__(self) -> "ParallelInference":
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

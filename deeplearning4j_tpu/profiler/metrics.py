@@ -242,6 +242,32 @@ class Histogram(_Metric):
         with self._lock:
             return self._sum
 
+    def quantile(self, q: float) -> Optional[float]:
+        """Approximate q-quantile (0 <= q <= 1) from the cumulative
+        buckets, linearly interpolated within the owning bucket —
+        prometheus ``histogram_quantile`` semantics, computed locally
+        so the serving stats / bench probes need no PromQL engine.
+        Returns None for an empty histogram; observations landing in
+        the +Inf bucket clamp to the highest finite bound."""
+        self._check_unlabelled()
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile must be in [0, 1], got {q}")
+        with self._lock:
+            counts = list(self._counts)
+            total = self._count
+        if total == 0:
+            return None
+        rank = q * total
+        cum = 0
+        lo = 0.0
+        for bound, c in zip(self.buckets, counts):
+            if c and cum + c >= rank:
+                frac = (rank - cum) / c
+                return lo + (bound - lo) * max(min(frac, 1.0), 0.0)
+            cum += c
+            lo = bound
+        return self.buckets[-1]
+
     def _samples(self):
         with self._lock:
             counts = list(self._counts)

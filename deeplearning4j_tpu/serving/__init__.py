@@ -1,0 +1,41 @@
+"""Inference serving: continuous batching with deadlines, admission
+control, graceful degradation, and drain (see ``serving.server``).
+
+Quickstart::
+
+    from deeplearning4j_tpu.serving import ModelServer
+
+    server = ModelServer(net, batch_limit=32, max_queue=256,
+                         default_deadline=0.2, preemption=True)
+    server.warmup([(4,)])                    # AOT: every bucket compiled
+    UIServer.getInstance().attach_serving(server)   # /healthz, /readyz
+    y = server.output(x)                     # or submit(x).get()
+    server.close()                           # drain + release handlers
+"""
+
+from deeplearning4j_tpu.serving.errors import (DeadlineExceededError,
+                                               ServerClosedError,
+                                               ServerDrainingError,
+                                               ServerOverloadedError,
+                                               ServerUnhealthyError,
+                                               ServingError)
+
+# serving.server pulls in jax; the error taxonomy above is part of the
+# wire contract and must stay importable from thin clients, so the
+# server symbols resolve lazily on first attribute access.
+_SERVER_SYMBOLS = ("ModelServer", "ServingRequest", "CircuitBreaker")
+
+
+def __getattr__(name):
+    if name in _SERVER_SYMBOLS:
+        from deeplearning4j_tpu.serving import server
+        return getattr(server, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")
+
+
+__all__ = [
+    "ModelServer", "ServingRequest", "CircuitBreaker", "ServingError",
+    "ServerOverloadedError", "DeadlineExceededError", "ServerDrainingError",
+    "ServerClosedError", "ServerUnhealthyError",
+]

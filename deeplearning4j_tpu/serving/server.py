@@ -1,0 +1,825 @@
+"""Robust inference serving: continuous batching hardened for failure.
+
+:class:`ModelServer` wraps a trained MultiLayerNetwork /
+ComputationGraph behind a request queue and supersedes
+:class:`~deeplearning4j_tpu.parallel.wrapper.ParallelInference` (kept
+for API parity) with the operational properties a production server
+needs from day one (TensorFlow system paper's serving architecture,
+PAPERS.md; TVM's ahead-of-time compilation for the bucketed shapes):
+
+- **Bounded admission.** The request queue is a hard bound; a full
+  queue rejects with :class:`~.errors.ServerOverloadedError` instead of
+  blocking producers unboundedly — queueing past capacity only grows
+  every request's latency.
+- **Per-request deadlines, end to end.** A request whose deadline
+  expires while queued is shed with
+  :class:`~.errors.DeadlineExceededError` *before* dispatch and its
+  batch slot reclaimed — one slow client cannot rot the batch for
+  everyone behind it. Requests are resolved exactly once (shed XOR
+  completed), enforced by a lock in :class:`ServingRequest`.
+- **Bucketed AOT warmup.** Coalesced batches pad to power-of-two
+  buckets aligned to the mesh's data width; :meth:`ModelServer.warmup`
+  pre-compiles every bucket x shape on the serving mesh *before*
+  ``ready`` flips true, reporting each signature through the W201
+  recompile-churn detector so zero steady-state recompiles is a
+  *measured* property (:meth:`recompiles_after_warmup`).
+- **Graceful degradation.** A failed or timed-out dispatch probes the
+  mesh (:class:`~deeplearning4j_tpu.parallel.elastic.DeviceMonitor`),
+  drops dead replicas, re-warms the buckets on the survivors, and
+  retries the SAME coalesced batch — bounded by ``max_retries``. A
+  :class:`CircuitBreaker` trips after ``breaker_threshold`` consecutive
+  dispatch failures: admissions fail fast with
+  :class:`~.errors.ServerUnhealthyError` until a half-open probe batch
+  succeeds.
+- **Graceful drain.** SIGTERM (via the
+  :class:`~deeplearning4j_tpu.train.resilience.SignalPreemption` seam)
+  or :meth:`drain` stops admissions, completes the in-flight batch,
+  fails queued-but-undispatched requests with the *retriable*
+  :class:`~.errors.ServerDrainingError`, and exits the serve loop
+  cleanly.
+
+Health surface: ``UIServer.attach_serving(server)`` exposes
+``/healthz`` (breaker state) and ``/readyz`` (warmed and not draining)
+next to the existing ``/metrics`` registry. Serving metrics:
+``dl4j_serving_requests_total{outcome=...}``,
+``dl4j_serving_latency_seconds`` (p50/p99 via ``Histogram.quantile``),
+``dl4j_serving_queue_depth``, ``dl4j_serving_batch_occupancy``,
+``dl4j_serving_batches_total``, ``dl4j_serving_breaker_state``,
+``dl4j_serving_replica_failures_total``,
+``dl4j_serving_warmup_seconds``.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import logging
+import threading
+import time
+import warnings
+from typing import Iterable, Optional, Sequence
+
+import jax
+import numpy as np
+
+from deeplearning4j_tpu import profiler as _prof
+from deeplearning4j_tpu.analysis import churn as _churn
+from deeplearning4j_tpu.parallel.elastic import (DispatchTimeoutError,
+                                                 DispatchWatchdog,
+                                                 shrink_mesh_on_dead)
+from deeplearning4j_tpu.parallel.mesh import DeviceMesh
+from deeplearning4j_tpu.serving.errors import (DeadlineExceededError,
+                                               ServerClosedError,
+                                               ServerDrainingError,
+                                               ServerOverloadedError,
+                                               ServerUnhealthyError)
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+_REG = _prof.get_registry()
+REQUESTS = _REG.counter(
+    "dl4j_serving_requests_total",
+    "Serving requests by terminal outcome: completed, failed (dispatch "
+    "error after retries), shed_deadline (expired while queued), "
+    "shed_overload (queue full at admission), shed_draining (queued at "
+    "drain), rejected_unhealthy (breaker open), rejected_closed",
+    labelnames=("outcome",))
+LATENCY = _REG.histogram(
+    "dl4j_serving_latency_seconds",
+    "End-to-end request latency, admission to completion (completed "
+    "requests only)")
+QUEUE_DEPTH = _REG.gauge(
+    "dl4j_serving_queue_depth",
+    "Requests currently queued for the next coalesced batch, per server "
+    "(a gauge two servers overwrote would flap between unrelated "
+    "depths; counters/histograms above aggregate process-wide, which "
+    "stays monotone and matches the one-server-per-process deployment)",
+    labelnames=("server",))
+OCCUPANCY = _REG.histogram(
+    "dl4j_serving_batch_occupancy",
+    "Live rows / padded bucket size per dispatched batch (1.0 = no "
+    "padding waste)",
+    buckets=(0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0))
+BATCHES = _REG.counter(
+    "dl4j_serving_batches_total",
+    "Coalesced batches dispatched (including retried-then-failed ones)")
+BREAKER_STATE = _REG.gauge(
+    "dl4j_serving_breaker_state",
+    "Circuit breaker state per server: 0 closed, 0.5 half-open (probe "
+    "in flight), 1 open (failing fast). Labelled so one process hosting "
+    "several servers (e.g. a replacement built mid-incident) cannot "
+    "mask another breaker's open state",
+    labelnames=("server",))
+REPLICA_FAILURES = _REG.counter(
+    "dl4j_serving_replica_failures_total",
+    "Serving dispatches that raised or exceeded replica_timeout (each "
+    "probes the mesh and retries on the survivors)")
+WARMUP_SECONDS = _REG.gauge(
+    "dl4j_serving_warmup_seconds",
+    "Wall time of the last warmup(): AOT compile of every bucket x "
+    "shape on the serving mesh")
+
+
+class ServingRequest:
+    """One queued inference request. Future-like: ``get(timeout)``.
+
+    Resolution is exactly-once by construction: ``_resolve`` takes an
+    internal lock and the first completion/failure wins — a request
+    shed on deadline can never ALSO be completed by a racing dispatch,
+    and ``resolutions`` (the win count) is pinned to <= 1 by tests.
+    """
+
+    __slots__ = ("features", "n", "deadline", "enqueued_at", "resolved_at",
+                 "resolutions", "_event", "_lock", "_resolved", "_result",
+                 "_error")
+
+    def __init__(self, features: np.ndarray, deadline: Optional[float],
+                 enqueued_at: float):
+        self.features = features
+        self.n = int(features.shape[0])
+        self.deadline = deadline          # absolute time.monotonic() or None
+        self.enqueued_at = enqueued_at
+        self.resolved_at: Optional[float] = None   # monotonic, set once
+        self.resolutions = 0
+        self._event = threading.Event()
+        self._lock = threading.Lock()
+        self._resolved = False
+        self._result = None
+        self._error: Optional[BaseException] = None
+
+    def expired(self, now: float) -> bool:
+        return self.deadline is not None and now >= self.deadline
+
+    def _resolve(self, result=None, error: BaseException = None) -> bool:
+        """First resolution wins; returns whether THIS call won."""
+        with self._lock:
+            if self._resolved:
+                return False
+            self._resolved = True
+            self.resolutions += 1
+            self.resolved_at = time.monotonic()
+            self._result = result
+            self._error = error
+        self._event.set()
+        return True
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def get(self, timeout: float = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("inference result not ready")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class CircuitBreaker:
+    """CLOSED -> (N consecutive failures) -> OPEN -> (cooldown) ->
+    HALF_OPEN -> one probe batch -> CLOSED on success, OPEN on failure.
+
+    ``clock`` is injectable so the cooldown is deterministic in tests.
+    Thread-safe: admission (client threads) and dispatch accounting
+    (the serve thread) share the state under one lock.
+    """
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+    def __init__(self, threshold: int = 5, cooldown: float = 5.0,
+                 clock=time.monotonic, name: str = "default"):
+        self.threshold = int(threshold)
+        self.cooldown = float(cooldown)
+        self.name = str(name)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._failures = 0
+        self._state = self.CLOSED
+        self._opened_at = 0.0
+        self._gauge()
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    @property
+    def consecutive_failures(self) -> int:
+        with self._lock:
+            return self._failures
+
+    def _gauge(self):
+        BREAKER_STATE.labels(server=self.name).set(
+            {self.CLOSED: 0.0, self.HALF_OPEN: 0.5,
+             self.OPEN: 1.0}[self._state])
+
+    def admit(self) -> bool:
+        """Admission-side gate: False while OPEN (fail fast). HALF_OPEN
+        admits — the probe batch is about to decide recovery."""
+        with self._lock:
+            if self._state == self.OPEN \
+                    and self._clock() - self._opened_at >= self.cooldown:
+                self._state = self.HALF_OPEN
+                self._gauge()
+            return self._state != self.OPEN
+
+    def retry_after(self) -> Optional[float]:
+        with self._lock:
+            if self._state != self.OPEN:
+                return None
+            return max(self.cooldown - (self._clock() - self._opened_at), 0.0)
+
+    def allow_dispatch(self) -> bool:
+        """Serve-loop gate: True unless OPEN with cooldown remaining.
+        The transition to HALF_OPEN happens here (or in admit) once the
+        cooldown elapses; the next dispatched batch is the probe."""
+        with self._lock:
+            if self._state == self.OPEN:
+                if self._clock() - self._opened_at < self.cooldown:
+                    return False
+                self._state = self.HALF_OPEN
+                self._gauge()
+            return True
+
+    def record_success(self):
+        with self._lock:
+            self._failures = 0
+            if self._state != self.CLOSED:
+                logger.info("circuit breaker: %s -> closed (probe batch "
+                            "succeeded)", self._state)
+            self._state = self.CLOSED
+            self._gauge()
+
+    def record_failure(self):
+        with self._lock:
+            self._failures += 1
+            if self._state == self.HALF_OPEN \
+                    or self._failures >= self.threshold:
+                if self._state != self.OPEN:
+                    logger.warning(
+                        "circuit breaker: open after %d consecutive "
+                        "dispatch failures (cooldown %.3gs)",
+                        self._failures, self.cooldown)
+                self._state = self.OPEN
+                self._opened_at = self._clock()
+                self._gauge()
+
+
+_SERVER_SEQ = itertools.count()
+
+
+class ModelServer:
+    """Continuous-batching model server over a device mesh (module doc).
+
+    Parameters
+    ----------
+    model : a trained/initialized network exposing ``output(x)``.
+    mesh : serving :class:`DeviceMesh` (default: data-parallel over all
+        devices). Buckets align to the mesh's ``data`` width so the
+        sharded dispatch always divides evenly.
+    batch_limit : max live rows per coalesced batch (= largest bucket).
+    max_queue : bound on queued requests; admission control beyond it.
+    coalesce_ms : how long the batcher waits for more arrivals once it
+        holds a partial batch.
+    default_deadline : per-request deadline in seconds applied when
+        ``submit`` passes none (None = no deadline).
+    max_retries : dispatch retries on the surviving replicas after a
+        forward failure/timeout.
+    replica_timeout : soft watchdog deadline per dispatch (None = no
+        supervision); grace defaults to 4x.
+    breaker_threshold / breaker_cooldown : circuit-breaker tuning.
+    drain_timeout : how long ``drain()``/``close()`` waits for the
+        in-flight batch before failing the queue itself.
+    input_dtype : requests are cast to this dtype at admission so the
+        steady-state jit signature is pinned (dtype drift = recompile).
+    preemption : a :class:`~deeplearning4j_tpu.train.resilience.
+        PreemptionSignal` polled between batches — ``True`` installs
+        :class:`~deeplearning4j_tpu.train.resilience.SignalPreemption`
+        (SIGTERM/SIGINT -> drain). Deterministic tests pass
+        ``StepPreemption(n)`` (drain after n batches).
+    faults : a :class:`~deeplearning4j_tpu.faults.FaultPlan` wiring the
+        serving fault seams (injected replica faults / device loss /
+        slow + hung forwards) for chaos tests.
+    rewarm_on_shrink : re-compile every warmed bucket on the survivor
+        mesh after dropping dead replicas (restores zero steady-state
+        recompiles before the retry dispatches).
+    name : stable label for this server's metrics (the
+        ``dl4j_serving_breaker_state{server=}`` gauge); defaults to a
+        process-unique ``serverN``.
+    """
+
+    def __init__(self, model, mesh: DeviceMesh = None, batch_limit: int = 32,
+                 max_queue: int = 128, coalesce_ms: float = 2.0,
+                 default_deadline: Optional[float] = None,
+                 max_retries: int = 2,
+                 replica_timeout: Optional[float] = None,
+                 breaker_threshold: int = 5, breaker_cooldown: float = 5.0,
+                 drain_timeout: float = 30.0, input_dtype=np.float32,
+                 preemption=None, faults=None, rewarm_on_shrink: bool = True,
+                 name: Optional[str] = None, _breaker_clock=time.monotonic):
+        self.model = model
+        # stable metrics label: distinguishes this server's breaker state
+        # from other servers' in the same process/registry
+        self.name = name if name is not None else f"server{next(_SERVER_SEQ)}"
+        self.mesh = mesh or DeviceMesh.data_parallel()
+        self.batch_limit = int(batch_limit)
+        self.max_queue = int(max_queue)
+        self.coalesce = float(coalesce_ms) / 1000.0
+        self.default_deadline = default_deadline
+        self.max_retries = int(max_retries)
+        self.replica_timeout = replica_timeout
+        self.drain_timeout = float(drain_timeout)
+        self.input_dtype = np.dtype(input_dtype)
+        self.rewarm_on_shrink = bool(rewarm_on_shrink)
+        self._faults = faults
+        self.breaker = CircuitBreaker(breaker_threshold, breaker_cooldown,
+                                      clock=_breaker_clock, name=self.name)
+        self._queue_gauge = QUEUE_DEPTH.labels(server=self.name)
+        # deadline=None -> unsupervised inline dispatch (fault holds still
+        # honored); warmup=0 because server.warmup() pre-compiles every
+        # bucket — a steady-state dispatch that compiles IS a defect here
+        self._watchdog = DispatchWatchdog(replica_timeout, plan=faults,
+                                          warmup=0)
+        self._churn = _churn.get_churn_detector()
+        self._cond = threading.Condition()
+        self._dq: "collections.deque[ServingRequest]" = collections.deque()
+        self._draining = False
+        self._drained = False
+        self._closed = False
+        self._drain_requested = threading.Event()
+        self._warmed = False
+        self._warm_shapes: list = []
+        self._warm_sig_count = 0
+        self._died = False
+        self._batches = 0
+        self.counts: "collections.Counter[str]" = collections.Counter()
+        self._preemption = None
+        self._preemption_installed = False
+        if preemption is not None and preemption is not False:
+            from deeplearning4j_tpu.train import resilience as _res
+            self._preemption = _res.SignalPreemption(
+                on_request=self._drain_requested.set) \
+                if preemption is True else preemption
+            install = getattr(self._preemption, "install", None)
+            if install is not None:
+                self._preemption_installed = bool(install())
+        self._worker = threading.Thread(target=self._serve, daemon=True,
+                                        name="dl4j-serving")
+        self._worker.start()
+
+    # ------------------------------------------------------------- buckets
+    def data_width(self) -> int:
+        return max(1, self.mesh.size("data"))
+
+    def buckets(self) -> list:
+        """Padded batch sizes this server compiles: the mesh's data
+        width doubling up to (at least) ``batch_limit`` — every bucket
+        divides the data axis, so the sharded dispatch never pads
+        unevenly or fails placement."""
+        w = self.data_width()
+        out = [w]
+        while out[-1] < self.batch_limit:
+            out.append(out[-1] * 2)
+        return out
+
+    def _bucket_for(self, total: int) -> int:
+        for b in self.buckets():
+            if b >= total:
+                return b
+        return self.buckets()[-1]
+
+    # ----------------------------------------------------------- admission
+    def submit(self, x, deadline: Optional[float] = None) -> ServingRequest:
+        """Queue one request. ``x``: [n, ...features] with n <=
+        ``batch_limit``; ``deadline``: seconds from now (overrides
+        ``default_deadline``). Raises the structured admission errors
+        instead of ever blocking the caller."""
+        x = np.asarray(x, dtype=self.input_dtype)
+        if x.ndim < 1:
+            raise ValueError("request features need a leading batch dim")
+        if x.shape[0] > self.batch_limit:
+            raise ValueError(
+                f"request rows {x.shape[0]} exceed batch_limit "
+                f"{self.batch_limit} — split the request (oversize batches "
+                "would compile an unwarmed bucket)")
+        if self._warmed:
+            fshape = tuple(int(d) for d in x.shape[1:])
+            if fshape not in self._warm_shapes:
+                # a novel shape would XLA-compile under the steady-state
+                # watchdog (warmup=0): past replica_timeout that reads as
+                # a hung replica, burns retries, and feeds the breaker —
+                # one bad-shape client must not trip it for everyone
+                raise ValueError(
+                    f"request feature shape {fshape} was not warmed "
+                    f"(warmed: {self._warm_shapes}) — call "
+                    "warmup([shape]) before serving it")
+        now = time.monotonic()
+        dl = self.default_deadline if deadline is None else deadline
+        req = ServingRequest(x, now + dl if dl is not None else None, now)
+        with self._cond:
+            if self._closed:
+                self._count("rejected_closed")
+                raise ServerClosedError()
+            if self._draining or self._drain_requested.is_set():
+                self._count("shed_draining")
+                raise ServerDrainingError()
+            if not self.breaker.admit():
+                self._count("rejected_unhealthy")
+                raise ServerUnhealthyError(
+                    self.breaker.consecutive_failures,
+                    retry_after=self.breaker.retry_after())
+            if len(self._dq) >= self.max_queue:
+                self._count("shed_overload")
+                raise ServerOverloadedError(len(self._dq), self.max_queue)
+            self._dq.append(req)
+            self._queue_gauge.set(len(self._dq))
+            self._cond.notify()
+        return req
+
+    def output(self, x, timeout: float = 30.0,
+               deadline: Optional[float] = None) -> np.ndarray:
+        """Synchronous single-request API (ref: ParallelInference.output)."""
+        return self.submit(x, deadline=deadline).get(timeout)
+
+    def _count(self, outcome: str):
+        # _cond wraps an RLock: callers already holding it (submit, the
+        # shed paths) re-enter; the serve/drainer threads serialize here
+        # so concurrent same-key increments cannot lose one
+        with self._cond:
+            self.counts[outcome] += 1
+        REQUESTS.labels(outcome=outcome).inc()
+
+    # ------------------------------------------------------------- warmup
+    def warmup(self, shapes: Iterable[Sequence[int]],
+               strict: bool = False) -> "ModelServer":
+        """AOT-compile every bucket x feature shape on the serving mesh
+        BEFORE taking traffic: ``shapes`` is an iterable of per-request
+        feature shapes WITHOUT the leading batch dim (e.g. ``[(4,)]``
+        or ``[(3, 224, 224)]``). Runs the serving-config lint first
+        (``strict=True`` raises on E-codes, else warnings), then flips
+        ``ready`` true. Each compile registers its signature with the
+        W201 churn detector; :meth:`recompiles_after_warmup` measures
+        steady-state compiles against this baseline."""
+        shapes = [tuple(int(d) for d in s) for s in shapes]
+        report = self.validate(shapes=shapes)
+        if strict:
+            report.raise_if_errors()
+        for d in report.diagnostics:
+            warnings.warn(f"serving config: {d.code}: {d.message}",
+                          stacklevel=2)
+        elapsed = self._compile_buckets(shapes)
+        WARMUP_SECONDS.set(elapsed)
+        for s in shapes:
+            if s not in self._warm_shapes:
+                self._warm_shapes.append(s)
+        self._warmed = True
+        logger.info("serving warmup: %d bucket(s) x %d shape(s) compiled "
+                    "in %.3fs on %d device(s)", len(self.buckets()),
+                    len(shapes), elapsed, len(self.mesh.devices))
+        return self
+
+    def _compile_buckets(self, shapes) -> float:
+        """AOT-compile every bucket x feature shape on the CURRENT mesh
+        and re-base the zero-recompile churn baseline — shared by
+        :meth:`warmup` and the post-shrink re-warm so the two cannot
+        drift. Returns the wall seconds spent."""
+        t0 = time.perf_counter()
+        for shape in shapes:
+            for b in self.buckets():
+                self._forward_raw(
+                    np.zeros((b,) + tuple(shape), self.input_dtype))
+        self._warm_sig_count = self._churn.signature_count(
+            "serving:forward", owner=self)
+        return time.perf_counter() - t0
+
+    def recompiles_after_warmup(self) -> int:
+        """Distinct forward signatures compiled since the last
+        ``warmup()``/re-warm — the steady-state pin is 0."""
+        if not self._warmed:
+            return 0
+        return self._churn.signature_count("serving:forward",
+                                           owner=self) - self._warm_sig_count
+
+    def validate(self, shapes=None, hbm_gb=None):
+        """Static serving-config lint: buckets x mesh x HBM (analysis.
+        serving) plus any W201 churn findings recorded for this server."""
+        from deeplearning4j_tpu.analysis.serving import lint_serving
+        return lint_serving(self.model, self.buckets(), mesh=self.mesh,
+                            shapes=shapes, hbm_gb=hbm_gb,
+                            input_dtype=self.input_dtype,
+                            extra=self._churn.diagnostics_for(owner=self))
+
+    # ------------------------------------------------------- health surface
+    @property
+    def ready(self) -> bool:
+        """True once warmed and still admitting (what /readyz serves).
+        An OPEN breaker rejects every submit, so readiness goes false
+        with it — a load balancer pulls the replica from rotation; once
+        the cooldown elapses the breaker reads HALF_OPEN (admitting
+        again) and readiness returns so the probe batch can flow."""
+        return (self._warmed and not self._draining and not self._closed
+                and not self._drain_requested.is_set()
+                and self._worker.is_alive()
+                # admit() is the same lazy OPEN->HALF_OPEN gate submit()
+                # uses: it mutates nothing except that time-driven
+                # transition, so /readyz and admission cannot disagree
+                and self.breaker.admit())
+
+    @property
+    def healthy(self) -> bool:
+        """True unless the breaker is open or the serve loop died (what
+        /healthz serves)."""
+        return (self.breaker.state != CircuitBreaker.OPEN
+                and not self._died
+                and (self._worker.is_alive() or self._drained
+                     or self._closed))
+
+    @property
+    def state(self) -> str:
+        if self._closed:
+            return "closed"
+        if self._draining or self._drain_requested.is_set():
+            return "draining"
+        if not self._warmed:
+            return "warming"
+        return "serving"
+
+    def queue_depth(self) -> int:
+        with self._cond:
+            return len(self._dq)
+
+    def stats(self) -> dict:
+        """Operational snapshot: latency quantiles (process-wide
+        histogram), per-server outcome counts, queue/breaker state."""
+        return {
+            "state": self.state,
+            "ready": self.ready,
+            "healthy": self.healthy,
+            "queue_depth": self.queue_depth(),
+            "batches": self._batches,
+            "breaker": self.breaker.state,
+            "counts": dict(self.counts),
+            "buckets": self.buckets(),
+            "recompiles_after_warmup": self.recompiles_after_warmup(),
+            "latency_p50": LATENCY.quantile(0.5),
+            "latency_p99": LATENCY.quantile(0.99),
+        }
+
+    # ------------------------------------------------------------ serve loop
+    def _serve(self):
+        try:
+            while True:
+                if self._preemption is not None \
+                        and self._preemption.requested(self._batches):
+                    self._drain_requested.set()
+                with self._cond:
+                    if self._closed or self._drain_requested.is_set():
+                        return
+                    if not self._dq:
+                        # bounded wait so drain/preemption/breaker checks
+                        # run even on an idle server
+                        self._cond.wait(0.05)
+                        continue
+                if not self.breaker.allow_dispatch():
+                    # failing fast: do not dispatch, but keep shedding
+                    # requests whose deadlines expire while we wait
+                    self._shed_expired()
+                    time.sleep(0.005)
+                    continue
+                batch = self._build_batch()
+                if batch:
+                    self._dispatch(batch)
+        except BaseException:
+            self._died = True
+            logger.exception("serving loop died — failing queued requests")
+            raise
+        finally:
+            self._finish_drain()
+
+    def _shed(self, req: ServingRequest, now: float):
+        waited = now - req.enqueued_at
+        deadline = (req.deadline - req.enqueued_at
+                    if req.deadline is not None else 0.0)
+        if req._resolve(error=DeadlineExceededError(waited, deadline)):
+            self._count("shed_deadline")
+
+    def _shed_expired(self):
+        """Shed every expired request anywhere in the queue — while the
+        breaker is open nothing is dispatching, so an expired request
+        behind an unexpired head must still fail at its deadline, not
+        when the cooldown elapses."""
+        now = time.monotonic()
+        with self._cond:
+            if any(r.expired(now) for r in self._dq):
+                live = collections.deque()
+                for r in self._dq:
+                    if r.expired(now):
+                        self._shed(r, now)
+                    else:
+                        live.append(r)
+                self._dq = live
+            self._queue_gauge.set(len(self._dq))
+
+    def _build_batch(self) -> list:
+        """Pop up to ``batch_limit`` live rows, shedding expired
+        requests as they surface (their slots are reclaimed — the batch
+        keeps filling), waiting up to the coalesce window for more
+        arrivals once it holds a partial batch."""
+        batch: list = []
+        total = 0
+        t_end = None
+        shape = None
+        while True:
+            now = time.monotonic()
+            with self._cond:
+                while self._dq and self._dq[0].expired(now):
+                    self._shed(self._dq.popleft(), now)
+                while self._dq and total < self.batch_limit \
+                        and total + self._dq[0].n <= self.batch_limit \
+                        and (shape is None
+                             or self._dq[0].features.shape[1:] == shape):
+                    # one batch = one feature shape: warmup() supports
+                    # several shapes, and mixed shapes cannot concatenate
+                    req = self._dq.popleft()
+                    if req.expired(now):
+                        self._shed(req, now)
+                        continue
+                    batch.append(req)
+                    total += req.n
+                    shape = req.features.shape[1:]
+                self._queue_gauge.set(len(self._dq))
+                head_full = bool(self._dq) and (
+                    total + self._dq[0].n > self.batch_limit
+                    or (shape is not None
+                        and self._dq[0].features.shape[1:] != shape))
+            if not batch:
+                return batch
+            if total >= self.batch_limit or head_full:
+                return batch
+            if t_end is None:
+                t_end = now + self.coalesce
+            remaining = t_end - now
+            if remaining <= 0:
+                return batch
+            if self._drain_requested.is_set() or self._closed:
+                return batch    # dispatch what we hold, then drain
+            with self._cond:
+                if not self._dq:
+                    self._cond.wait(min(remaining, 0.01))
+
+    def _dispatch(self, batch: list):
+        total = sum(r.n for r in batch)
+        bucket = self._bucket_for(total)
+        try:
+            # inside the try: ANY failure building or running the batch
+            # must resolve its requests, never kill the serve loop
+            feats = np.concatenate([r.features for r in batch], axis=0)
+            out = self._forward(feats)
+        except Exception as e:
+            self.breaker.record_failure()
+            for req in batch:
+                if req._resolve(error=e):
+                    self._count("failed")
+        else:
+            self.breaker.record_success()
+            now = time.monotonic()
+            pos = 0
+            for req in batch:
+                if req._resolve(result=out[pos:pos + req.n]):
+                    LATENCY.observe(now - req.enqueued_at)
+                    self._count("completed")
+                pos += req.n
+        OCCUPANCY.observe(total / float(bucket))
+        self._batches += 1
+        BATCHES.inc()
+
+    # ------------------------------------------------------------- forward
+    def _forward(self, feats: np.ndarray) -> np.ndarray:
+        """One coalesced batch (live rows only) through the sharded
+        forward, with bounded retry on a surviving replica set after a
+        failure or timeout (mirrors ParallelInference, plus breaker
+        accounting upstream). Padding to the bucket happens PER ATTEMPT:
+        a mesh shrink between attempts changes the ladder (e.g. 8
+        survivors -> 7), and a batch padded for the old data width could
+        not be placed on the new one."""
+        from deeplearning4j_tpu.parallel.wrapper import InferenceFailedError
+        total = int(feats.shape[0])
+        last = None
+        attempts = 0
+        for _ in range(self.max_retries + 1):
+            attempts += 1
+            if not self._warmed:
+                # pre-warmup traffic legitimately compiles; the
+                # zero-leniency steady-state watchdog must not read the
+                # compile as a hung replica and feed the breaker
+                self._watchdog.begin_attempt(1)
+            bucket = self._bucket_for(total)
+            padded = feats
+            if bucket > total:
+                padded = np.concatenate(
+                    [feats, np.zeros((bucket - total,) + feats.shape[1:],
+                                     feats.dtype)], axis=0)
+            try:
+                out = self._watchdog.run(
+                    lambda p=padded: self._forward_once(p),
+                    self._batches + 1)
+                return out[:total]
+            except (Exception, DispatchTimeoutError) as e:
+                last = e
+                REPLICA_FAILURES.inc()
+                warnings.warn(
+                    f"serving dispatch failure (attempt {attempts}): "
+                    f"{type(e).__name__}: {e} — probing devices and "
+                    "retrying on the survivors", stacklevel=2)
+                self._drop_dead_replicas()
+        raise InferenceFailedError(attempts, last)
+
+    def _forward_once(self, feats: np.ndarray) -> np.ndarray:
+        if self._faults is not None:
+            self._faults.serving_forward(
+                self._batches + 1, [d.id for d in self.mesh.devices])
+        return self._forward_raw(feats)
+
+    def _forward_raw(self, feats: np.ndarray) -> np.ndarray:
+        # signature includes the device set: a mesh rebuild recompiles
+        # even at identical shapes, and the churn accounting must see it
+        fp = (tuple(d.id for d in self.mesh.devices),
+              _churn.array_fingerprint(feats))
+        self._churn.record("serving:forward", fp, owner=self)
+        with self.mesh:
+            x = jax.device_put(feats, self.mesh.batch_sharding(feats.ndim))
+            return np.asarray(self.model.output(x))
+
+    def _drop_dead_replicas(self):
+        """Probe the serving mesh; rebuild on the survivors when devices
+        are dead (the shared elastic shrink guard — tensor-parallel
+        meshes refuse), then re-warm the buckets there so the retry —
+        and all steady-state traffic after it — stays compile-free."""
+        new_mesh = shrink_mesh_on_dead(self.mesh, plan=self._faults,
+                                       context="serving")
+        if new_mesh is None:
+            return
+        self.mesh = new_mesh
+        if self._warmed and self.rewarm_on_shrink:
+            # the re-warm itself compiles unsupervised (_forward_raw does
+            # not go through the watchdog), so the retry stays covered
+            elapsed = self._compile_buckets(self._warm_shapes)
+            logger.info("serving: re-warmed %d bucket(s) on the survivor "
+                        "mesh in %.3fs", len(self.buckets()), elapsed)
+        else:
+            # no re-warm: the retry legitimately compiles ONE program on
+            # the shrunk mesh — run that dispatch unsupervised (the
+            # steady-state watchdog warmup is 0 on purpose)
+            self._watchdog.begin_attempt(1)
+
+    # --------------------------------------------------------------- drain
+    def drain(self, timeout: float = None) -> "ModelServer":
+        """Stop admissions, let the in-flight batch complete, fail every
+        queued-but-undispatched request with the retriable
+        :class:`ServerDrainingError`, and stop the serve loop. Safe to
+        call from any thread and idempotent; SIGTERM triggers the same
+        path through the preemption seam."""
+        self._drain_requested.set()
+        with self._cond:
+            self._cond.notify_all()
+        if threading.current_thread() is not self._worker:
+            self._worker.join(timeout if timeout is not None
+                              else self.drain_timeout)
+            if self._worker.is_alive():
+                # the in-flight dispatch is stuck past the drain budget:
+                # fail the queue ourselves (resolution stays exactly-once)
+                warnings.warn("drain: serve loop still busy after "
+                              "timeout — failing queued requests directly",
+                              stacklevel=2)
+                self._finish_drain()
+        return self
+
+    def _finish_drain(self):
+        with self._cond:
+            self._draining = True
+            queued = list(self._dq)
+            self._dq.clear()
+            self._queue_gauge.set(0)
+            self._cond.notify_all()
+        for req in queued:
+            if req._resolve(error=ServerDrainingError()):
+                self._count("shed_draining")
+        self._drained = True
+
+    def close(self):
+        """Drain, then release the preemption handlers. Idempotent;
+        also the context-manager exit."""
+        if self._closed:
+            return
+        self.drain()
+        self._closed = True
+        if self._preemption_installed:
+            uninstall = getattr(self._preemption, "uninstall", None)
+            if uninstall is not None:
+                uninstall()
+            self._preemption_installed = False
+
+    def __enter__(self) -> "ModelServer":
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

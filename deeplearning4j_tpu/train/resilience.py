@@ -234,10 +234,20 @@ class StepPreemption(PreemptionSignal):
 class SignalPreemption(PreemptionSignal):
     """SIGTERM/SIGINT -> preemption flag. Installed for the duration of
     a resilient ``fit()`` (main thread only — signal handlers cannot be
-    installed elsewhere); previous handlers are restored on close."""
+    installed elsewhere); previous handlers are restored on close.
 
-    def __init__(self, signals=(_signal.SIGTERM, _signal.SIGINT)):
+    ``on_request`` is an optional zero-arg callback invoked from the
+    handler so a consumer polling from ANOTHER thread (the model
+    server's serve loop reacting to SIGTERM with a drain) wakes
+    immediately instead of at its next poll. It must be cheap and
+    non-blocking — setting a ``threading.Event`` is the intended use;
+    exceptions are swallowed (a failing callback must not break the
+    signal handler)."""
+
+    def __init__(self, signals=(_signal.SIGTERM, _signal.SIGINT),
+                 on_request=None):
         self.signals = signals
+        self.on_request = on_request
         self._event = threading.Event()
         self._prev: Dict[int, Any] = {}
 
@@ -258,6 +268,11 @@ class SignalPreemption(PreemptionSignal):
 
     def _handler(self, signum, frame):
         self._event.set()
+        if self.on_request is not None:
+            try:
+                self.on_request()
+            except Exception:
+                pass
 
     def requested(self, step: int) -> bool:
         return self._event.is_set()

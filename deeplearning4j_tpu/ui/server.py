@@ -21,9 +21,29 @@ perf PR cites — see ``deeplearning4j_tpu.profiler``):
 
 - ``GET /metrics``  -> Prometheus text exposition (v0.0.4) of the global
   metrics registry: op-dispatch counters, compile-cache hits/misses,
-  H2D/D2H bytes, train step / data-wait histograms, throughput gauges.
+  H2D/D2H bytes, train step / data-wait histograms, throughput gauges,
+  serving counters. Served regardless of whether a StatsStorage is
+  attached — ``detach()`` removes the dashboard's storage but keeps the
+  scrape endpoint (and the server) alive.
 - ``GET /trace``    -> Chrome Trace Event Format JSON of the global span
   tracer (open in ui.perfetto.dev or chrome://tracing).
+
+Serving health surface (``UIServer.attach_serving(model_server)``):
+
+- ``GET /healthz``  -> 200 while the attached model server's circuit
+  breaker is closed/half-open (or no server is attached — process
+  liveness), 503 when the breaker is open or the serve loop died.
+- ``GET /readyz``   -> 200 only when the attached server is warmed
+  (every bucket AOT-compiled) and admitting; 503 while warming,
+  draining, closed, or with no server attached — wire this as the load
+  balancer's readiness check so a replica drains out of rotation
+  before SIGTERM lands.
+
+Storage/serving references live as *instance attributes on the HTTP
+server object* (one atomic attribute read per request), not on the
+handler class: re-``attach()`` used to reassign a shared class
+attribute while serving threads read it — a data race two UIServer
+instances could also trample.
 """
 
 from __future__ import annotations
@@ -157,7 +177,15 @@ def _sanitize(x):
 
 
 class _Handler(BaseHTTPRequestHandler):
-    storage: StatsStorage = None  # set per-server via subclass
+    @property
+    def storage(self) -> Optional[StatsStorage]:
+        # instance attribute on the serving HTTPServer: one atomic read,
+        # swapped by attach()/detach() without touching shared class state
+        return getattr(self.server, "dl4j_storage", None)
+
+    @property
+    def serving(self):
+        return getattr(self.server, "dl4j_serving", None)
 
     def log_message(self, *a):   # silence request logging
         pass
@@ -194,8 +222,31 @@ class _Handler(BaseHTTPRequestHandler):
             return self._body(
                 _prof.get_tracer().export_chrome_trace().encode(),
                 "application/json")
+        if url.path == "/healthz":
+            sv = self.serving
+            if sv is None:
+                return self._json({"status": "ok", "serving": "none"})
+            if sv.healthy:
+                return self._json({"status": "ok", "state": sv.state,
+                                   "breaker": sv.breaker.state})
+            return self._json({"status": "unhealthy", "state": sv.state,
+                               "breaker": sv.breaker.state}, 503)
+        if url.path == "/readyz":
+            sv = self.serving
+            if sv is None:
+                return self._json({"ready": False,
+                                   "reason": "no model server attached"},
+                                  503)
+            if sv.ready:
+                return self._json({"ready": True, "state": sv.state,
+                                   "queue_depth": sv.queue_depth()})
+            return self._json({"ready": False, "state": sv.state}, 503)
         if url.path == "/":
             return self._body(_PAGE.encode(), "text/html")
+        if st is None:
+            # dashboard endpoints need a StatsStorage; /metrics, /trace
+            # and the health endpoints above stay live without one
+            return self._json({"error": "no stats storage attached"}, 503)
         if url.path == "/api/sessions":
             return self._json(st.listSessionIDs())
         sid = q.get("session", "")
@@ -245,7 +296,6 @@ class UIServer:
 
     def __init__(self, port: int = 9000):
         self.port = port
-        self._storage: Optional[StatsStorage] = None
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -255,21 +305,43 @@ class UIServer:
             cls._instance = cls(port)
         return cls._instance
 
-    def attach(self, storage: StatsStorage):
-        self._storage = storage
+    def _ensure_httpd(self) -> ThreadingHTTPServer:
         if self._httpd is None:
-            handler = type("BoundHandler", (_Handler,), {"storage": storage})
-            self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), handler)
+            self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port),
+                                              _Handler)
             self.port = self._httpd.server_address[1]   # resolve port 0
             self._thread = threading.Thread(target=self._httpd.serve_forever,
                                             daemon=True)
             self._thread.start()
-        else:
-            self._httpd.RequestHandlerClass.storage = storage
+        return self._httpd
+
+    def attach(self, storage: StatsStorage):
+        """Attach (or swap) the dashboard's StatsStorage; starts the
+        HTTP server on first use. The reference lives on the server
+        object, so re-attach is one atomic attribute write — no shared
+        handler-class state for serving threads to race on."""
+        self._ensure_httpd().dl4j_storage = storage
+        return self
+
+    def attach_serving(self, model_server):
+        """Expose a :class:`~deeplearning4j_tpu.serving.ModelServer`'s
+        health at ``/healthz`` + ``/readyz`` (starts the HTTP server if
+        needed — serving works without any StatsStorage attached)."""
+        self._ensure_httpd().dl4j_serving = model_server
         return self
 
     def detach(self):
-        self.stop()
+        """Detach the stats storage ONLY: the dashboard endpoints go
+        503 but the server — and ``/metrics``, ``/trace``, the health
+        endpoints — keeps running. Call :meth:`stop` to shut down."""
+        if self._httpd is not None:
+            self._httpd.dl4j_storage = None
+        return self
+
+    def detach_serving(self):
+        if self._httpd is not None:
+            self._httpd.dl4j_serving = None
+        return self
 
     def stop(self):
         if self._httpd is not None:
