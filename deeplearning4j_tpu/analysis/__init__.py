@@ -28,6 +28,13 @@ validation is the same shape of tool):
   propagation over ``_Node`` graphs plus ``E151`` undefined input,
   ``E152`` shape conflict, ``E153`` bad loss variable, ``W151`` dangling
   placeholder, ``W152`` unused variable, ``W153`` no training op.
+- :mod:`concurrency` — AST-level thread-safety lints over source files
+  or modules (:func:`analyze_concurrency`, ``--concurrency`` on the
+  CLI, and the ``tools/lint.py`` self-lint gate): ``E201`` unguarded
+  cross-thread mutation, ``E202`` read-modify-write outside a lock,
+  ``E203`` lock-order cycle, ``W210`` wall clock in deadline math,
+  ``W211`` un-looped ``Condition.wait``, ``W212`` unjoined worker
+  thread, ``W213`` double-checked initialization race.
 - :mod:`churn` — runtime detector behind the fit/compile dispatch seams:
   ``dl4j_recompiles_total{site=...}`` in the profiler registry plus a
   ``W201`` diagnostic when one site crosses the signature threshold.
@@ -43,6 +50,7 @@ is pure-static and runs anywhere the configs import.
 """
 
 from deeplearning4j_tpu.analysis.analyzer import analyze
+from deeplearning4j_tpu.analysis.concurrency import analyze_concurrency
 from deeplearning4j_tpu.analysis.churn import (RecompileChurnDetector,
                                                array_fingerprint,
                                                get_churn_detector)
@@ -57,7 +65,8 @@ from deeplearning4j_tpu.analysis.samediff import analyze_samediff
 from deeplearning4j_tpu.analysis.serving import lint_serving
 
 __all__ = [
-    "analyze", "analyze_samediff", "Diagnostic", "Severity",
+    "analyze", "analyze_concurrency", "analyze_samediff", "Diagnostic",
+    "Severity",
     "ValidationReport", "ModelValidationError", "DIAGNOSTIC_CODES",
     "MeshSpec", "PipelineSpec", "normalize_code", "RecompileChurnDetector",
     "get_churn_detector", "array_fingerprint", "lint_serving",

@@ -91,6 +91,12 @@ def main(argv=None) -> int:
                          "module:attr")
     ap.add_argument("--zoo", action="store_true",
                     help="lint every model-zoo architecture")
+    ap.add_argument("--concurrency", metavar="PATH_OR_MODULE",
+                    action="append", default=[],
+                    help="run the E2xx/W21x thread-safety lints over a "
+                         "source file, directory, or importable module "
+                         "name (pure AST — nothing is imported or "
+                         "executed; repeatable)")
     ap.add_argument("--batch-size", type=int, default=None,
                     help="planned global batch size (enables the W103 "
                          "mesh-divisibility lint, or E101 with --mesh)")
@@ -135,6 +141,26 @@ def main(argv=None) -> int:
             ap.error(f"--severity: {e}")
     if args.hbm_gb is not None and not args.mesh:
         ap.error("--hbm-gb needs a mesh declaration: pass --mesh as well")
+
+    if args.concurrency:
+        if args.targets or args.zoo:
+            ap.error("--concurrency lints source, not models: pass either "
+                     "--concurrency targets or model targets, not both")
+        # source-level lints: resolved without importing the target (and
+        # without importing the model/zoo stack at all)
+        from deeplearning4j_tpu.analysis.concurrency import \
+            analyze_concurrency
+        failed = 0
+        for target in args.concurrency:
+            try:
+                report = analyze_concurrency(target, suppress=suppress,
+                                             severity_overrides=overrides)
+            except FileNotFoundError as e:
+                ap.error(f"--concurrency: {e}")
+            print(report.format())
+            if not report.ok(warnings_as_errors=not args.warnings_ok):
+                failed += 1
+        return 1 if failed else 0
 
     targets: List[Tuple[str, object]] = []
     if args.zoo:

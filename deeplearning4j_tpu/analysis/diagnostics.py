@@ -98,6 +98,35 @@ DIAGNOSTIC_CODES = {
     "DL4J-W110": "serving bucket ladder: duplicate buckets or more buckets "
                  "than the threshold — each bucket x input shape is one "
                  "compiled program (warmup time, executable-cache HBM)",
+    # E2xx/W21x concurrency lints (analysis/concurrency.py): AST-level
+    # thread-safety analysis of the framework's own (or user) source.
+    "DL4J-E201": "unguarded cross-thread mutation: an attribute shared "
+                 "with a worker thread is assigned/mutated outside any "
+                 "lock, so other threads can observe or clobber "
+                 "intermediate state",
+    "DL4J-E202": "unguarded read-modify-write: `self.x += 1` (or an "
+                 "equivalent read-then-assign) on shared state outside "
+                 "any lock — two racing writers lose one update (the "
+                 "lost-increment class)",
+    "DL4J-E203": "lock-order cycle: the static lock-acquisition graph "
+                 "contains a cycle, so two threads taking the locks in "
+                 "opposite orders deadlock",
+    "DL4J-W210": "wall clock in deadline arithmetic: time.time() (which "
+                 "NTP can step) feeds timeout/deadline math — use "
+                 "time.monotonic() for durations",
+    "DL4J-W211": "Condition.wait() outside a predicate loop: spurious "
+                 "wakeups / stolen notifications return with the "
+                 "condition still false",
+    "DL4J-W212": "unjoined worker thread: a stored thread is started but "
+                 "no close/drain path joins it, racing shutdown against "
+                 "its last writes",
+    "DL4J-W213": "double-checked/lazy initialization race: `if self.x is "
+                 "None: self.x = ...` without holding a lock (or without "
+                 "re-checking under it) lets two threads both initialize",
+    "DL4J-E299": "unparseable source: the concurrency analyzer could not "
+                 "parse this file, so none of its classes were checked — "
+                 "a distinct code so suppressing a real finding family "
+                 "never hides a syntax error",
     # E15x/W15x SameDiff graph lints (analysis/samediff.py).
     "DL4J-E151": "undefined graph input: an op node consumes a name no "
                  "variable, constant, placeholder, or node output defines",

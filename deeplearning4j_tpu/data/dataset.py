@@ -24,6 +24,7 @@ import jax
 import numpy as np
 
 from deeplearning4j_tpu import profiler as _prof
+from deeplearning4j_tpu.utils.concurrent import ErrorLatch as _ErrorLatch
 
 # Registered at import so GET /metrics always exposes the input-pipeline
 # series (zero until a prefetching iterator runs) — a flat-zero
@@ -327,7 +328,7 @@ class AsyncDataSetIterator(DataSetIterator):
         self._thread = None
         self._next_item = None
         self._stop = None
-        self._pending_error = None
+        self._pending = _ErrorLatch()
         self.reset()
 
     def _pull_with_retry(self, stop):
@@ -345,10 +346,9 @@ class AsyncDataSetIterator(DataSetIterator):
             # surface on the consumer thread: letting the exception kill
             # the worker would enqueue _END and silently truncate the
             # stream (e.g. an evaluation quietly computed on 2 of 100
-            # batches). Also recorded so close() can propagate an error
+            # batches). Also latched so close() can propagate an error
             # the consumer never pulled.
-            if self._pending_error is None:
-                self._pending_error = e
+            self._pending.record(e)
             _offer_until_stopped(q, _PrefetchFailure(e), stop)
         finally:
             # block-put the END sentinel with the same stop-checked retry as
@@ -370,7 +370,7 @@ class AsyncDataSetIterator(DataSetIterator):
 
     def reset(self):
         self._shutdown_worker()
-        self._pending_error = None      # explicit restart: fresh slate
+        self._pending.clear()           # explicit restart: fresh slate
         self.base.reset()
         self._restart_worker()
 
@@ -391,7 +391,7 @@ class AsyncDataSetIterator(DataSetIterator):
         the consumer stopped pulling must not vanish."""
         self._shutdown_worker()
         self._next_item = self._END
-        err, self._pending_error = self._pending_error, None
+        err = self._pending.take()
         if err is not None:
             raise err
 
@@ -416,8 +416,7 @@ class AsyncDataSetIterator(DataSetIterator):
         item = self._next_item
         if isinstance(item, _PrefetchFailure):
             self._next_item = self._END
-            if self._pending_error is item.error:
-                self._pending_error = None      # delivered here, not close()
+            self._pending.delivered(item.error)  # raised here, not close()
             raise item.error
         self._next_item = self._queue.get()
         if _prof.instrumentation_active():
@@ -436,7 +435,7 @@ class AsyncDataSetIterator(DataSetIterator):
 
     def seek(self, cursor) -> None:
         self._shutdown_worker()
-        self._pending_error = None
+        self._pending.clear()
         self.base.seek(cursor)
         self._restart_worker()
 
@@ -549,7 +548,7 @@ class DevicePrefetcher:
                                               retry_backoff)
         self._src = group_into_megabatches(batches, steps_per_dispatch)
         self._done = False
-        self._pending_error = None
+        self._pending = _ErrorLatch()
         self._thread = threading.Thread(target=self._worker, daemon=True)
         self._thread.start()
 
@@ -573,9 +572,8 @@ class DevicePrefetcher:
                 if not self._offer(self._stage(item)):
                     return
         except BaseException as e:  # surface in the consumer, not the log
-            # record first so a close() racing this offer still sees it
-            if self._pending_error is None:
-                self._pending_error = e
+            # latch first so a close() racing this offer still sees it
+            self._pending.record(e)
             self._offer(_PrefetchFailure(e))
         finally:
             self._offer(self._END)
@@ -595,8 +593,7 @@ class DevicePrefetcher:
             raise StopIteration
         if isinstance(item, _PrefetchFailure):
             self._done = True
-            if self._pending_error is item.error:
-                self._pending_error = None      # delivered to the consumer
+            self._pending.delivered(item.error)
             raise item.error
         return item
 
@@ -615,7 +612,7 @@ class DevicePrefetcher:
         self._thread = None
         self._done = True
         _PREFETCH_QUEUE_DEPTH.set(0)
-        err, self._pending_error = self._pending_error, None
+        err = self._pending.take()
         if err is not None:
             raise err
 

@@ -54,6 +54,22 @@ Serving fault kinds (ISSUE 7 — the model server's degradation paths):
   (steady / burst / deadline-storm mixes) shared by the chaos tests and
   ``benchmarks/probe_serving.py``.
 
+Race kinds (ISSUE 8 — the concurrency analyzer's dynamic layer,
+``pytest -m races``):
+
+- **Seeded deterministic interleavings** — :class:`InterleavingHarness`
+  runs N thread bodies under a cooperative scheduler: exactly one
+  thread executes at a time, and at every traced line/opcode boundary
+  a seeded RNG decides whether to context-switch. The schedule is a
+  pure function of the seed, so a racy interleaving that loses an
+  increment (the ``DL4J-E202`` class) *reproduces* instead of flaking —
+  the harness is how every E201/E202 repo fix pins its regression test.
+- **Preemptive stress** — :func:`preemptive_stress` drops
+  ``sys.setswitchinterval`` to microseconds so the real serving /
+  elastic / async-checkpoint thread pools interleave maximally while a
+  seeded workload hammers them (the sweep mode: no determinism, vastly
+  more schedules).
+
 Every fault fires exactly once per planned step index (so a retried
 pull succeeds, like a real transient), and :meth:`FaultPlan.seeded`
 derives a whole plan from one integer seed for sweep-style chaos tests
@@ -66,10 +82,13 @@ apply order through the megabatch grouping and the prefetcher).
 
 from __future__ import annotations
 
+import contextlib
 import os
+import random
+import sys
 import threading
 import time
-from typing import Iterable, Optional, Set
+from typing import Callable, Iterable, List, Optional, Sequence, Set
 
 import numpy as np
 
@@ -515,3 +534,228 @@ class ServingLoad:
             except Exception as e:  # admission errors are outcomes here
                 out.append((spec, e))
         return out
+
+
+# ------------------------------------------------- deterministic interleaving
+class InterleavingHarness:
+    """Seeded deterministic thread-interleaving executor.
+
+    ``run(fn_a, fn_b, ...)`` executes the callables on real threads,
+    but under a cooperative scheduler: exactly ONE thread holds the
+    execution token at any time, and at every traced line (or, with
+    ``opcode_level=True``, every bytecode opcode — fine enough to split
+    ``self.x += 1`` between its LOAD and STORE) the running thread asks
+    a seeded RNG whether to hand the token to another runnable thread.
+    Because every switch decision is drawn from the seed and switch
+    points execute in a total order, the interleaving — and therefore
+    the outcome of any data race in the bodies — is a deterministic
+    function of ``(seed, switch_prob, bodies)``.
+
+    This is what makes the E201/E202 bug class *testable*: the
+    lost-increment fixture loses the same increments on every run with
+    the same seed, and the locked fix can be pinned to never lose any
+    across a seed sweep (``pytest -m races``).
+
+    Escape hatch for real blocking: if the token holder blocks in C
+    (e.g. on a ``threading.Lock`` another thread holds), it cannot
+    reach a switch point — a waiter that observes no scheduler progress
+    for ``stall_timeout`` seconds steals the token so the run cannot
+    deadlock. Bodies built purely from traced Python (the bad fixtures)
+    never stall, so their schedules stay exactly deterministic; bodies
+    taking real locks stay correct but may interleave through the
+    (timing-based) steal path.
+
+    Only code in the submitted bodies (their module, transitively
+    called functions included) is traced; scheduler internals and the
+    interpreter's ``threading`` machinery are exempt so the RNG stream
+    is consumed by user code only.
+    """
+
+    def __init__(self, seed: int = 0, switch_prob: float = 0.35,
+                 opcode_level: bool = True, stall_timeout: float = 0.01,
+                 timeout: float = 30.0):
+        self.seed = int(seed)
+        self.switch_prob = float(switch_prob)
+        self.opcode_level = bool(opcode_level)
+        self.stall_timeout = float(stall_timeout)
+        self.timeout = float(timeout)
+        self._rng = random.Random(self.seed)
+        self._cond = threading.Condition()
+        self._active: Optional[int] = None
+        self._runnable: List[int] = []
+        self._progress = 0
+        self._started = 0
+        self._total = 0
+        self._abort = False
+        self._results: dict = {}
+        self._errors: dict = {}
+        self._tls = threading.local()
+
+    # ------------------------------------------------------------ scheduling
+    def _switch_point(self, idx: int) -> None:
+        if self._abort or getattr(self._tls, "in_scheduler", False):
+            return
+        self._tls.in_scheduler = True
+        try:
+            with self._cond:
+                self._progress += 1
+                if self._active == idx and len(self._runnable) > 1 \
+                        and self._rng.random() < self.switch_prob:
+                    others = [i for i in self._runnable if i != idx]
+                    self._active = self._rng.choice(others)
+                    self._cond.notify_all()
+                self._wait_for_token(idx)
+        finally:
+            self._tls.in_scheduler = False
+
+    def _wait_for_token(self, idx: int) -> None:
+        """Block (cond held) until this thread owns the token; steal it
+        if the current owner is blocked outside traced code. A steal
+        needs THREE consecutive empty stall windows: an owner that is
+        merely descheduled (startup, a loaded box) usually progresses
+        within one window, while one blocked in C on a real lock never
+        does — a premature steal would diverge the seeded schedule."""
+        stalls = 0
+        while self._active != idx:
+            if self._abort:
+                return      # run() gave up: free-run to completion
+            seen = self._progress
+            if self._cond.wait(self.stall_timeout) \
+                    or self._progress != seen:
+                stalls = 0
+                continue
+            if idx not in self._runnable:
+                stalls = 0
+                continue
+            stalls += 1
+            if stalls >= 3:
+                # owner is stuck in C (a real lock): take over.
+                # every caller holds _cond around this method
+                self._active = idx      # dl4j: noqa=E201
+                self._cond.notify_all()
+                return
+
+    def _finish(self, idx: int) -> None:
+        with self._cond:
+            if idx in self._runnable:
+                self._runnable.remove(idx)
+            if self._runnable:
+                self._active = (self._rng.choice(self._runnable)
+                                if self._active == idx
+                                else self._active)
+            else:
+                self._active = None
+            self._cond.notify_all()
+
+    # --------------------------------------------------------------- tracing
+    #: exact source files never traced: the harness itself plus the
+    #: stdlib modules its scheduler leans on — matched by identity, not
+    #: substring, so a user file named e.g. random_search.py still gets
+    #: its switch points
+    _TRACE_EXCLUDED = frozenset({__file__, threading.__file__,
+                                 random.__file__})
+
+    def _tracer(self, idx: int):
+        excluded = self._TRACE_EXCLUDED
+        opcode_level = self.opcode_level
+
+        def trace(frame, event, arg):
+            code_file = frame.f_code.co_filename
+            if code_file in excluded:
+                return None
+            if event == "call":
+                if opcode_level:
+                    frame.f_trace_opcodes = True
+                return trace
+            if event in ("line", "opcode"):
+                self._switch_point(idx)
+            return trace
+        return trace
+
+    def _body(self, idx: int, fn: Callable) -> None:
+        # rendezvous: no body runs a user opcode until EVERY thread has
+        # started, so a slow-to-schedule initial token owner can never
+        # be stolen from before it has run at all
+        with self._cond:
+            self._started += 1
+            self._cond.notify_all()
+            while self._started < self._total:
+                self._cond.wait()
+            self._wait_for_token(idx)
+        sys.settrace(self._tracer(idx))
+        try:
+            result = fn()
+        except BaseException as e:
+            sys.settrace(None)
+            with self._cond:
+                self._errors[idx] = e
+            self._finish(idx)
+        else:
+            sys.settrace(None)
+            with self._cond:
+                self._results[idx] = result
+            self._finish(idx)
+
+    # ------------------------------------------------------------------- run
+    def run(self, *fns: Callable) -> List:
+        """Execute ``fns`` to completion under the seeded schedule;
+        returns their results in order (re-raising the first body
+        error). A harness instance is single-use — the RNG stream is
+        part of the schedule."""
+        if not fns:
+            return []
+        with self._cond:
+            self._runnable = list(range(len(fns)))
+            self._active = 0
+            self._started = 0
+            self._total = len(fns)
+        threads = [threading.Thread(target=self._body, args=(i, fn),
+                                    name=f"interleave-{i}", daemon=True)
+                   for i, fn in enumerate(fns)]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + self.timeout
+        for t in threads:
+            t.join(max(deadline - time.monotonic(), 0.0))
+        alive = [t.name for t in threads if t.is_alive()]
+        if alive:
+            with self._cond:        # unwedge before reporting: parked
+                self._abort = True  # threads return from _wait_for_token
+                self._runnable = []  # and free-run (untraced switch
+                self._active = None  # points) instead of spinning
+                self._cond.notify_all()
+            raise TimeoutError(
+                f"interleaving harness: {alive} still running after "
+                f"{self.timeout}s (seed={self.seed})")
+        for i in range(len(fns)):
+            if i in self._errors:
+                raise self._errors[i]
+        return [self._results.get(i) for i in range(len(fns))]
+
+    @classmethod
+    def sweep(cls, fns_factory: Callable[[], Sequence[Callable]],
+              seeds: Iterable[int], **kw) -> List:
+        """Run a fresh body set under each seed; returns the per-seed
+        results list — the shape the ``-m races`` sweeps assert over."""
+        out = []
+        for s in seeds:
+            out.append(cls(seed=s, **kw).run(*fns_factory()))
+        return out
+
+
+@contextlib.contextmanager
+def preemptive_stress(seed: int = 0, switch_interval: float = 1e-5):
+    """Maximize REAL thread preemption for the duration of the block:
+    drops ``sys.setswitchinterval`` to ``switch_interval`` (the GIL
+    hands off between bytecodes orders of magnitude more often) and
+    yields a seeded ``random.Random`` for the workload so the request
+    pattern is reproducible even though the schedule is not. The sweep
+    mode for racing the *real* serving / elastic / async-checkpoint
+    stacks (``pytest -m races``); :class:`InterleavingHarness` is the
+    deterministic single-schedule mode."""
+    prev = sys.getswitchinterval()
+    sys.setswitchinterval(switch_interval)
+    try:
+        yield random.Random(seed)
+    finally:
+        sys.setswitchinterval(prev)

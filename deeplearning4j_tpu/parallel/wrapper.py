@@ -433,7 +433,8 @@ class ParallelInference:
                                        context="inference")
         if new_mesh is None:
             return
-        self.mesh = new_mesh
+        with self._submit_lock:     # submitters/close() read the mesh
+            self.mesh = new_mesh
         if self._watchdog is not None:
             self._watchdog.begin_attempt()  # the shrunk forward recompiles
 

@@ -13,7 +13,12 @@ al., 2016) and TVM (Chen et al., 2018)):
 
 Plus :mod:`modes` — the OpExecutioner-style :class:`ProfilingMode`
 (OFF/BASIC/NAN_PANIC/INF_PANIC) that gates per-op instrumentation and
-unifies the Environment numerics-panic knobs.
+unifies the Environment numerics-panic knobs — and :mod:`locks` —
+instrumented Lock/RLock/Condition wrappers (``dl4j_lock_{wait,hold}_
+seconds`` + ``dl4j_lock_contention_total`` per lock name, gated on the
+same ProfilingMode) with a runtime lock-order witness that raises on
+A->B/B->A inversions under tests (the dynamic half of the DL4J-E203
+static deadlock lint).
 
 Instrumented seams: ``ops.registry`` dispatch, ``native.runtime``
 (compile cache, H2D/D2H), ``parallel.{wrapper,data}`` (replication /
@@ -30,6 +35,13 @@ read before any span or sample is allocated.
 
 import time as _time
 
+from deeplearning4j_tpu.profiler.locks import (InstrumentedCondition,
+                                               InstrumentedLock,
+                                               InstrumentedRLock,
+                                               LockOrderInversionError,
+                                               disable_lock_order_witness,
+                                               enable_lock_order_witness,
+                                               lock_order_edges)
 from deeplearning4j_tpu.profiler.metrics import (Counter, Gauge, Histogram,
                                                  MetricsRegistry,
                                                  get_registry)
@@ -47,6 +59,9 @@ __all__ = [
     "SpanTracer", "trace_span", "get_tracer", "enable_tracing",
     "disable_tracing", "tracing_enabled", "instrumentation_active",
     "now_us", "observe_region", "timed_region", "iter_with_data_wait",
+    "InstrumentedLock", "InstrumentedRLock", "InstrumentedCondition",
+    "LockOrderInversionError", "enable_lock_order_witness",
+    "disable_lock_order_witness", "lock_order_edges",
 ]
 
 

@@ -236,7 +236,9 @@ class A3CDiscreteDense:
         try:
             self._worker_body(wid, rollouts, stop)
         except BaseException as e:   # surface worker crashes to train()
-            self._worker_error = e
+            with self._lock:
+                if self._worker_error is None:
+                    self._worker_error = e
             stop.set()
 
     def _worker_body(self, wid: int, rollouts: "queue.Queue",
@@ -269,7 +271,8 @@ class A3CDiscreteDense:
             # n-step discounted returns bootstrapped from V(s_T)
             if done or ep_steps >= self.conf.max_episode_steps:
                 boot = 0.0
-                self.episode_rewards.append(ep_reward)
+                with self._lock:    # every worker appends here
+                    self.episode_rewards.append(ep_reward)
                 obs = mdp.reset()
                 ep_reward, ep_steps = 0.0, 0
             else:
@@ -297,10 +300,11 @@ class A3CDiscreteDense:
 
     def train(self) -> "A3CDiscreteDense":
         """Run workers + trainer until max_steps env steps are consumed."""
-        self._value_jit = jax.jit(self._value)
-        self._worker_error = None   # BEFORE workers start: a crash during
-        rollouts: "queue.Queue" = queue.Queue(maxsize=64)   # startup must
-        stop = threading.Event()                            # not be erased
+        with self._lock:            # BEFORE workers start: a crash during
+            self._value_jit = jax.jit(self._value)  # startup must not be
+            self._worker_error = None               # erased
+        rollouts: "queue.Queue" = queue.Queue(maxsize=64)
+        stop = threading.Event()
         workers = [threading.Thread(target=self._worker,
                                     args=(i, rollouts, stop), daemon=True)
                    for i in range(self.conf.num_threads)]

@@ -191,7 +191,7 @@ class CircuitBreaker:
         self.cooldown = float(cooldown)
         self.name = str(name)
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = _prof.InstrumentedLock("serving:breaker")
         self._failures = 0
         self._state = self.CLOSED
         self._opened_at = 0.0
@@ -340,7 +340,9 @@ class ModelServer:
         self._watchdog = DispatchWatchdog(replica_timeout, plan=faults,
                                           warmup=0)
         self._churn = _churn.get_churn_detector()
-        self._cond = threading.Condition()
+        # instrumented: dl4j_lock_{wait,hold}_seconds{lock="serving"} +
+        # contention counter under ProfilingMode (profiler.locks)
+        self._cond = _prof.InstrumentedCondition("serving")
         self._dq: "collections.deque[ServingRequest]" = collections.deque()
         self._draining = False
         self._drained = False
@@ -468,10 +470,11 @@ class ModelServer:
                           stacklevel=2)
         elapsed = self._compile_buckets(shapes)
         WARMUP_SECONDS.set(elapsed)
-        for s in shapes:
-            if s not in self._warm_shapes:
-                self._warm_shapes.append(s)
-        self._warmed = True
+        with self._cond:    # the serve thread reads both fields (E201)
+            for s in shapes:
+                if s not in self._warm_shapes:
+                    self._warm_shapes.append(s)
+            self._warmed = True
         logger.info("serving warmup: %d bucket(s) x %d shape(s) compiled "
                     "in %.3fs on %d device(s)", len(self.buckets()),
                     len(shapes), elapsed, len(self.mesh.devices))
@@ -487,8 +490,9 @@ class ModelServer:
             for b in self.buckets():
                 self._forward_raw(
                     np.zeros((b,) + tuple(shape), self.input_dtype))
-        self._warm_sig_count = self._churn.signature_count(
-            "serving:forward", owner=self)
+        with self._cond:    # written by warmup (caller) AND the serve
+            self._warm_sig_count = self._churn.signature_count(
+                "serving:forward", owner=self)      # thread's re-warm
         return time.perf_counter() - t0
 
     def recompiles_after_warmup(self) -> int:
@@ -589,7 +593,8 @@ class ModelServer:
                 if batch:
                     self._dispatch(batch)
         except BaseException:
-            self._died = True
+            with self._cond:
+                self._died = True
             logger.exception("serving loop died — failing queued requests")
             raise
         finally:
@@ -689,7 +694,8 @@ class ModelServer:
                     self._count("completed")
                 pos += req.n
         OCCUPANCY.observe(total / float(bucket))
-        self._batches += 1
+        with self._cond:    # stats() readers race this increment (E202)
+            self._batches += 1
         BATCHES.inc()
 
     # ------------------------------------------------------------- forward
@@ -758,7 +764,8 @@ class ModelServer:
                                        context="serving")
         if new_mesh is None:
             return
-        self.mesh = new_mesh
+        with self._cond:    # validate()/stats() read the mesh (E201)
+            self.mesh = new_mesh
         if self._warmed and self.rewarm_on_shrink:
             # the re-warm itself compiles unsupervised (_forward_raw does
             # not go through the watchdog), so the retry stays covered
@@ -803,7 +810,8 @@ class ModelServer:
         for req in queued:
             if req._resolve(error=ServerDrainingError()):
                 self._count("shed_draining")
-        self._drained = True
+        with self._cond:
+            self._drained = True
 
     def close(self):
         """Drain, then release the preemption handlers. Idempotent;
@@ -811,7 +819,8 @@ class ModelServer:
         if self._closed:
             return
         self.drain()
-        self._closed = True
+        with self._cond:
+            self._closed = True
         if self._preemption_installed:
             uninstall = getattr(self._preemption, "uninstall", None)
             if uninstall is not None:

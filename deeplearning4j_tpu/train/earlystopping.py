@@ -85,10 +85,12 @@ class MaxTimeIterationTerminationCondition:
         self._start = None
 
     def terminate_iteration(self, score: float) -> bool:
+        # monotonic: an NTP wall-clock step must not end (or extend)
+        # the training budget spuriously (W210)
         if self._start is None:
-            self._start = time.time()
+            self._start = time.monotonic()
             return False
-        return (time.time() - self._start) > self.max_seconds
+        return (time.monotonic() - self._start) > self.max_seconds
 
 
 class InMemoryModelSaver:

@@ -57,7 +57,9 @@ class PerformanceListener(TrainingListener):
         self.samples_per_sec: Optional[float] = None
 
     def iterationDone(self, model, iteration, epoch):
-        now = time.time()
+        # monotonic: throughput is a duration, and an NTP wall-clock step
+        # mid-window would report negative (or absurd) samples/sec (W210)
+        now = time.monotonic()
         self._samples += getattr(model, "_last_batch_size", 0)
         if self._last_time is not None and iteration % self.frequency == 0:
             dt = now - self._last_time
@@ -92,11 +94,11 @@ class TimeIterationListener(TrainingListener):
 
     def __init__(self, total_iterations: int, out: Callable = None):
         self.total = total_iterations
-        self.start = time.time()
+        self.start = time.monotonic()   # duration math: W210
         self.out = out or (lambda msg: logger.info(msg))
 
     def iterationDone(self, model, iteration, epoch):
-        elapsed = time.time() - self.start
+        elapsed = time.monotonic() - self.start
         if iteration > 0:
             remaining = elapsed / iteration * (self.total - iteration)
             self.out(f"iter {iteration}/{self.total}, ETA {remaining:.0f}s")
@@ -186,7 +188,7 @@ class StatsListener(TrainingListener):
         import jax
         if not self._sampled(iteration):
             return
-        self._t_iter_start = time.time()
+        self._t_iter_start = time.monotonic()   # duration math: W210
         # device-side copy (donation-safe; freed after the diff is taken)
         self._snapshot = jax.tree_util.tree_map(lambda a: a + 0,
                                                 model._params)
@@ -245,7 +247,8 @@ class StatsListener(TrainingListener):
             layers[name] = {k: (v.tolist() if hasattr(v, "tolist") and
                                 getattr(v, "ndim", 0) else float(v))
                             for k, v in rec.items()}
-        dur = (time.time() - self._t_iter_start) if self._t_iter_start else None
+        dur = (time.monotonic() - self._t_iter_start) \
+            if self._t_iter_start else None
         self.storage.putUpdate({
             "session_id": self.session_id,
             "worker_id": "0",

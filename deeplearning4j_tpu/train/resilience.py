@@ -77,6 +77,7 @@ import numpy as np
 from deeplearning4j_tpu import profiler as _prof
 from deeplearning4j_tpu.data.dataset import (DataSetIterator,
                                              RetryingDataSetIterator)
+from deeplearning4j_tpu.utils.concurrent import ErrorLatch
 from deeplearning4j_tpu.utils.environment import NumericsPanicError
 
 logger = logging.getLogger("deeplearning4j_tpu")
@@ -406,8 +407,8 @@ class CheckpointManager:
         """Re-raise the FIRST background-write failure (once) as
         AsyncCheckpointError on the calling thread."""
         w = self._writer
-        if w is not None and w.error is not None:
-            err, w.error = w.error, None
+        err = w.take_error() if w is not None else None
+        if err is not None:
             raise AsyncCheckpointError(
                 f"background checkpoint write failed: {err}") from err
 
@@ -585,10 +586,14 @@ class _AsyncWriter:
     def __init__(self, manager: "CheckpointManager", depth: int):
         self.manager = manager
         self.queue: "_queue.Queue" = _queue.Queue(maxsize=max(1, int(depth)))
-        self.error: Optional[BaseException] = None
+        self._pending = ErrorLatch()   # writer thread vs fit thread
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="dl4j-ckpt-writer")
         self._thread.start()
+
+    def take_error(self) -> Optional[BaseException]:
+        """Pop the first unreported write failure (fit-thread side)."""
+        return self._pending.take()
 
     def submit(self, job):
         self.queue.put(job)
@@ -604,8 +609,7 @@ class _AsyncWriter:
                 self.manager._write(snap, status=status, cursor=cursor,
                                     normalizer=normalizer, extra=extra)
             except BaseException as e:
-                if self.error is None:
-                    self.error = e
+                self._pending.record(e)   # first failure wins
             finally:
                 self.queue.task_done()
                 CKPT_ASYNC_QUEUE.set(self.queue.qsize())

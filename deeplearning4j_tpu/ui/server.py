@@ -293,27 +293,37 @@ class UIServer:
     """ref: UIServer.getInstance().attach(statsStorage)."""
 
     _instance: Optional["UIServer"] = None
+    # class-level twin of the instance _lifecycle lock: two threads
+    # racing getInstance() must not both construct (and later bind) a
+    # server for the same port
+    _instance_lock = threading.Lock()
 
     def __init__(self, port: int = 9000):
         self.port = port
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        # serializes start/stop: attach()/attach_serving() from two
+        # threads must not both observe _httpd None and double-bind the
+        # port (DL4J-W213), and stop() must not race a concurrent start
+        self._lifecycle = threading.Lock()
 
     @classmethod
     def getInstance(cls, port: int = 9000) -> "UIServer":
-        if cls._instance is None:
-            cls._instance = cls(port)
-        return cls._instance
+        with UIServer._instance_lock:
+            if cls._instance is None:
+                cls._instance = cls(port)
+            return cls._instance
 
     def _ensure_httpd(self) -> ThreadingHTTPServer:
-        if self._httpd is None:
-            self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port),
-                                              _Handler)
-            self.port = self._httpd.server_address[1]   # resolve port 0
-            self._thread = threading.Thread(target=self._httpd.serve_forever,
-                                            daemon=True)
-            self._thread.start()
-        return self._httpd
+        with self._lifecycle:
+            if self._httpd is None:
+                self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port),
+                                                  _Handler)
+                self.port = self._httpd.server_address[1]  # resolve port 0
+                self._thread = threading.Thread(
+                    target=self._httpd.serve_forever, daemon=True)
+                self._thread.start()
+            return self._httpd
 
     def attach(self, storage: StatsStorage):
         """Attach (or swap) the dashboard's StatsStorage; starts the
@@ -344,13 +354,20 @@ class UIServer:
         return self
 
     def stop(self):
-        if self._httpd is not None:
-            self._httpd.shutdown()
-            self._httpd.server_close()
-            self._httpd = None
-            self._thread = None
-        if UIServer._instance is self:
-            UIServer._instance = None
+        with self._lifecycle:
+            if self._httpd is not None:
+                self._httpd.shutdown()
+                # join before closing the socket: serve_forever has
+                # observed the shutdown once join returns, so no request
+                # thread touches the server object past this point
+                if self._thread is not None:
+                    self._thread.join(timeout=10.0)
+                self._httpd.server_close()
+                self._httpd = None
+                self._thread = None
+        with UIServer._instance_lock:
+            if UIServer._instance is self:
+                UIServer._instance = None
 
     @property
     def url(self) -> str:

@@ -75,10 +75,15 @@ class StatsStorage:
 
     # ------------------------------------------------------------ listeners
     def registerStatsStorageListener(self, cb: Callable[[StatsStorageEvent], None]):
-        self._listeners.append(cb)
+        # registration can race a training thread mid-_notify: mutate
+        # and snapshot the listener list under the storage lock
+        with self._lock:
+            self._listeners.append(cb)
 
     def _notify(self, event: StatsStorageEvent):
-        for cb in list(self._listeners):
+        with self._lock:
+            listeners = list(self._listeners)
+        for cb in listeners:
             cb(event)
 
     def _store(self, record: Dict, static: bool) -> bool:
@@ -112,7 +117,9 @@ class InMemoryStatsStorage(StatsStorage):
             return sorted(set(self._static) | set(self._updates))
 
     def getStaticInfo(self, session_id):
-        return self._static.get(session_id)
+        # UI request threads read while the training thread stores
+        with self._lock:
+            return self._static.get(session_id)
 
     def getAllUpdates(self, session_id):
         with self._lock:
@@ -152,7 +159,8 @@ class FileStatsStorage(InMemoryStatsStorage):
         return is_new
 
     def close(self):
-        self._fh.close()
+        with self._lock:        # a racing _store must not hit a closed fh
+            self._fh.close()
 
 
 class StatsStorageRouter:

@@ -1,0 +1,49 @@
+"""Small shared concurrency primitives (jax-free).
+
+Home of cross-thread plumbing used by more than one subsystem; keeping
+one implementation means its exactly-once semantics are race-tested in
+one place (``pytest -m races``) instead of drifting between copies.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class ErrorLatch:
+    """First-error latch shared by a worker thread and its consumer.
+    The worker records the first failure, the consumer marks it
+    delivered when it surfaces through the normal result channel, and
+    ``close()``-style paths take whatever was never delivered — every
+    transition under one lock, so a worker error racing a shutdown can
+    neither be lost nor double-raised (DL4J-E201/E202: such fields used
+    to be bare cross-thread writes). Used by AsyncDataSetIterator,
+    DevicePrefetcher, and the async checkpoint writer."""
+
+    __slots__ = ("_lock", "_error")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._error: "BaseException | None" = None
+
+    def record(self, e: BaseException) -> None:
+        """Worker side: the FIRST error wins."""
+        with self._lock:
+            if self._error is None:
+                self._error = e
+
+    def delivered(self, e: BaseException) -> None:
+        """Consumer side: this error surfaced via the queue — close()
+        must not re-raise it."""
+        with self._lock:
+            if self._error is e:
+                self._error = None
+
+    def clear(self) -> None:
+        with self._lock:
+            self._error = None
+
+    def take(self) -> "BaseException | None":
+        with self._lock:
+            e, self._error = self._error, None
+            return e

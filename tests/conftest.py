@@ -33,6 +33,13 @@ def pytest_configure(config):
         "(`pytest -m quick`, target <120s — the CI gate)")
     config.addinivalue_line(
         "markers",
+        "races: seeded thread-interleaving tests (`pytest -m races`) — "
+        "the InterleavingHarness determinism pins, the instrumented-lock "
+        "layer, and one regression per E201/E202 repo fix. Like chaos, "
+        "deliberately a fast marker so tier-1's `-m 'not slow'` gate "
+        "runs every race schedule")
+    config.addinivalue_line(
+        "markers",
         "chaos: seeded fault-injection sweeps through the resilience and "
         "elastic layers (`pytest -m chaos`). DELIBERATELY a fast marker, "
         "not a slow one: tier-1 runs `-m 'not slow'`, so every chaos "

@@ -21,9 +21,17 @@ of the same rules:
 a finding, matching ruff semantics, so both linters agree on the same
 annotations. Exit status 0 = clean.
 
+On top of the style/correctness rules, the gate runs the repo's own
+**concurrency self-lint** (``deeplearning4j_tpu.analysis.concurrency``,
+the DL4J-E2xx/W21x thread-safety codes) over the package with
+warnings-as-errors — per-code suppressions live in pyproject.toml under
+``[tool.dl4j.concurrency]`` and per-line ones as ``# dl4j: noqa=E201``
+comments. Ruff has no equivalent rule set, so this half always runs.
+
 Usage: ``python tools/lint.py [paths...]`` (default: the package, tests,
 tools, benchmarks). ``--fallback`` forces the AST linter even when ruff
-exists (what the test suite pins).
+exists (what the test suite pins); ``--no-concurrency`` skips the
+thread-safety pass (style-only run).
 """
 
 from __future__ import annotations
@@ -66,12 +74,12 @@ def _noqa_lines(source: str):
     return out
 
 
-def _used_names(tree: ast.AST):
+def _used_names(nodes):
     """Every identifier the module can plausibly reference: Name loads,
     plus word tokens inside string constants (quoted annotations,
     __all__ entries, forward references)."""
     used = set()
-    for node in ast.walk(tree):
+    for node in nodes:
         if isinstance(node, ast.Name):
             used.add(node.id)
         elif isinstance(node, ast.Constant) and isinstance(node.value, str) \
@@ -82,10 +90,10 @@ def _used_names(tree: ast.AST):
     return used
 
 
-def _check_f401(tree, path: Path, findings):
+def _check_f401(tree, nodes, path: Path, findings):
     if path.name == "__init__.py":
         return
-    used = _used_names(tree)
+    used = _used_names(nodes)
     for node in tree.body:                       # module level only
         if isinstance(node, ast.Import):
             for alias in node.names:
@@ -120,8 +128,8 @@ def _check_f811(tree, path: Path, findings):
             seen[node.name] = node.lineno
 
 
-def _check_f632(tree, path: Path, findings):
-    for node in ast.walk(tree):
+def _check_f632(tree, nodes, path: Path, findings):
+    for node in nodes:
         if not isinstance(node, ast.Compare):
             continue
         for op, comp in zip(node.ops, node.comparators):
@@ -134,8 +142,8 @@ def _check_f632(tree, path: Path, findings):
                     "use == / != to compare with literals, not 'is'"))
 
 
-def _check_b006(tree, path: Path, findings):
-    for node in ast.walk(tree):
+def _check_b006(tree, nodes, path: Path, findings):
+    for node in nodes:
         if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             continue
         defaults = list(node.args.defaults) + \
@@ -152,8 +160,8 @@ def _check_b006(tree, path: Path, findings):
                     f"None and create inside the function"))
 
 
-def _check_e722(tree, path: Path, findings):
-    for node in ast.walk(tree):
+def _check_e722(tree, nodes, path: Path, findings):
+    for node in nodes:
         if isinstance(node, ast.ExceptHandler) and node.type is None:
             findings.append(Finding(path, node.lineno, "E722",
                                     "bare 'except:' — name the exception"))
@@ -174,14 +182,14 @@ def _scope_statements(fn):
             stack.append(child)
 
 
-def _check_f841(tree, path: Path, findings):
+def _check_f841(tree, nodes, path: Path, findings):
     """Local assigned but never used. Conservative subset of ruff's F841:
     plain single-Name ``x = ...`` / annotated assignments only (tuple
     unpacking, loop targets, and aug-assigns are deliberate far too often
     to flag), ``_``-prefixed names exempt, and a name counts as used if it
     is loaded ANYWHERE inside the function — including nested closures
     and short string constants (quoted forward refs)."""
-    for fn in ast.walk(tree):
+    for fn in nodes:
         if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
             continue
         used = set()
@@ -260,12 +268,13 @@ def lint_file(path: Path):
     except SyntaxError as e:
         return [Finding(path, e.lineno or 0, "E999", f"syntax error: {e.msg}")]
     findings = []
-    for check in (_check_f401, _check_f811, _check_f632, _check_b006,
-                  _check_e722):
-        check(tree, path, findings)
+    nodes = list(ast.walk(tree))    # ONE tree walk shared by every check
+    _check_f811(tree, path, findings)
+    for check in (_check_f401, _check_f632, _check_b006, _check_e722):
+        check(tree, nodes, path, findings)
     # tests/* keep F841 probes (mirrors the pyproject per-file-ignores)
     if "tests" not in path.parts:
-        _check_f841(tree, path, findings)
+        _check_f841(tree, nodes, path, findings)
     _check_w605(source, path, findings)
     noqa = _noqa_lines(source)
     return [f for f in findings
@@ -294,16 +303,94 @@ def run_fallback(paths) -> int:
     return 1 if findings else 0
 
 
+#: what the concurrency self-lint covers: the shipped package only —
+#: tests keep deliberately-racy fixtures, benchmarks are single-threaded
+CONCURRENCY_PATHS = ["deeplearning4j_tpu"]
+
+
+def _pyproject_concurrency_suppress() -> list:
+    """``[tool.dl4j.concurrency] suppress = ["W212", ...]`` from
+    pyproject.toml (line-scoped parse: this container is py3.10, no
+    tomllib, and the gate must stay dependency-free). Scans the section
+    line by line until the next ``[section]`` header, so other keys,
+    comments, or '[' characters inside the section cannot silently
+    defeat the parse."""
+    try:
+        text = (REPO / "pyproject.toml").read_text(encoding="utf-8")
+    except OSError:
+        return []
+    in_section = in_array = False
+    body: list = []
+    for line in text.splitlines():
+        # strip TOML comments first: a ']' or quoted word inside one
+        # must not end (or pollute) the array parse — codes never
+        # contain '#'
+        stripped = line.split("#", 1)[0].strip()
+        if in_array:
+            head = stripped.split("]", 1)[0]
+            body.append(head)
+            if "]" in stripped:
+                return re.findall(r'"([^"]+)"', " ".join(body))
+            continue
+        if re.fullmatch(r"\[tool\.dl4j\.concurrency\]", stripped):
+            in_section = True
+            continue
+        if in_section and re.fullmatch(r"\[[^\]]+\]", stripped):
+            break                       # next section header
+        if in_section:
+            m = re.match(r"suppress\s*=\s*\[(?P<rest>.*)", stripped)
+            if m:
+                rest = m.group("rest")
+                if "]" in rest:         # single-line array
+                    return re.findall(r'"([^"]+)"',
+                                      rest.split("]", 1)[0])
+                body.append(rest)       # multi-line array: keep reading
+                in_array = True
+    return []
+
+
+def run_concurrency(paths=None) -> int:
+    """The DL4J-E2xx/W21x thread-safety self-lint, warnings-as-errors.
+    Returns 0 when every path is clean."""
+    sys.path.insert(0, str(REPO))
+    try:
+        from deeplearning4j_tpu.analysis.concurrency import \
+            analyze_concurrency
+    finally:
+        sys.path.pop(0)
+    suppress = _pyproject_concurrency_suppress()
+    failed = 0
+    for p in (paths or CONCURRENCY_PATHS):
+        try:
+            report = analyze_concurrency(str(REPO / p), suppress=suppress)
+        except ValueError as e:
+            # a typo'd code in [tool.dl4j.concurrency] suppress must be
+            # a clean usage error, not a traceback
+            print(f"concurrency self-lint: bad suppress config in "
+                  f"pyproject.toml: {e}")
+            return 1
+        print(report.format())
+        if not report.ok(warnings_as_errors=True):
+            failed = 1
+    return failed
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("paths", nargs="*", default=None)
     ap.add_argument("--fallback", action="store_true",
                     help="force the AST fallback even when ruff is on PATH")
+    ap.add_argument("--no-concurrency", action="store_true",
+                    help="skip the DL4J-E2xx/W21x thread-safety self-lint")
     args = ap.parse_args(argv)
     paths = args.paths or DEFAULT_PATHS
     if not args.fallback and shutil.which("ruff"):
-        return subprocess.call(["ruff", "check", *paths], cwd=REPO)
-    return run_fallback(paths)
+        rc = subprocess.call(["ruff", "check", *paths], cwd=REPO)
+    else:
+        rc = run_fallback(paths)
+    if not args.no_concurrency:
+        rc = run_concurrency() or rc
+    return rc
 
 
 if __name__ == "__main__":
