@@ -58,6 +58,32 @@ def microbench(ops: int) -> dict:
     return out
 
 
+def _queue_ns_per_op(q, ops: int) -> float:
+    t0 = time.perf_counter()
+    for _ in range(ops):
+        q.put(1)
+        q.get()
+    return (time.perf_counter() - t0) / ops * 1e9
+
+
+def queue_microbench(ops: int) -> dict:
+    """plain queue.Queue vs InstrumentedQueue put+get — the PR-10
+    prefetcher-queue adoption rides on this being ~free with
+    instrumentation OFF."""
+    import queue as _q
+
+    from deeplearning4j_tpu import profiler
+    raw = _q.Queue(maxsize=4)
+    inst = profiler.InstrumentedQueue(maxsize=4, name="probe:queue")
+    profiler.set_profiling_mode(profiler.ProfilingMode.OFF)
+    out = {"queue_raw_ns_per_op": _queue_ns_per_op(raw, ops),
+           "queue_off_ns_per_op": _queue_ns_per_op(inst, ops)}
+    profiler.set_profiling_mode(profiler.ProfilingMode.BASIC)
+    out["queue_on_ns_per_op"] = _queue_ns_per_op(inst, ops)
+    profiler.set_profiling_mode(None)
+    return out
+
+
 def build():
     from deeplearning4j_tpu.data.dataset import DataSet
     from deeplearning4j_tpu.models import zoo
@@ -121,6 +147,7 @@ def main():
     args = ap.parse_args()
 
     res = microbench(args.ops)
+    res.update(queue_microbench(max(1, args.ops // 10)))
     res.update(fit_overhead(args.iters, args.warmup, args.blocks))
     ratio = res["fit_inst_sec_per_iter"] / res["fit_plain_sec_per_iter"] \
         - 1.0

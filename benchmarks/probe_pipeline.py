@@ -1,0 +1,131 @@
+"""Probe: staged input pipeline vs the per-batch float path (ISSUE 10).
+
+Isolates the INPUT side (no model dispatch): JPEGs on disk through the
+staged pipeline into device-staged batches, three ways —
+
+1. ``float32 per-batch`` — the r05 shape of the problem: host float
+   conversion, one ``device_put`` of a float batch per step (4x the
+   bytes of uint8).
+2. ``uint8 per-batch`` — bytes to the device, cast on chip, still one
+   transfer per batch.
+3. ``uint8 megabatch (K)`` — the r06 staged path: workers fill one
+   contiguous ``[K, B, C, H, W]`` slot, ONE transfer per K-step
+   dispatch.
+
+Plus decode-worker scaling (1 worker vs all cores) to verify the pool
+actually parallelizes, and the H2D bytes each mode ships. One JSON
+line:
+
+  {"probe": "pipeline", "float32_per_batch_img_s": ..,
+   "uint8_per_batch_img_s": .., "uint8_megabatch_img_s": ..,
+   "decode_1w_img_s": .., "decode_nw_img_s": .., "workers": ..,
+   "h2d_mb_float32": .., "h2d_mb_uint8": .., "speedup_vs_float": ..}
+
+Run: python benchmarks/probe_pipeline.py [--imgs N] [--batch B] [--k K]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def make_dataset(root: str, n: int, side: int) -> str:
+    from PIL import Image
+    if os.path.isdir(root) and sum(
+            len(fs) for _, _, fs in os.walk(root)) == n:
+        return root
+    rng = np.random.RandomState(42)
+    per = n // 8
+    for c in range(8):
+        d = os.path.join(root, f"class{c}")
+        os.makedirs(d, exist_ok=True)
+        for i in range(per):
+            arr = rng.randint(0, 255, (side, side, 3), dtype=np.uint8)
+            Image.fromarray(arr).save(os.path.join(d, f"{i}.jpg"),
+                                      quality=85)
+    return root
+
+
+def drive(root, hw, batch, workers, dtype, k):
+    """One epoch through the pipeline, staging every item on device;
+    returns (img/s, h2d_bytes)."""
+    import jax
+
+    from deeplearning4j_tpu.data.dataset import stage_item
+    from deeplearning4j_tpu.data.pipeline import MultiWorkerImageIterator
+    it = MultiWorkerImageIterator(root, hw, hw, batch_size=batch,
+                                  workers=workers, dtype=dtype,
+                                  drop_last=True, steps_per_dispatch=k)
+    staged_bytes = 0
+    try:
+        # warmup epoch: worker spawn + child imports must not bill the
+        # measured epoch (spawn re-runs site init per worker)
+        while it.hasNext():
+            it.next()
+        it.reset()
+        t0 = time.perf_counter()
+        n = 0
+        last = None
+        for item in it.dispatch_stream():
+            feats = item.features
+            staged_bytes += feats.nbytes if hasattr(feats, "nbytes") else 0
+            last = stage_item(item)
+            n += feats.shape[0] * feats.shape[1] if feats.ndim == 5 \
+                else feats.shape[0]
+        if last is not None:            # real device sync
+            jax.block_until_ready(last.features)
+        dt = time.perf_counter() - t0
+        return n / dt, staged_bytes
+    finally:
+        it.close()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--imgs", type=int, default=512)
+    ap.add_argument("--side", type=int, default=96)
+    ap.add_argument("--hw", type=int, default=64)
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--k", type=int, default=4)
+    args = ap.parse_args()
+
+    root = make_dataset(f"/tmp/dl4j_probe_pipe_{args.side}_{args.imgs}",
+                        args.imgs, args.side)
+    cores = os.cpu_count() or 1
+
+    # decode scaling: 1 worker vs all cores, uint8 K=1
+    dec1, _ = drive(root, args.hw, args.batch, 1, "uint8", 1)
+    decn, _ = drive(root, args.hw, args.batch, cores, "uint8", 1)
+
+    f32, h2d_f32 = drive(root, args.hw, args.batch, cores, "float32", 1)
+    u8, h2d_u8 = drive(root, args.hw, args.batch, cores, "uint8", 1)
+    mega, h2d_m = drive(root, args.hw, args.batch, cores, "uint8", args.k)
+
+    out = {"probe": "pipeline", "imgs": args.imgs, "hw": args.hw,
+           "batch": args.batch, "k": args.k, "workers": cores,
+           "decode_1w_img_s": round(dec1, 1),
+           "decode_nw_img_s": round(decn, 1),
+           "float32_per_batch_img_s": round(f32, 1),
+           "uint8_per_batch_img_s": round(u8, 1),
+           "uint8_megabatch_img_s": round(mega, 1),
+           "h2d_mb_float32": round(h2d_f32 / 1e6, 1),
+           "h2d_mb_uint8": round(h2d_u8 / 1e6, 1),
+           "speedup_vs_float": round(mega / f32, 2)}
+    print(json.dumps(out))
+    # uint8 ships exactly 1/4 the float bytes — the staging discipline
+    # the H2D-bound analysis (W108) assumes
+    assert abs(h2d_f32 - 4 * h2d_u8) / h2d_f32 < 0.01, \
+        f"uint8 staging should ship 1/4 the float bytes " \
+        f"({h2d_u8} vs {h2d_f32})"
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -20,6 +20,11 @@ validation is the same shape of tool):
   axis, ``E103`` pipeline-split weight tie, ``E104`` per-device HBM
   budget, ``W104`` replicated giant, ``W105`` pipeline FLOP imbalance,
   ``W106`` sub-MXU shard, ``W107`` per-layer collective volume.
+- :mod:`pipeline` — input-pipeline feasibility against a declared
+  :class:`InputPipelineSpec` (``analyze(..., input_pipeline=...)``, CLI
+  ``--pipeline workers=8,batch=256,decode_ms=1.3``): ``W108`` host-bound
+  decode/H2D img/s below the model's estimated device img/s — "this
+  host cannot feed this chip", caught before any worker spawns.
 - :mod:`serving` — serving-config lints (``ModelServer.validate()`` /
   :func:`lint_serving`): ``E110`` bucket vs. data-axis divisibility,
   ``E111`` serving HBM budget (params + largest-bucket activations),
@@ -61,6 +66,8 @@ from deeplearning4j_tpu.analysis.diagnostics import (DIAGNOSTIC_CODES,
                                                      ValidationReport,
                                                      normalize_code)
 from deeplearning4j_tpu.analysis.distribution import MeshSpec, PipelineSpec
+from deeplearning4j_tpu.analysis.pipeline import (InputPipelineSpec,
+                                                  lint_input_pipeline)
 from deeplearning4j_tpu.analysis.samediff import analyze_samediff
 from deeplearning4j_tpu.analysis.serving import lint_serving
 
@@ -68,6 +75,7 @@ __all__ = [
     "analyze", "analyze_concurrency", "analyze_samediff", "Diagnostic",
     "Severity",
     "ValidationReport", "ModelValidationError", "DIAGNOSTIC_CODES",
-    "MeshSpec", "PipelineSpec", "normalize_code", "RecompileChurnDetector",
+    "MeshSpec", "PipelineSpec", "InputPipelineSpec", "lint_input_pipeline",
+    "normalize_code", "RecompileChurnDetector",
     "get_churn_detector", "array_fingerprint", "lint_serving",
 ]

@@ -109,6 +109,11 @@ def main(argv=None) -> int:
     ap.add_argument("--hbm-gb", type=float, default=None,
                     help="per-device HBM budget in GiB for the E104 "
                          "parameter-footprint check (default 16)")
+    ap.add_argument("--pipeline", default=None, metavar="SPEC",
+                    help="declared input pipeline for the W108 can-this-"
+                         "host-feed-this-chip check, e.g. 'workers=8,"
+                         "batch=256,decode_ms=1.3,h2d_mbps=6.2,hw=224"
+                         "[,dtype=uint8][,mfu=0.3][,device_img_s=2184]'")
     ap.add_argument("--suppress", action="append", default=[],
                     metavar="CODES",
                     help="suppress diagnostic codes (comma-separated or "
@@ -141,6 +146,13 @@ def main(argv=None) -> int:
             ap.error(f"--severity: {e}")
     if args.hbm_gb is not None and not args.mesh:
         ap.error("--hbm-gb needs a mesh declaration: pass --mesh as well")
+    pipeline_spec = None
+    if args.pipeline:
+        from deeplearning4j_tpu.analysis.pipeline import InputPipelineSpec
+        try:
+            pipeline_spec = InputPipelineSpec.parse(args.pipeline)
+        except ValueError as e:
+            ap.error(f"--pipeline: {e}")
 
     if args.concurrency:
         if args.targets or args.zoo:
@@ -178,8 +190,8 @@ def main(argv=None) -> int:
     for name, obj in targets:
         report = analyze(obj, batch_size=args.batch_size,
                          data_devices=args.devices, mesh=args.mesh,
-                         hbm_gb=args.hbm_gb, suppress=suppress,
-                         severity_overrides=overrides)
+                         hbm_gb=args.hbm_gb, input_pipeline=pipeline_spec,
+                         suppress=suppress, severity_overrides=overrides)
         report.subject = name
         total.extend(report.diagnostics)
         print(report.format())

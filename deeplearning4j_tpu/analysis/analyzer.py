@@ -36,6 +36,7 @@ _REGRESSION_LOSSES = {"mse", "l2", "l1", "mae", "squaredloss", "huber"}
 def analyze(target, batch_size: Optional[int] = None,
             data_devices: Optional[int] = None, mesh=None, sharding=None,
             pipeline=None, hbm_gb: Optional[float] = None,
+            input_pipeline=None,
             suppress=None, severity_overrides=None) -> ValidationReport:
     """Analyze a configuration, builder, network, or SameDiff graph.
 
@@ -47,6 +48,10 @@ def analyze(target, batch_size: Optional[int] = None,
     ``DeviceMesh``) switches on the E1xx/W10x distribution lints;
     ``sharding`` (``ShardingRule`` or {regex: spec}), ``pipeline``
     (``PipelineSpec``/stage count), and ``hbm_gb`` refine them.
+    ``input_pipeline`` (an
+    :class:`~deeplearning4j_tpu.analysis.pipeline.InputPipelineSpec`,
+    dict, or ``"workers=8,batch=256,decode_ms=1.3"`` string) switches on
+    the W108 can-this-host-feed-this-chip check.
     ``suppress``/``severity_overrides`` shape the report per code
     (:meth:`ValidationReport.apply_config`).
     """
@@ -59,6 +64,10 @@ def analyze(target, batch_size: Optional[int] = None,
                 "hbm_gb=) apply to layer configurations, not SameDiff "
                 "graphs — recorded op graphs carry no per-layer shard "
                 "declaration to check yet")
+        if input_pipeline is not None:
+            raise ValueError(
+                "the input-pipeline lint (input_pipeline=) applies to "
+                "layer configurations, not SameDiff graphs")
         from deeplearning4j_tpu.analysis.samediff import analyze_samediff
         report = analyze_samediff(conf, batch_size=batch_size or 1)
     elif hasattr(conf, "graph_inputs") and hasattr(conf, "nodes"):
@@ -71,6 +80,9 @@ def analyze(target, batch_size: Optional[int] = None,
                         "MultiLayerConfiguration, ComputationGraph"
                         "Configuration, one of their builders, a network, "
                         "or a SameDiff graph")
+    if input_pipeline is not None:
+        from deeplearning4j_tpu.analysis.pipeline import lint_input_pipeline
+        report.extend(lint_input_pipeline(conf, input_pipeline))
     if target is not conf:                       # a network: add model-level
         report.extend(_model_checks(target))
     return report.apply_config(suppress, severity_overrides)

@@ -76,8 +76,9 @@ CONDITION_CTORS = frozenset({"Condition", "InstrumentedCondition",
                              "instrumented_condition"})
 #: thread-safe primitives: calling methods on (or sharing) these is fine
 THREADSAFE_CTORS = LOCK_CTORS | frozenset({
-    "Queue", "LifoQueue", "PriorityQueue", "SimpleQueue", "Event",
-    "Semaphore", "BoundedSemaphore", "Barrier", "local",
+    "Queue", "LifoQueue", "PriorityQueue", "SimpleQueue",
+    "InstrumentedQueue", "Event", "Semaphore", "BoundedSemaphore",
+    "Barrier", "local",
 })
 #: plain-container constructors whose mutating METHOD calls count as writes
 MUTABLE_CTORS = frozenset({"list", "dict", "set", "deque", "Counter",

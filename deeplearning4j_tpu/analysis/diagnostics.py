@@ -87,6 +87,11 @@ DIAGNOSTIC_CODES = {
                  "it non-divisible, forcing padding)",
     "DL4J-W107": "collective volume: a single layer's estimated gradient "
                  "allreduce payload per step exceeds the threshold",
+    "DL4J-W108": "input pipeline cannot feed the chip: the declared "
+                 "pipeline's decode- or H2D-bound img/s (workers x "
+                 "per-core decode rate, bandwidth / image bytes) is below "
+                 "the model's estimated device img/s — the accelerator "
+                 "idles regardless of stage overlap",
     # E11x/W11x serving-config lints (analysis/serving.py): validate the
     # bucket ladder x mesh x HBM budget before warmup burns the compiles.
     "DL4J-E110": "serving bucket/mesh mismatch: a batch bucket does not "

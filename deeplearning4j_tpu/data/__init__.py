@@ -29,6 +29,12 @@ from deeplearning4j_tpu.data.image import (  # noqa: F401
     PipelineImageTransform,
 )
 from deeplearning4j_tpu.data.iterators import Cifar10DataSetIterator  # noqa: F401
+from deeplearning4j_tpu.data.pipeline import (  # noqa: F401
+    DataPipelineError,
+    ImagePipeline,
+    MultiWorkerImageIterator,
+    StagedImageIterator,
+)
 from deeplearning4j_tpu.data.audio import (  # noqa: F401
     AudioDataSetIterator,
     WavFileRecordReader,

@@ -376,7 +376,10 @@ class AsyncDataSetIterator(DataSetIterator):
 
     def _restart_worker(self):
         self._stop = threading.Event()
-        self._queue = queue.Queue(maxsize=self.prefetch)
+        # instrumented queue (PR-8 carried follow-up): producer/consumer
+        # contention on the prefetch buffer shows up in dl4j_lock_*
+        self._queue = _prof.InstrumentedQueue(maxsize=self.prefetch,
+                                              name="async_iterator_queue")
         self._thread = threading.Thread(target=self._worker,
                                         args=(self._queue, self._stop),
                                         daemon=True)
@@ -538,7 +541,10 @@ class DevicePrefetcher:
                  max_retries: int = 0, retry_backoff: float = 0.05):
         from deeplearning4j_tpu.train.stepping import group_into_megabatches
         self._placement = placement
-        self._queue = queue.Queue(maxsize=max(1, prefetch))
+        # instrumented queue (PR-8 carried follow-up): staging-buffer
+        # contention is observable via dl4j_lock_*{lock=prefetch_queue}
+        self._queue = _prof.InstrumentedQueue(maxsize=max(1, prefetch),
+                                              name="prefetch_queue")
         self._stop = threading.Event()
         if max_retries and isinstance(batches, DataSetIterator):
             # transient-error retry happens at the pull seam: a generator

@@ -7,4 +7,5 @@ from deeplearning4j_tpu.nn.config import (  # noqa: F401
     NeuralNetConfiguration,
 )
 from deeplearning4j_tpu.nn import layers  # noqa: F401
+from deeplearning4j_tpu.nn.augment import DeviceAugmentation  # noqa: F401
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork  # noqa: F401

@@ -25,6 +25,7 @@ from deeplearning4j_tpu import profiler as _prof
 from deeplearning4j_tpu.analysis import churn as _churn
 from deeplearning4j_tpu.data.dataset import DataSet, DataSetIterator, MultiDataSet
 from deeplearning4j_tpu.evaluation.evaluation import Evaluation
+from deeplearning4j_tpu.nn import augment as _augment_mod
 from deeplearning4j_tpu.nn import layers as L
 from deeplearning4j_tpu.nn import preprocessors as pp
 from deeplearning4j_tpu.nn.config import InputType, NeuralNetConfiguration
@@ -404,6 +405,7 @@ class ComputationGraph:
         self._train_step_cache = {}
         self._megastep_cache = {}
         self._fwd_cache = None
+        self._augment = None    # DeviceAugmentation (see setDeviceAugmentation)
         self._initialized = False
 
     def validate(self, batch_size: int = None, data_devices: int = None,
@@ -565,11 +567,19 @@ class ComputationGraph:
 
         seed = base.seed
 
+        augment = self._augment
+
         def step(params, states, opt_state, t, ins, labels, lmasks):
             # per-step RNG from the donated device counter (see
             # MultiLayerNetwork._make_train_step: avoids a host->device
             # upload per iteration, stays resume-deterministic)
             key = jax.random.fold_in(jax.random.PRNGKey(seed), t)
+            if augment is not None:
+                # on-device augmentation prelude: every 4-D (NCHW image)
+                # input runs the seeded chain; non-image inputs pass
+                # through (nn.augment.maybe_augment)
+                ins = {name: _augment_mod.maybe_augment(augment, v, t)
+                       for name, v in ins.items()}
 
             def loss_fn(p):
                 return self._loss_and_reg(p, states, ins, labels, True, key,
@@ -601,9 +611,24 @@ class ComputationGraph:
             self._t_dev = jnp.asarray(self._iteration, jnp.int32)
         return self._t_dev
 
+    def setDeviceAugmentation(self, augment) -> "ComputationGraph":
+        """Attach (or detach with ``None``) a
+        :class:`~deeplearning4j_tpu.nn.augment.DeviceAugmentation` — the
+        seeded on-device crop/flip/normalize prelude; semantics identical
+        to ``MultiLayerNetwork.setDeviceAugmentation`` (image inputs
+        only; a changed chain invalidates the compiled step caches)."""
+        cur = getattr(self, "_augment", None)
+        same = (augment.signature() if augment is not None else None) == \
+            (cur.signature() if cur is not None else None)
+        self._augment = augment
+        if not same:
+            self._train_step_cache.clear()
+            self._megastep_cache.clear()
+        return self
+
     def fit(self, data, labels=None, epochs: int = 1,
             steps_per_dispatch: int = 1, prefetch: int = 2,
-            checkpoint=None, nan_policy=None, faults=None):
+            checkpoint=None, nan_policy=None, faults=None, augment=None):
         """Accepts a DataSetIterator, DataSet, MultiDataSet, or arrays.
         ``steps_per_dispatch=K`` runs K update steps per compiled dispatch
         with double-buffered device prefetch (``prefetch=0`` = synchronous
@@ -611,10 +636,14 @@ class ComputationGraph:
         ``checkpoint=``/``nan_policy=``/``faults=`` enable the fault-
         tolerance layer (atomic checkpoint + auto-resume, NaN recovery
         policies, deterministic fault injection) — semantics identical to
-        MultiLayerNetwork.fit."""
+        MultiLayerNetwork.fit, as are ``augment=`` (on-device
+        augmentation) and the native megabatch pull from staged pipeline
+        iterators."""
         if not self._initialized:
             self.init()
         self._ensure_opt_state()
+        if augment is not None:
+            self.setDeviceAugmentation(augment)
         _maybe_attach_env_profiler(self)
         session = None
         if checkpoint is not None or nan_policy is not None \
@@ -627,6 +656,10 @@ class ComputationGraph:
             if isinstance(data, DataSetIterator):
                 if session is None or not session.consume_skip_reset():
                     data.reset()
+                if _stepping.use_dispatch_stream(data, steps_per_dispatch,
+                                                 session):
+                    yield from data.dispatch_stream()
+                    return
                 while data.hasNext():
                     yield data.next()
             elif isinstance(data, (DataSet, MultiDataSet)):

@@ -26,6 +26,7 @@ from deeplearning4j_tpu.data.dataset import (AsyncDataSetIterator, DataSet,
                                              DataSetIterator,
                                              IterableDataSetIterator)
 from deeplearning4j_tpu.evaluation.evaluation import Evaluation, RegressionEvaluation
+from deeplearning4j_tpu.nn import augment as _augment_mod
 from deeplearning4j_tpu.nn import layers as L
 from deeplearning4j_tpu.nn.config import MultiLayerConfiguration
 from deeplearning4j_tpu.train import stepping as _stepping
@@ -164,6 +165,7 @@ class MultiLayerNetwork:
         self._megastep_cache = {}
         self._tbptt_step_cache = {}
         self._fwd_cache = None
+        self._augment = None    # DeviceAugmentation (see setDeviceAugmentation)
         self._score = float("nan")
         self._initialized = False
 
@@ -293,6 +295,8 @@ class MultiLayerNetwork:
         frozen = getattr(self, "_frozen_layers", None) or set()
         seed = base.seed
 
+        augment = self._augment
+
         def step(params, states, opt_state, t, x, y, fmask, lmask):
             # per-step RNG derived ON DEVICE from the (donated) iteration
             # counter: a fresh host-built PRNGKey per step costs a full
@@ -300,6 +304,11 @@ class MultiLayerNetwork:
             # fold_in(base, t) keeps dropout deterministic per iteration
             # (and therefore exact-resume stable)
             key = jax.random.fold_in(jax.random.PRNGKey(seed), t)
+            # on-device augmentation prelude (nn.augment): uint8 pixels
+            # off the staged pipeline are cast + crop/flip/normalized
+            # HERE, seeded by fold_in(aug_seed, t) — bit-reproducible per
+            # seed and identical under the scanned megastep
+            x = _augment_mod.maybe_augment(augment, x, t)
             tf = t.astype(jnp.float32)
 
             def loss_fn(p):
@@ -339,9 +348,26 @@ class MultiLayerNetwork:
             self._t_dev = jnp.asarray(self._iteration, jnp.int32)
         return self._t_dev
 
+    def setDeviceAugmentation(self, augment) -> "MultiLayerNetwork":
+        """Attach (or detach with ``None``) a
+        :class:`~deeplearning4j_tpu.nn.augment.DeviceAugmentation`: the
+        chain runs as a seeded prelude INSIDE the compiled train step, so
+        uint8 pixels off the staged pipeline are cast + augmented on
+        device. A chain with a different :meth:`signature` invalidates
+        the compiled step caches (one recompile); re-attaching an equal
+        chain keeps them — steady state stays at zero recompiles."""
+        cur = getattr(self, "_augment", None)
+        same = (augment.signature() if augment is not None else None) == \
+            (cur.signature() if cur is not None else None)
+        self._augment = augment
+        if not same:
+            self._train_step_cache.clear()
+            self._megastep_cache.clear()
+        return self
+
     def fit(self, data, labels=None, epochs: int = 1,
             steps_per_dispatch: int = 1, prefetch: int = 2,
-            checkpoint=None, nan_policy=None, faults=None):
+            checkpoint=None, nan_policy=None, faults=None, augment=None):
         """ref: MultiLayerNetwork.fit(DataSetIterator) — accepts an
         iterator, a DataSet, or (features, labels) arrays.
 
@@ -377,10 +403,21 @@ class MultiLayerNetwork:
         FaultPlan(...)`` injects deterministic failures for testing.
         SIGTERM/SIGINT during a checkpointed fit finishes the in-flight
         (mega)step, writes a checkpoint marked ``"preempted"``, and
-        returns cleanly."""
+        returns cleanly.
+
+        ``augment=DeviceAugmentation(...)`` compiles crop/flip/normalize
+        into the train step itself (see :meth:`setDeviceAugmentation`).
+        A staged iterator whose ``megabatch_steps`` matches
+        ``steps_per_dispatch`` feeds the fit through its native
+        ``dispatch_stream()`` — whole contiguous ``[K, B, ...]`` uint8
+        megabatches, ONE H2D transfer per dispatch instead of K
+        per-batch copies + stacks (resilience sessions keep the
+        per-batch path: their cursors are recorded at pull granularity)."""
         if not self._initialized:
             self.init()
         self._ensure_opt_state()
+        if augment is not None:
+            self.setDeviceAugmentation(augment)
         _maybe_attach_env_profiler(self)
         tbptt_len = self._tbptt_length()
         session = None
@@ -394,6 +431,10 @@ class MultiLayerNetwork:
             if isinstance(data, DataSetIterator):
                 if session is None or not session.consume_skip_reset():
                     data.reset()
+                if _stepping.use_dispatch_stream(data, steps_per_dispatch,
+                                                 session):
+                    yield from data.dispatch_stream()
+                    return
                 while data.hasNext():
                     yield data.next()
             elif isinstance(data, DataSet):

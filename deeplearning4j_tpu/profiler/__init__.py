@@ -23,11 +23,14 @@ static deadlock lint).
 Instrumented seams: ``ops.registry`` dispatch, ``native.runtime``
 (compile cache, H2D/D2H), ``parallel.{wrapper,data}`` (replication /
 shard transfers), the ``nn.{multilayer,graph}`` fit loops (step time,
-data-wait vs compute, ``train:megastep`` spans +
+data-wait vs compute + the ``dl4j_train_overlap_ratio`` gauge /
+:func:`data_overlap_ratio`, ``train:megastep`` spans +
 ``dl4j_steps_per_dispatch`` for multi-step dispatch), the input
 pipeline (``dl4j_{async_iterator,prefetch}_queue_depth``,
-``dl4j_prefetch_h2d_bytes_total``), and the listener bus
-(``MetricsListener``, ``PerformanceListener``).
+``dl4j_prefetch_h2d_bytes_total``, and the staged pipeline's per-stage
+``dl4j_pipeline_{stage_seconds,stall_seconds,queue_depth,
+h2d_bytes_total}``), and the listener bus (``MetricsListener``,
+``PerformanceListener``).
 
 Everything is near-zero-cost when disabled: one module-level flag / enum
 read before any span or sample is allocated.
@@ -37,6 +40,7 @@ import time as _time
 
 from deeplearning4j_tpu.profiler.locks import (InstrumentedCondition,
                                                InstrumentedLock,
+                                               InstrumentedQueue,
                                                InstrumentedRLock,
                                                LockOrderInversionError,
                                                disable_lock_order_witness,
@@ -59,9 +63,11 @@ __all__ = [
     "SpanTracer", "trace_span", "get_tracer", "enable_tracing",
     "disable_tracing", "tracing_enabled", "instrumentation_active",
     "now_us", "observe_region", "timed_region", "iter_with_data_wait",
+    "data_overlap_ratio",
     "InstrumentedLock", "InstrumentedRLock", "InstrumentedCondition",
-    "LockOrderInversionError", "enable_lock_order_witness",
-    "disable_lock_order_witness", "lock_order_edges",
+    "InstrumentedQueue", "LockOrderInversionError",
+    "enable_lock_order_witness", "disable_lock_order_witness",
+    "lock_order_edges",
 ]
 
 
@@ -117,12 +123,37 @@ class timed_region:
 
 _SENTINEL = object()
 
+# data-wait-vs-compute overlap: 1.0 = the input pipeline is fully hidden
+# behind dispatched compute, 0.5 = the host spends as long waiting for
+# batches as dispatching them (data-starved). Updated by
+# iter_with_data_wait; dl4j_train_data_wait_seconds / _step_seconds hold
+# the raw halves.
+_OVERLAP_RATIO = get_registry().gauge(
+    "dl4j_train_overlap_ratio",
+    "Compiled-dispatch time as a fraction of dispatch + data-wait time "
+    "(1.0 = input pipeline fully overlapped with compute; low values = "
+    "the chip is starving for data)")
+
+
+def data_overlap_ratio():
+    """Cumulative dispatch/(dispatch + data_wait) from the two fit-loop
+    histograms — the overlap number the data-pipeline bench reports.
+    None before any instrumented fit ran."""
+    reg = get_registry()
+    step = reg.get("dl4j_train_step_seconds")
+    wait = reg.get("dl4j_train_data_wait_seconds")
+    s = step.sum if step is not None else 0.0
+    w = wait.sum if wait is not None else 0.0
+    total = s + w
+    return None if total == 0 else s / total
+
 
 def iter_with_data_wait(batches):
     """Yield from ``batches`` measuring each pull as ``train:data_wait``
     (histogram + span) — the data-wait half of the data-wait-vs-compute
-    split both fit loops report. The terminal pull (StopIteration) is not
-    recorded: it measures exhaustion, not a batch wait."""
+    split both fit loops report (``dl4j_train_overlap_ratio`` tracks the
+    running ratio). The terminal pull (StopIteration) is not recorded: it
+    measures exhaustion, not a batch wait."""
     it = iter(batches)
     while True:
         active = instrumentation_active()
@@ -135,4 +166,7 @@ def iter_with_data_wait(batches):
             observe_region("train:data_wait", "dl4j_train_data_wait_seconds",
                            "Host wait for the next training batch", t0u,
                            _time.perf_counter() - t0)
+            ratio = data_overlap_ratio()
+            if ratio is not None:
+                _OVERLAP_RATIO.set(ratio)
         yield ds

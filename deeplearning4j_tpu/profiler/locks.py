@@ -30,6 +30,7 @@ cycle exists in the code, the witness proves a real schedule walked it.
 
 from __future__ import annotations
 
+import queue as _queue
 import threading
 import time
 import warnings
@@ -306,3 +307,34 @@ class InstrumentedCondition(threading.Condition):
         self.name = str(name)
         super().__init__(lock if lock is not None
                          else InstrumentedRLock(name))
+
+
+class InstrumentedQueue(_queue.Queue):
+    """``queue.Queue`` whose internal mutex (and the three conditions
+    built on it) is an :class:`InstrumentedRLock` — every put/get
+    reports wait/hold/contention under the queue's role name. The
+    input-pipeline hot-path queues (DevicePrefetcher,
+    AsyncDataSetIterator) use this so queue contention shows up in
+    ``dl4j_lock_*{lock=...}`` like any other lock; overhead with
+    instrumentation OFF is one module-flag check per op
+    (benchmarks/probe_lock_overhead.py pins it)."""
+
+    def __init__(self, maxsize: int = 0, name: str = "queue"):
+        super().__init__(maxsize)
+        # replace the plain primitives queue.Queue.__init__ installed;
+        # Condition drives the lock through the _release_save/
+        # _acquire_restore/_is_owned protocol InstrumentedRLock delegates
+        lock = InstrumentedRLock(name)
+        self.mutex = lock
+        self.not_empty = threading.Condition(lock)
+        self.not_full = threading.Condition(lock)
+        self.all_tasks_done = threading.Condition(lock)
+
+
+# PR-8 carried follow-up: the metrics registry's get-or-create lock is a
+# hot path (observe_region resolves its histogram through it every train
+# step) — swap it for an instrumented lock. Safe against recursion: the
+# dl4j_lock_* families above were registered BEFORE the swap, so a
+# lock-metric record only takes per-family/child locks (plain
+# threading.Lock), never the registry lock.
+_REG._lock = InstrumentedLock("metrics_registry")

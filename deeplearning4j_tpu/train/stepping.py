@@ -157,12 +157,20 @@ def stack_megabatch(group: List[Union[DataSet, MultiDataSet]]) -> MegaBatch:
 def group_into_megabatches(batches: Iterable, steps: int) -> Iterator:
     """Yield MegaBatches of ``steps`` consecutive same-signature batches;
     batches stranded by a signature change or the epoch tail are yielded
-    as plain DataSets (single-step fits) — equivalence over cleverness."""
+    as plain DataSets (single-step fits) — equivalence over cleverness.
+    Items that arrive ALREADY stacked (a staged pipeline's
+    ``dispatch_stream()`` emits contiguous MegaBatch buffers directly —
+    no re-stack, one H2D transfer) pass through untouched."""
     if steps <= 1:
         yield from batches
         return
     pending, sig = [], None
     for ds in batches:
+        if isinstance(ds, MegaBatch):
+            yield from pending
+            pending, sig = [], None
+            yield ds
+            continue
         s = batch_signature(ds)
         if pending and s != sig:
             yield from pending
@@ -173,6 +181,18 @@ def group_into_megabatches(batches: Iterable, steps: int) -> Iterator:
             yield stack_megabatch(pending)
             pending = []
     yield from pending
+
+
+def use_dispatch_stream(data, steps: int, session) -> bool:
+    """True when a fit can pull native megabatches from a staged
+    pipeline iterator: K matches the iterator's declared staging, no
+    resilience session (cursors are recorded per pull — a K-batch pull
+    would make them dispatch-granular), and no per-batch preprocessor
+    (those run on the host path; use device augmentation instead)."""
+    return (steps > 1 and session is None
+            and getattr(data, "megabatch_steps", 1) == steps
+            and hasattr(data, "dispatch_stream")
+            and getattr(data, "_pre", None) is None)
 
 
 def scan_megastep(body, num_carry: int):

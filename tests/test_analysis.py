@@ -1604,3 +1604,119 @@ class TestPureStaticConcurrency:
                               capture_output=True, text=True, timeout=120)
         assert proc.returncode == 0, proc.stderr
         assert "PURE-STATIC-CONCURRENCY-OK" in proc.stdout
+
+
+class TestInputPipelineLint:
+    """DL4J-W108: can this host feed this chip (analysis/pipeline.py)."""
+
+    def _conv_conf(self):
+        return (NeuralNetConfiguration.Builder().list()
+                .layer(ConvolutionLayer(nOut=64, kernelSize=(3, 3)))
+                .layer(ConvolutionLayer(nOut=128, kernelSize=(3, 3)))
+                .layer(DenseLayer(nOut=64, activation="relu"))
+                .layer(OutputLayer(nOut=8))
+                .setInputType(InputType.convolutional(64, 64, 3))
+                .build())
+
+    def test_starved_pipeline_flags_w108(self):
+        from deeplearning4j_tpu.analysis import InputPipelineSpec, analyze
+        spec = InputPipelineSpec(workers=1, batch_size=256,
+                                 decode_ms_per_img=50.0, h2d_mbps=6.2,
+                                 dtype="float32")
+        report = analyze(self._conv_conf(), input_pipeline=spec)
+        w108 = [d for d in report.diagnostics if d.code == "DL4J-W108"]
+        assert len(w108) == 1
+        assert "cannot feed this chip" in w108[0].message
+        assert "uint8" in w108[0].fix_hint      # float32 link: suggest bytes
+
+    def test_fed_pipeline_clean(self):
+        from deeplearning4j_tpu.analysis import InputPipelineSpec, analyze
+        spec = InputPipelineSpec(workers=256, batch_size=256,
+                                 decode_ms_per_img=1.0, h2d_mbps=100000,
+                                 dtype="uint8")
+        report = analyze(self._conv_conf(), input_pipeline=spec)
+        assert "DL4J-W108" not in [d.code for d in report.diagnostics]
+
+    def test_measured_device_rate_overrides_estimate(self):
+        from deeplearning4j_tpu.analysis import InputPipelineSpec, analyze
+        # decode bound 2000 img/s: above a measured 1000 img/s device
+        # rate (clean), below a measured 10000 img/s one (W108)
+        base = dict(workers=2, batch_size=64, decode_ms_per_img=1.0,
+                    dtype="uint8")
+        clean = analyze(self._conv_conf(), input_pipeline=InputPipelineSpec(
+            device_img_per_sec=1000, **base))
+        assert "DL4J-W108" not in [d.code for d in clean.diagnostics]
+        hot = analyze(self._conv_conf(), input_pipeline=InputPipelineSpec(
+            device_img_per_sec=10000, **base))
+        assert "DL4J-W108" in [d.code for d in hot.diagnostics]
+
+    def test_spec_parse_and_coerce(self):
+        from deeplearning4j_tpu.analysis import InputPipelineSpec
+        s = InputPipelineSpec.parse(
+            "workers=8,batch=256,decode_ms=1.3,h2d_mbps=6.2,hw=224,"
+            "dtype=uint8,mfu=0.25")
+        assert (s.workers, s.batch_size, s.height, s.width) == \
+            (8, 256, 224, 224)
+        assert s.assumed_mfu == 0.25
+        assert InputPipelineSpec.coerce(s) is s
+        d = InputPipelineSpec.coerce({"workers": 2, "batch_size": 32})
+        assert d.workers == 2
+        with pytest.raises(ValueError, match="known keys"):
+            InputPipelineSpec.parse("wrkrs=8")
+        with pytest.raises(ValueError, match="workers"):
+            InputPipelineSpec.parse("batch=32")
+
+    def test_w108_suppressible_and_documented(self):
+        from deeplearning4j_tpu.analysis import InputPipelineSpec, analyze
+        assert "DL4J-W108" in DIAGNOSTIC_CODES
+        spec = InputPipelineSpec(workers=1, batch_size=256,
+                                 decode_ms_per_img=50.0)
+        report = analyze(self._conv_conf(), input_pipeline=spec,
+                         suppress=["W108"])
+        assert "DL4J-W108" not in [d.code for d in report.diagnostics]
+
+    def test_cli_pipeline_flag(self, capsys, tmp_path, monkeypatch):
+        mod = tmp_path / "feedmodel.py"
+        mod.write_text(
+            "from deeplearning4j_tpu.nn.config import (InputType,\n"
+            "    NeuralNetConfiguration)\n"
+            "from deeplearning4j_tpu.nn.layers import (ConvolutionLayer,\n"
+            "    DenseLayer, OutputLayer)\n"
+            "conf = (NeuralNetConfiguration.Builder().list()\n"
+            "        .layer(ConvolutionLayer(nOut=64, kernelSize=(3, 3)))\n"
+            "        .layer(DenseLayer(nOut=64, activation='relu'))\n"
+            "        .layer(OutputLayer(nOut=8))\n"
+            "        .setInputType(InputType.convolutional(64, 64, 3))\n"
+            "        .build())\n")
+        monkeypatch.syspath_prepend(str(tmp_path))
+        from deeplearning4j_tpu.analysis.__main__ import main
+        assert main(["feedmodel:conf", "--pipeline",
+                     "workers=1,batch=256,decode_ms=50.0"]) == 1
+        assert "DL4J-W108" in capsys.readouterr().out
+        # typo'd spec: clean usage error, not a traceback
+        with pytest.raises(SystemExit) as ei:
+            main(["feedmodel:conf", "--pipeline", "wrkrs=1"])
+        assert ei.value.code == 2
+
+    def test_graph_config_needs_measured_rate(self):
+        """Graph configs have no jax-free FLOP propagation: without a
+        measured device rate the lint stays silent instead of guessing."""
+        from deeplearning4j_tpu.analysis import InputPipelineSpec, analyze
+        conf = (NeuralNetConfiguration.Builder().graphBuilder()
+                .addInputs("in")
+                .addLayer("c", ConvolutionLayer(nOut=8, kernelSize=(3, 3)),
+                          "in")
+                .addLayer("d", DenseLayer(nOut=16, activation="relu"), "c")
+                .addLayer("out", OutputLayer(nOut=4), "d")
+                .setOutputs("out")
+                .setInputTypes(InputType.convolutional(16, 16, 3)))
+        spec = InputPipelineSpec(workers=1, batch_size=64,
+                                 decode_ms_per_img=50.0, height=16,
+                                 width=16)
+        r = analyze(conf, input_pipeline=spec)
+        assert "DL4J-W108" not in [d.code for d in r.diagnostics]
+        spec2 = InputPipelineSpec(workers=1, batch_size=64,
+                                  decode_ms_per_img=50.0, height=16,
+                                  width=16, device_img_per_sec=10000)
+        r2 = analyze(conf, input_pipeline=spec2)
+        assert "DL4J-W108" in [d.code for d in r2.diagnostics]

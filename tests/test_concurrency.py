@@ -516,3 +516,85 @@ class TestInstrumentedLocks:
             assert isinstance(server.breaker._lock, prof.InstrumentedLock)
         finally:
             server.close()
+
+
+class TestInstrumentedQueue:
+    """PR-10 adoption: queue.Queue drop-in with instrumented internals
+    (the DevicePrefetcher/AsyncDataSetIterator hot-path queues)."""
+
+    def setup_method(self):
+        prof.set_profiling_mode(None)
+        prof.disable_lock_order_witness()
+
+    teardown_method = setup_method
+
+    def _hold_count(self, label):
+        m = prof.get_registry().get("dl4j_lock_hold_seconds")
+        child = m.children().get((label,))
+        return child.count if child is not None else 0
+
+    def test_drop_in_queue_semantics(self):
+        import queue
+        q = prof.InstrumentedQueue(maxsize=2, name="test:q")
+        q.put(1)
+        q.put(2)
+        with pytest.raises(queue.Full):
+            q.put_nowait(3)
+        assert q.get() == 1 and q.get() == 2
+        with pytest.raises(queue.Empty):
+            q.get_nowait()
+        assert q.qsize() == 0 and q.empty()
+
+    def test_blocking_handoff_across_threads(self):
+        q = prof.InstrumentedQueue(maxsize=1, name="test:q_handoff")
+        got = []
+
+        def consumer():
+            for _ in range(20):
+                got.append(q.get())
+        t = threading.Thread(target=consumer)
+        t.start()
+        for i in range(20):
+            q.put(i)
+        t.join(10.0)
+        assert got == list(range(20))
+
+    def test_records_under_profiling_free_when_off(self):
+        before_off = self._hold_count("test:q_metrics")
+        q = prof.InstrumentedQueue(name="test:q_metrics")
+        q.put(1)
+        q.get()
+        assert self._hold_count("test:q_metrics") == before_off  # OFF: nothing
+        prof.set_profiling_mode(prof.ProfilingMode.BASIC)
+        q.put(2)
+        q.get()
+        assert self._hold_count("test:q_metrics") > before_off
+
+    @races
+    def test_prefetcher_queue_instrumented_end_to_end(self):
+        """The real DevicePrefetcher runs on an InstrumentedQueue and
+        still delivers every staged batch under preemptive stress."""
+        from deeplearning4j_tpu.data.dataset import (DataSet,
+                                                     DevicePrefetcher)
+        prof.set_profiling_mode(prof.ProfilingMode.BASIC)
+        rng = np.random.RandomState(0)
+        batches = [DataSet(rng.randn(4, 3).astype(np.float32),
+                           np.eye(2, dtype=np.float32)[rng.randint(0, 2, 4)])
+                   for _ in range(16)]
+        with preemptive_stress(seed=5):
+            with DevicePrefetcher(iter(batches), prefetch=2) as pf:
+                seen = sum(1 for _ in pf)
+        assert seen == 16
+        assert isinstance(pf._queue, prof.InstrumentedQueue)
+        assert self._hold_count("prefetch_queue") > 0
+
+    def test_registry_lock_is_instrumented(self):
+        """PR-8 carried follow-up pin: the metrics registry's hot-path
+        get-or-create lock reports into dl4j_lock_* when profiling."""
+        reg = prof.get_registry()
+        assert isinstance(reg._lock, prof.InstrumentedLock)
+        assert reg._lock.name == "metrics_registry"
+        prof.set_profiling_mode(prof.ProfilingMode.BASIC)
+        before = self._hold_count("metrics_registry")
+        reg.gauge("dl4j_test_registry_lock_probe", "probe")
+        assert self._hold_count("metrics_registry") > before

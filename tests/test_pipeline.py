@@ -1,14 +1,44 @@
-"""Multi-worker image pipeline: correctness of the shared-memory ring
-(ref test model: datavec-data-image record-reader round-trip tests +
+"""Staged multi-worker image pipeline: correctness of the shared-memory
+megabatch ring, the composable stage API, cursor/seek, worker-death
+detection, and the on-device augmentation path (ref test model:
+datavec-data-image record-reader round-trip tests +
 AsyncDataSetIterator ordering tests, SURVEY.md §4)."""
 
 import os
+import threading
+import time
 
 import numpy as np
 import pytest
 
-from deeplearning4j_tpu.data.pipeline import (MultiWorkerImageIterator,
+from deeplearning4j_tpu.data.pipeline import (DataPipelineError,
+                                              ImagePipeline,
+                                              MultiWorkerImageIterator,
                                               _decode_one)
+
+
+def _build_conv_net(h=16, w=16, seed=0, dtype="float", n_out=3):
+    from deeplearning4j_tpu.nn.config import (InputType,
+                                              NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers import (ConvolutionLayer,
+                                              GlobalPoolingLayer,
+                                              OutputLayer)
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    conf = (NeuralNetConfiguration.Builder().seed(seed).dataType(dtype)
+            .list()
+            .layer(ConvolutionLayer(kernelSize=(3, 3), nOut=4,
+                                    activation="relu"))
+            .layer(GlobalPoolingLayer())
+            .layer(OutputLayer(nOut=n_out, lossFunction="mcxent",
+                               activation="softmax"))
+            .setInputType(InputType.convolutional(h, w, 3))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _leaves(net):
+    import jax
+    return [np.asarray(a) for a in jax.tree_util.tree_leaves(net._params)]
 
 @pytest.fixture(scope="module")
 def image_root(tmp_path_factory):
@@ -118,23 +148,8 @@ class TestMultiWorkerPipeline:
     def test_uint8_batches_train_end_to_end(self, image_root):
         """uint8 features cast on device inside the jitted step
         (nn/layers.policy_cast) — both fp32 and bf16 policies."""
-        from deeplearning4j_tpu.nn.config import (InputType,
-                                                  NeuralNetConfiguration)
-        from deeplearning4j_tpu.nn.layers import (ConvolutionLayer,
-                                                  GlobalPoolingLayer,
-                                                  OutputLayer)
         for dtype in ("float", "bfloat16"):
-            conf = (NeuralNetConfiguration.Builder().seed(0).dataType(dtype)
-                    .list()
-                    .layer(ConvolutionLayer(kernelSize=(3, 3), nOut=4,
-                                            activation="relu"))
-                    .layer(GlobalPoolingLayer())
-                    .layer(OutputLayer(nOut=3, lossFunction="mcxent",
-                                       activation="softmax"))
-                    .setInputType(InputType.convolutional(16, 16, 3))
-                    .build())
-            from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
-            net = MultiLayerNetwork(conf).init()
+            net = _build_conv_net(dtype=dtype)
             it = MultiWorkerImageIterator(image_root, 16, 16, batch_size=8,
                                           workers=1, drop_last=True)
             try:
@@ -142,3 +157,501 @@ class TestMultiWorkerPipeline:
                 assert np.isfinite(net.score())
             finally:
                 it.close()
+
+
+class TestStagedPipeline:
+    """The stage graph: megabatch staging, dispatch_stream, interleave,
+    the builder API, and the one-transfer-per-dispatch pin."""
+
+    @pytest.mark.quick
+    def test_dispatch_stream_matches_per_batch(self, image_root):
+        """dispatch_stream emits [K,B,C,H,W] MegaBatches for full groups
+        + plain DataSets for the leftover/tail, content identical to the
+        per-batch pull order (in-order emission, deterministic)."""
+        from deeplearning4j_tpu.train.stepping import MegaBatch
+        it = MultiWorkerImageIterator(image_root, 16, 16, batch_size=8,
+                                      workers=2, drop_last=False,
+                                      steps_per_dispatch=2)
+        try:
+            items = list(it.dispatch_stream())
+            # 37 imgs, B=8 -> 4 full batches -> 2 megas of K=2, tail of 5
+            kinds = [type(x).__name__ for x in items]
+            assert kinds == ["MegaBatch", "MegaBatch", "DataSet"]
+            assert items[0].features.shape == (2, 8, 3, 16, 16)
+            assert items[0].features.dtype == np.uint8
+            assert items[0].labels.shape == (2, 8, 3)
+            assert items[2].features.shape[0] == 5      # drop_last=False
+            flat = []
+            for x in items:
+                if isinstance(x, MegaBatch):
+                    flat.extend((x.features[j], x.labels[j])
+                                for j in range(x.steps))
+                else:
+                    flat.append((x.features, x.labels))
+            it.reset()
+            pulled = []
+            while it.hasNext():
+                ds = it.next()
+                pulled.append((ds.features, ds.labels))
+            assert len(pulled) == len(flat)
+            for (f1, y1), (f2, y2) in zip(flat, pulled):
+                np.testing.assert_array_equal(f1, f2)
+                np.testing.assert_array_equal(y1, y2)
+        finally:
+            it.close()
+
+    def test_partial_group_falls_back_to_singles(self, image_root):
+        """3 full batches with K=2: one full mega + one single (the
+        signature-stable tail fallback), then the host-decoded tail."""
+        from deeplearning4j_tpu.train.stepping import MegaBatch
+        it = MultiWorkerImageIterator(image_root, 16, 16, batch_size=12,
+                                      workers=2, drop_last=False,
+                                      steps_per_dispatch=2)
+        try:
+            items = list(it.dispatch_stream())
+            # 37 imgs, B=12 -> 3 full batches: 1 mega[2] + 1 single + tail(1)
+            assert [type(x).__name__ for x in items] == \
+                ["MegaBatch", "DataSet", "DataSet"]
+            assert isinstance(items[0], MegaBatch) and items[0].steps == 2
+            assert items[1].features.shape[0] == 12
+            assert items[2].features.shape[0] == 1
+        finally:
+            it.close()
+
+    def test_native_megabatch_fit_bit_exact_vs_stacked(self, image_root):
+        """fit() pulling native megabatches dispatches the SAME compiled
+        program on the same data as the group-and-stack path — params
+        bit-identical."""
+        n1 = _build_conv_net()
+        it1 = MultiWorkerImageIterator(image_root, 16, 16, batch_size=8,
+                                       workers=1, drop_last=True,
+                                       steps_per_dispatch=2)
+        n2 = _build_conv_net()
+        it2 = MultiWorkerImageIterator(image_root, 16, 16, batch_size=8,
+                                       workers=1, drop_last=True)  # K=1
+        try:
+            n1.fit(it1, epochs=1, steps_per_dispatch=2)   # native stream
+            n2.fit(it2, epochs=1, steps_per_dispatch=2)   # stacked groups
+            for a, b in zip(_leaves(n1), _leaves(n2)):
+                np.testing.assert_array_equal(a, b)
+        finally:
+            it1.close()
+            it2.close()
+
+    @pytest.mark.quick
+    def test_one_uint8_transfer_per_dispatch(self, image_root):
+        """THE megabatch H2D pin: each K-step dispatch stages exactly ONE
+        5-D uint8 feature transfer (today's path), not K per-batch puts."""
+        import jax
+        puts = []
+        orig = jax.device_put
+
+        def counting_put(x, *a, **kw):
+            if getattr(x, "ndim", 0) >= 4 and \
+                    getattr(x, "dtype", None) == np.uint8:
+                puts.append(x.shape)
+            return orig(x, *a, **kw)
+        net = _build_conv_net()
+        it = MultiWorkerImageIterator(image_root, 16, 16, batch_size=8,
+                                      workers=1, drop_last=True,
+                                      steps_per_dispatch=2)
+        jax.device_put = counting_put
+        try:
+            net.fit(it, epochs=1, steps_per_dispatch=2)
+        finally:
+            jax.device_put = orig
+            it.close()
+        # 4 full batches = 2 dispatches = 2 megabatch transfers, 5-D each
+        assert puts == [(2, 8, 3, 16, 16), (2, 8, 3, 16, 16)]
+
+    def test_interleave_mixes_directories_keeps_set(self, image_root):
+        plain = MultiWorkerImageIterator(image_root, 16, 16, batch_size=8,
+                                         workers=1, drop_last=False)
+        inter = MultiWorkerImageIterator(image_root, 16, 16, batch_size=8,
+                                         workers=1, drop_last=False,
+                                         interleave=3)
+        try:
+            def epoch(it):
+                out = []
+                while it.hasNext():
+                    ds = it.next()
+                    out += [(int(np.argmax(ds.labels[r])),
+                             int(ds.features[r].astype(np.int64).sum()))
+                            for r in range(ds.features.shape[0])]
+                return out
+            a, b = epoch(plain), epoch(inter)
+            assert sorted(a) == sorted(b)           # same multiset
+            assert a != b                           # different order
+            # un-interleaved directory order is class-sorted: the first
+            # batch is single-class; interleaved it must mix classes
+            assert len({cls for cls, _ in b[:8]}) > 1
+        finally:
+            plain.close()
+            inter.close()
+
+    @pytest.mark.quick
+    def test_builder_api(self, image_root):
+        p = (ImagePipeline.list(image_root).shuffle(seed=3)
+             .interleave(shards=2).decode(height=16, width=16, workers=2)
+             .batch(8).stage(steps_per_dispatch=2).prefetch(3))
+        names = [s.name for s in p.describe()]
+        assert names == ["list", "shuffle", "interleave", "decode",
+                         "batch", "stage", "prefetch"]
+        it = p.build()
+        try:
+            assert it.megabatch_steps == 2
+            assert it.n_slots == 3
+            assert it.shuffle
+            n = 0
+            while it.hasNext():
+                n += it.next().features.shape[0]
+            assert n == 32
+        finally:
+            it.close()
+
+    def test_builder_requires_core_stages(self, image_root):
+        with pytest.raises(ValueError, match="list"):
+            ImagePipeline.list(image_root).decode(height=8, width=8).build()
+
+    def test_overlap_ratio_and_stage_metrics(self, image_root):
+        """One instrumented staged fit records the overlap ratio AND the
+        per-stage pipeline series (decode/stage/tail seconds, h2d
+        bytes)."""
+        from deeplearning4j_tpu import profiler as prof
+        reg = prof.get_registry()
+        stage = reg.get("dl4j_pipeline_stage_seconds")
+        before = {lv: c.count for (lv,), c in stage.children().items()}
+        net = _build_conv_net()
+        it = MultiWorkerImageIterator(image_root, 16, 16, batch_size=8,
+                                      workers=2, drop_last=False,
+                                      steps_per_dispatch=2)
+        prev = prof.get_profiling_mode()
+        prof.set_profiling_mode(prof.ProfilingMode.BASIC)
+        try:
+            net.fit(it, epochs=1, steps_per_dispatch=2)
+            ratio = prof.data_overlap_ratio()
+            assert ratio is not None and 0.0 < ratio <= 1.0
+            gauge = reg.get("dl4j_train_overlap_ratio")
+            assert gauge is not None and 0.0 < gauge.value <= 1.0
+        finally:
+            prof.set_profiling_mode(prev)
+            it.close()
+        after = {lv: c.count for (lv,), c in stage.children().items()}
+        for lv in ("decode", "stage", "tail"):
+            assert after.get(lv, 0) > before.get(lv, 0), lv
+        assert reg.get("dl4j_pipeline_h2d_bytes_total").value > 0
+
+
+class TestCursorSeek:
+    """PR-5 cursor protocol on the staged pipeline: exact mid-epoch
+    resume with the seeded shuffle order rebuilt like
+    ListDataSetIterator."""
+
+    @pytest.mark.quick
+    def test_seek_resumes_exactly(self, image_root):
+        it = MultiWorkerImageIterator(image_root, 16, 16, batch_size=8,
+                                      workers=2, shuffle=True,
+                                      drop_last=True, seed=7)
+        try:
+            it.next()
+            cur = it.cursor()
+            assert cur == {"batch": 1, "epoch": 1}
+            rest = [int(it.next().features.astype(np.int64).sum())
+                    for _ in range(3)]
+            it.seek(cur)
+            resumed = [int(it.next().features.astype(np.int64).sum())
+                       for _ in range(3)]
+            assert rest == resumed
+        finally:
+            it.close()
+
+    def test_seek_across_epochs_and_instances(self, image_root):
+        """Epoch e's order rebuilds from seed+e-1 on a FRESH instance
+        (what checkpoint resume does) regardless of worker count."""
+        it1 = MultiWorkerImageIterator(image_root, 16, 16, batch_size=8,
+                                       workers=1, shuffle=True,
+                                       drop_last=True, seed=11)
+        try:
+            it1.reset()                 # epoch 2
+            it1.next()
+            cur = it1.cursor()
+            want = [int(it1.next().features.astype(np.int64).sum())
+                    for _ in range(2)]
+        finally:
+            it1.close()
+        it2 = MultiWorkerImageIterator(image_root, 16, 16, batch_size=8,
+                                       workers=1, shuffle=True,
+                                       drop_last=True, seed=11)
+        try:
+            it2.seek(cur)
+            got = [int(it2.next().features.astype(np.int64).sum())
+                   for _ in range(2)]
+            assert want == got
+        finally:
+            it2.close()
+
+    def test_seek_into_tail_region(self, image_root):
+        it = MultiWorkerImageIterator(image_root, 16, 16, batch_size=8,
+                                      workers=1, drop_last=False)
+        try:
+            it.seek({"batch": 4, "epoch": 0})   # all full batches consumed
+            assert it.hasNext()
+            ds = it.next()
+            assert ds.features.shape[0] == 5    # the 37 % 8 tail
+            assert not it.hasNext()
+        finally:
+            it.close()
+
+    def test_shuffle_epochs_differ_deterministically(self, image_root):
+        def two_epochs(workers):
+            it = MultiWorkerImageIterator(image_root, 16, 16, batch_size=8,
+                                          workers=workers, shuffle=True,
+                                          drop_last=True, seed=5)
+            try:
+                e1 = [int(it.next().features.astype(np.int64).sum())
+                      for _ in range(4)]
+                it.reset()
+                e2 = [int(it.next().features.astype(np.int64).sum())
+                      for _ in range(4)]
+                return e1, e2
+            finally:
+                it.close()
+        a1, a2 = two_epochs(workers=2)  # pool size must not change order
+        b1, b2 = two_epochs(workers=1)
+        assert a1 == b1 and a2 == b2    # deterministic across pool sizes
+        assert a1 != a2                 # epochs reshuffle
+
+
+class TestWorkerDeath:
+    """Satellite: a dead decode worker raises a structured error within
+    the liveness timeout instead of hanging next() forever."""
+
+    @pytest.mark.chaos
+    def test_killed_workers_raise_structured_error(self, image_root):
+        it = MultiWorkerImageIterator(image_root, 16, 16, batch_size=8,
+                                      workers=2, drop_last=True,
+                                      liveness_poll=0.2)
+        try:
+            for p in it._procs:
+                p.terminate()
+            t0 = time.monotonic()
+            with pytest.raises(DataPipelineError) as ei:
+                for _ in range(4):
+                    it.next()
+            assert time.monotonic() - t0 < 10.0     # bounded, no hang
+            msg = str(ei.value)
+            assert "decode worker died" in msg and "exitcode" in msg
+            from deeplearning4j_tpu.data.dataset import is_transient_error
+            assert not is_transient_error(ei.value)
+        finally:
+            it.close()
+
+    @pytest.mark.chaos
+    def test_reset_rebuilds_dead_pool(self, image_root):
+        it = MultiWorkerImageIterator(image_root, 16, 16, batch_size=8,
+                                      workers=1, drop_last=True,
+                                      liveness_poll=0.2)
+        try:
+            for p in it._procs:
+                p.terminate()
+            with pytest.raises(DataPipelineError):
+                for _ in range(4):
+                    it.next()
+            it.reset()
+            n = 0
+            while it.hasNext():
+                n += it.next().features.shape[0]
+            assert n == 32
+        finally:
+            it.close()
+
+    @pytest.mark.chaos
+    def test_decode_error_surfaces_not_hangs(self, image_root, tmp_path):
+        """A corrupt file is a decode error delivered to the consumer,
+        not a dead worker or a silent skip."""
+        import shutil
+        root = tmp_path / "imgs"
+        shutil.copytree(image_root, root)
+        bad = root / "ant" / "0.jpg"
+        bad.write_bytes(b"not a jpeg at all")
+        it = MultiWorkerImageIterator(str(root), 16, 16, batch_size=8,
+                                      workers=1, drop_last=True,
+                                      liveness_poll=0.2)
+        try:
+            with pytest.raises(DataPipelineError, match="decode failed"):
+                for _ in range(4):
+                    it.next()
+            # the error is latched: a retried pull re-raises promptly
+            # instead of waiting forever for the megabatch that can
+            # never complete (its errored sub-batch is gone for good)
+            t0 = time.monotonic()
+            with pytest.raises(DataPipelineError, match="decode failed"):
+                it.next()
+            assert time.monotonic() - t0 < 2.0
+        finally:
+            it.close()
+
+
+@pytest.mark.races
+class TestResetCloseRace:
+    """Satellite: mid-epoch reset()'s count-based drain vs a concurrent
+    close() — lifecycle calls serialize instead of deadlocking or
+    crashing on a torn-down queue."""
+
+    def test_concurrent_reset_and_close(self, image_root):
+        from deeplearning4j_tpu.faults import preemptive_stress
+        for seed in range(2):
+            it = MultiWorkerImageIterator(image_root, 16, 16, batch_size=8,
+                                          workers=1, drop_last=True,
+                                          liveness_poll=0.2)
+            it.next()                       # mid-epoch: tasks in flight
+            errs = []
+
+            def run(fn):
+                try:
+                    fn()
+                except Exception as e:      # pragma: no cover - failure path
+                    errs.append(e)
+            with preemptive_stress(seed=seed):
+                threads = [threading.Thread(target=run, args=(it.reset,)),
+                           threading.Thread(target=run, args=(it.close,))]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(timeout=30)
+                assert not any(t.is_alive() for t in threads), \
+                    "reset/close deadlocked"
+            assert not errs, errs
+            it.close()                      # idempotent afterwards
+
+    def test_reset_after_close_restarts(self, image_root):
+        it = MultiWorkerImageIterator(image_root, 16, 16, batch_size=8,
+                                      workers=1, drop_last=True)
+        it.next()
+        it.close()
+        it.reset()
+        try:
+            n = 0
+            while it.hasNext():
+                n += it.next().features.shape[0]
+            assert n == 32
+        finally:
+            it.close()
+
+
+class TestDeviceAugmentation:
+    """nn.augment: the seeded on-device crop/flip/normalize prelude."""
+
+    @pytest.mark.quick
+    def test_bit_reproducible_per_seed(self):
+        import jax
+        from deeplearning4j_tpu.data.dataset import DataSet
+        from deeplearning4j_tpu.nn.augment import DeviceAugmentation
+        rng = np.random.RandomState(0)
+        batches = [DataSet(rng.randint(0, 255, (8, 3, 16, 16), np.uint8),
+                           np.eye(3, dtype=np.float32)[
+                               rng.randint(0, 3, 8)]) for _ in range(4)]
+
+        def run(aug_seed):
+            net = _build_conv_net(h=12, w=12)       # crop 4: 16 -> 12
+            aug = (DeviceAugmentation(seed=aug_seed).crop(4)
+                   .random_flip().scale_to(0, 1))
+            net.fit(list(batches), steps_per_dispatch=2, augment=aug)
+            return _leaves(net)
+        a, b = run(7), run(7)
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x, y)     # same seed: identical
+        # a different seed draws different crops/flips
+        aug7 = DeviceAugmentation(seed=7).crop(4).random_flip()
+        aug8 = DeviceAugmentation(seed=8).crop(4).random_flip()
+        x = batches[0].features
+        o7 = np.asarray(aug7.apply(x, aug7.step_key(jax.numpy.int32(0))))
+        o8 = np.asarray(aug8.apply(x, aug8.step_key(jax.numpy.int32(0))))
+        assert not np.array_equal(o7, o8)
+
+    def test_host_transform_parity_fixture_epoch(self, image_root):
+        """Loss-curve parity pin: a deterministic transform (fixed flip)
+        run on the host in the workers vs compiled on device produces
+        BIT-IDENTICAL training (uint8-preserving op, same data, same
+        step RNG) — fp32 and bf16 policies."""
+        from deeplearning4j_tpu.data.image import FlipImageTransform
+        from deeplearning4j_tpu.nn.augment import DeviceAugmentation
+        from deeplearning4j_tpu.train.listeners import ScoreIterationListener
+        for dtype in ("float",):       # bf16 uint8-cast parity covered above
+            host = _build_conv_net(seed=3, dtype=dtype)
+            h_scores = ScoreIterationListener(1, out=lambda m: None)
+            host.setListeners([h_scores])
+            it_h = MultiWorkerImageIterator(image_root, 16, 16, batch_size=8,
+                                            workers=2, drop_last=True,
+                                            transform=FlipImageTransform(1))
+            dev = _build_conv_net(seed=3, dtype=dtype)
+            d_scores = ScoreIterationListener(1, out=lambda m: None)
+            dev.setListeners([d_scores])
+            it_d = MultiWorkerImageIterator(image_root, 16, 16, batch_size=8,
+                                            workers=2, drop_last=True)
+            try:
+                host.fit(it_h, epochs=1)
+                dev.fit(it_d, epochs=1, augment=DeviceAugmentation
+                        .from_transforms([FlipImageTransform(1)]))
+                np.testing.assert_array_equal(h_scores.history,
+                                              d_scores.history)
+                for a, b in zip(_leaves(host), _leaves(dev)):
+                    np.testing.assert_array_equal(a, b)
+            finally:
+                it_h.close()
+                it_d.close()
+
+    @pytest.mark.quick
+    def test_zero_steady_state_recompiles(self, image_root):
+        """Acceptance pin: augmented megastep fits compile ONE signature
+        — the W201 churn counter records no steady-state growth."""
+        from deeplearning4j_tpu.analysis.churn import get_churn_detector
+        from deeplearning4j_tpu.nn.augment import DeviceAugmentation
+        det = get_churn_detector()
+        net = _build_conv_net(h=12, w=12)
+        aug = DeviceAugmentation(seed=1).crop(4).random_flip()
+        it = MultiWorkerImageIterator(image_root, 16, 16, batch_size=8,
+                                      workers=2, drop_last=True,
+                                      steps_per_dispatch=2)
+        try:
+            for _ in range(2):
+                net.fit(it, epochs=1, steps_per_dispatch=2, augment=aug)
+        finally:
+            it.close()
+        assert det.signature_count("MultiLayerNetwork.megastep",
+                                   owner=net) == 1
+        assert det.diagnostics_for(net) == []
+
+    def test_same_signature_reattach_keeps_cache(self, image_root):
+        from deeplearning4j_tpu.nn.augment import DeviceAugmentation
+        net = _build_conv_net()
+        a1 = DeviceAugmentation(seed=1).flip(1)
+        a2 = DeviceAugmentation(seed=1).flip(1)
+        assert a1.signature() == a2.signature()
+        net.setDeviceAugmentation(a1)
+        net._train_step_cache["sentinel"] = "x"
+        net.setDeviceAugmentation(a2)               # equal: cache kept
+        assert "sentinel" in net._train_step_cache
+        net.setDeviceAugmentation(DeviceAugmentation(seed=2).flip(1))
+        assert "sentinel" not in net._train_step_cache
+
+    def test_from_transforms_unsupported_raises(self):
+        from deeplearning4j_tpu.data.image import (PipelineImageTransform,
+                                                   RotateImageTransform,
+                                                   ScaleImageTransform)
+        from deeplearning4j_tpu.nn.augment import DeviceAugmentation
+        with pytest.raises(ValueError, match="no device kernel"):
+            DeviceAugmentation.from_transforms([RotateImageTransform(10)])
+        with pytest.raises(ValueError, match="probabilistic"):
+            DeviceAugmentation.from_transforms([PipelineImageTransform(
+                [(ScaleImageTransform(0.5), 0.3)])])
+
+    def test_output_hw_and_crop_shapes(self):
+        import jax
+        from deeplearning4j_tpu.nn.augment import DeviceAugmentation
+        aug = DeviceAugmentation(seed=0).crop(4).random_flip()
+        assert aug.output_hw(16, 16) == (12, 12)
+        x = np.arange(2 * 3 * 16 * 16, dtype=np.uint8).reshape(2, 3, 16, 16)
+        out = aug.apply(x, jax.random.PRNGKey(0))
+        assert out.shape == (2, 3, 12, 12)
+        assert out.dtype == np.float32
