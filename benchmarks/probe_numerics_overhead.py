@@ -1,0 +1,145 @@
+"""Probe: fit-loop overhead of the nonfinite-provenance sanitizer.
+
+ISSUE 11 acceptance: the sanitizer rides the existing one-flag-check
+instrumentation path — sanitizer OFF costs one enum read per dispatch
+(~0%: the "off" mode IS the ship baseline), and provenance ON must add
+< 5% on top of the panic mode it extends.  The legacy NAN_PANIC gate
+already pays a per-step host sync to scan the loss (that is what a
+panic mode is); provenance adds ONE fused device-side state-copy
+dispatch per step, and the eager replay runs only on failure.
+
+Four modes on the same tiny-LeNet fixture (alternating median blocks,
+same discipline as probe_obs_overhead.py):
+
+  off     — ProfilingMode.OFF: the ship state
+  panic   — NAN_PANIC with enable_provenance(False): the legacy
+            attribution-free gate (loss sync only)
+  armed   — NAN_PANIC with provenance: + one snapshot dispatch/step
+            (the <5%-over-panic assertion)
+  ranges  — armed + track_value_ranges(every=10): the opt-in absmax
+            walk, reported but NOT asserted (a diagnostic dial — one
+            extra eager forward per sampled step is its documented
+            price)
+
+Prints ONE JSON line:
+
+  {"probe": "numerics_overhead", "off_sec_per_iter": ...,
+   "panic_sec_per_iter": ..., "armed_sec_per_iter": ...,
+   "ranges_sec_per_iter": ..., "panic_overhead_ratio": ...,
+   "provenance_overhead_ratio": ...}
+
+Run: python benchmarks/probe_numerics_overhead.py [--iters N]
+     [--assert-bounds]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+MODES = ("off", "panic", "armed", "ranges")
+
+
+def build():
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.models import zoo
+    net = zoo.LeNet(num_classes=3, input_shape=(1, 16, 16)).init()
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 16 * 16).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 8)]
+    return net, DataSet(x, y)
+
+
+def _set_mode(mode: str):
+    from deeplearning4j_tpu import profiler
+    from deeplearning4j_tpu.profiler import sanitizer
+    if mode == "off":
+        profiler.set_profiling_mode(profiler.ProfilingMode.OFF)
+        sanitizer.enable_provenance(True)
+        sanitizer.track_value_ranges(False)
+        return
+    profiler.set_profiling_mode(profiler.ProfilingMode.NAN_PANIC)
+    sanitizer.enable_provenance(mode != "panic")
+    sanitizer.track_value_ranges(mode == "ranges", every=10)
+
+
+def _block(net, ds, iters: int) -> float:
+    net.score()                   # sync before starting the clock
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        net.fit(ds)
+    net.score()                   # sync before stopping it
+    return (time.perf_counter() - t0) / iters
+
+
+def run(iters: int, warmup: int, blocks: int) -> dict:
+    """Alternating median blocks (see probe_obs_overhead.run): the
+    shared-host scheduler noise a back-to-back A/B would alias into the
+    ratio hits every mode equally instead."""
+    from deeplearning4j_tpu import profiler
+    from deeplearning4j_tpu.profiler import sanitizer
+    nets = {m: build() for m in MODES}
+    try:
+        for mode, (net, ds) in nets.items():
+            _set_mode(mode)
+            for _ in range(warmup):
+                net.fit(ds)
+        per = max(1, iters // blocks)
+        times = {m: [] for m in MODES}
+        for _ in range(blocks):
+            for mode, (net, ds) in nets.items():
+                _set_mode(mode)
+                times[mode].append(_block(net, ds, per))
+        # MIN of blocks, not median: the per-mode floor is the intrinsic
+        # cost — on a shared host, transient load inflates arbitrary
+        # blocks and a median can land on an inflated one for one mode
+        # and a quiet one for another, aliasing noise into the ratio
+        return {mode: min(ts) for mode, ts in times.items()}
+    finally:
+        profiler.set_profiling_mode(None)
+        sanitizer.enable_provenance(True)
+        sanitizer.track_value_ranges(False)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=480,
+                    help="total measured iterations per mode")
+    ap.add_argument("--warmup", type=int, default=30)
+    ap.add_argument("--blocks", type=int, default=16)
+    ap.add_argument("--assert-bounds", action="store_true",
+                    help="exit nonzero unless provenance adds < 5%% over "
+                         "the legacy panic gate")
+    args = ap.parse_args()
+
+    res = run(args.iters, args.warmup, args.blocks)
+    off, panic, armed, ranges = (res[m] for m in MODES)
+    provenance_ratio = armed / panic - 1.0
+    report = {
+        "probe": "numerics_overhead",
+        "iters": args.iters,
+        "off_sec_per_iter": round(off, 6),
+        "panic_sec_per_iter": round(panic, 6),
+        "armed_sec_per_iter": round(armed, 6),
+        "ranges_sec_per_iter": round(ranges, 6),
+        "panic_overhead_ratio": round(panic / off - 1.0, 4),
+        "provenance_overhead_ratio": round(provenance_ratio, 4),
+        "ranges_overhead_ratio": round(ranges / off - 1.0, 4),
+    }
+    print(json.dumps(report))
+    if args.assert_bounds:
+        # "OFF ~= 0%" holds by construction (the sanitizer's OFF path is
+        # one enum read — the off mode IS the baseline); the assertable
+        # bound is what PROVENANCE adds on top of the panic gate.
+        assert provenance_ratio < 0.05, \
+            f"provenance adds {provenance_ratio:.1%} over NAN_PANIC >= 5%"
+
+
+if __name__ == "__main__":
+    main()

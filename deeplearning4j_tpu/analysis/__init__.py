@@ -25,6 +25,14 @@ validation is the same shape of tool):
   ``--pipeline workers=8,batch=256,decode_ms=1.3``): ``W108`` host-bound
   decode/H2D img/s below the model's estimated device img/s — "this
   host cannot feed this chip", caught before any worker spawns.
+- :mod:`numerics` — numerics & precision lints under a declared
+  :class:`~deeplearning4j_tpu.nn.precision.PrecisionPolicy` and an
+  optional :class:`DataRangeSpec` (``analyze(..., policy="bf16",
+  data_range="0..255")``, CLI ``--policy bf16 --data-range 0..255``):
+  ``E301`` policy conflict, ``E302`` precision-unsafe accumulation,
+  ``E303`` dynamic-range overflow (the raw-pixel Adam-overflow class,
+  statically), ``W301`` redundant cast churn, ``W302`` loss-scaling
+  misconfiguration, ``W303`` unnormalized input.
 - :mod:`serving` — serving-config lints (``ModelServer.validate()`` /
   :func:`lint_serving`): ``E110`` bucket vs. data-axis divisibility,
   ``E111`` serving HBM budget (params + largest-bucket activations),
@@ -66,6 +74,7 @@ from deeplearning4j_tpu.analysis.diagnostics import (DIAGNOSTIC_CODES,
                                                      ValidationReport,
                                                      normalize_code)
 from deeplearning4j_tpu.analysis.distribution import MeshSpec, PipelineSpec
+from deeplearning4j_tpu.analysis.numerics import DataRangeSpec, lint_numerics
 from deeplearning4j_tpu.analysis.pipeline import (InputPipelineSpec,
                                                   lint_input_pipeline)
 from deeplearning4j_tpu.analysis.samediff import analyze_samediff
@@ -76,6 +85,7 @@ __all__ = [
     "Severity",
     "ValidationReport", "ModelValidationError", "DIAGNOSTIC_CODES",
     "MeshSpec", "PipelineSpec", "InputPipelineSpec", "lint_input_pipeline",
+    "DataRangeSpec", "lint_numerics",
     "normalize_code", "RecompileChurnDetector",
     "get_churn_detector", "array_fingerprint", "lint_serving",
 ]

@@ -109,6 +109,16 @@ def main(argv=None) -> int:
     ap.add_argument("--hbm-gb", type=float, default=None,
                     help="per-device HBM budget in GiB for the E104 "
                          "parameter-footprint check (default 16)")
+    ap.add_argument("--policy", default=None, metavar="POLICY",
+                    help="precision policy for the E3xx/W30x numerics "
+                         "lints: a compute dtype ('bf16', 'fp16', "
+                         "'fp32') or 'compute=fp16,params=fp32,"
+                         "loss_scale=32768' — without it the pass runs "
+                         "under each config's own dataType")
+    ap.add_argument("--data-range", default=None, metavar="LO..HI",
+                    help="declared input value range for the range-"
+                         "dependent numerics lints (E303/W303), e.g. "
+                         "'0..255' or '-1..1,normalized'")
     ap.add_argument("--pipeline", default=None, metavar="SPEC",
                     help="declared input pipeline for the W108 can-this-"
                          "host-feed-this-chip check, e.g. 'workers=8,"
@@ -146,6 +156,35 @@ def main(argv=None) -> int:
             ap.error(f"--severity: {e}")
     if args.hbm_gb is not None and not args.mesh:
         ap.error("--hbm-gb needs a mesh declaration: pass --mesh as well")
+    policy_spec = None
+    if args.policy:
+        from deeplearning4j_tpu.nn.precision import PrecisionPolicy
+        try:
+            if "=" in args.policy:
+                kv = {}
+                for part in args.policy.split(","):
+                    k, eq, v = part.partition("=")
+                    if not eq:
+                        raise ValueError(f"expected key=value, got {part!r}")
+                    k = k.strip()
+                    if k == "loss_scale":
+                        kv[k] = float(v)
+                    elif k in ("compute", "params"):
+                        kv[k] = v.strip()
+                    else:
+                        raise ValueError(f"unknown policy key {k!r}")
+                policy_spec = PrecisionPolicy(**kv)
+            else:
+                policy_spec = PrecisionPolicy.coerce(args.policy)
+        except (ValueError, TypeError) as e:
+            ap.error(f"--policy: {e}")
+    range_spec = None
+    if args.data_range:
+        from deeplearning4j_tpu.analysis.numerics import DataRangeSpec
+        try:
+            range_spec = DataRangeSpec.parse(args.data_range)
+        except ValueError as e:
+            ap.error(f"--data-range: {e}")
     pipeline_spec = None
     if args.pipeline:
         from deeplearning4j_tpu.analysis.pipeline import InputPipelineSpec
@@ -191,6 +230,7 @@ def main(argv=None) -> int:
         report = analyze(obj, batch_size=args.batch_size,
                          data_devices=args.devices, mesh=args.mesh,
                          hbm_gb=args.hbm_gb, input_pipeline=pipeline_spec,
+                         policy=policy_spec, data_range=range_spec,
                          suppress=suppress, severity_overrides=overrides)
         report.subject = name
         total.extend(report.diagnostics)

@@ -23,6 +23,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from deeplearning4j_tpu.analysis import distribution as _dist
 from deeplearning4j_tpu.analysis import layout as _layout
+from deeplearning4j_tpu.analysis import numerics as _numerics
 from deeplearning4j_tpu.analysis.diagnostics import (Diagnostic, Severity,
                                                      ValidationReport)
 from deeplearning4j_tpu.analysis.distribution import MeshSpec
@@ -36,7 +37,7 @@ _REGRESSION_LOSSES = {"mse", "l2", "l1", "mae", "squaredloss", "huber"}
 def analyze(target, batch_size: Optional[int] = None,
             data_devices: Optional[int] = None, mesh=None, sharding=None,
             pipeline=None, hbm_gb: Optional[float] = None,
-            input_pipeline=None,
+            input_pipeline=None, policy=None, data_range=None,
             suppress=None, severity_overrides=None) -> ValidationReport:
     """Analyze a configuration, builder, network, or SameDiff graph.
 
@@ -52,6 +53,13 @@ def analyze(target, batch_size: Optional[int] = None,
     :class:`~deeplearning4j_tpu.analysis.pipeline.InputPipelineSpec`,
     dict, or ``"workers=8,batch=256,decode_ms=1.3"`` string) switches on
     the W108 can-this-host-feed-this-chip check.
+    ``policy`` (a :class:`~deeplearning4j_tpu.nn.precision.
+    PrecisionPolicy` or a dtype string like ``"bf16"``) and
+    ``data_range`` (a :class:`~deeplearning4j_tpu.analysis.numerics.
+    DataRangeSpec`, ``"0..255"``, or ``(lo, hi)``) refine the E3xx/W30x
+    numerics lints — with neither, the pass still runs under the policy
+    implied by the config's ``dataType`` (or the network's attached
+    ``setPrecisionPolicy``).
     ``suppress``/``severity_overrides`` shape the report per code
     (:meth:`ValidationReport.apply_config`).
     """
@@ -68,6 +76,11 @@ def analyze(target, batch_size: Optional[int] = None,
             raise ValueError(
                 "the input-pipeline lint (input_pipeline=) applies to "
                 "layer configurations, not SameDiff graphs")
+        if policy is not None or data_range is not None:
+            raise ValueError(
+                "the numerics lints (policy=/data_range=) apply to "
+                "layer configurations, not SameDiff graphs — recorded "
+                "op graphs carry no per-layer dtype rule to check yet")
         from deeplearning4j_tpu.analysis.samediff import analyze_samediff
         report = analyze_samediff(conf, batch_size=batch_size or 1)
     elif hasattr(conf, "graph_inputs") and hasattr(conf, "nodes"):
@@ -83,6 +96,10 @@ def analyze(target, batch_size: Optional[int] = None,
     if input_pipeline is not None:
         from deeplearning4j_tpu.analysis.pipeline import lint_input_pipeline
         report.extend(lint_input_pipeline(conf, input_pipeline))
+    if hasattr(conf, "layers") or hasattr(conf, "graph_inputs"):
+        report.extend(_numerics.lint_numerics(
+            conf, policy=policy, data_range=data_range,
+            model=target if target is not conf else None))
     if target is not conf:                       # a network: add model-level
         report.extend(_model_checks(target))
     return report.apply_config(suppress, severity_overrides)

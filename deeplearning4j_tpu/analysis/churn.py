@@ -72,7 +72,11 @@ class RecompileChurnDetector:
             "Distinct jit signatures compiled per dispatch site (a value "
             "that keeps growing during steady-state training is churn)",
             labelnames=("site",))
-        self._lock = threading.Lock()
+        # instrumented (PR-8 adoption sweep): record() sits on every fit
+        # dispatch — the lock itself is only taken per NEW signature, but
+        # contention here is exactly the churn the detector exists to see
+        from deeplearning4j_tpu.profiler.locks import InstrumentedLock
+        self._lock = InstrumentedLock("churn_detector")
         self._seen: Dict[Tuple[str, int], Set] = {}
         self._flagged: Set[Tuple[str, int]] = set()
         self._diags: List[Tuple[Optional[int], Diagnostic]] = []

@@ -18,7 +18,11 @@ What it infers, per class, with no imports executed (pure ``ast``):
 - **Thread entry points** — methods passed as ``threading.Thread(
   target=self.m)`` anywhere in the class, plus ``run`` on
   ``threading.Thread`` subclasses; the *thread-reachable* set is their
-  closure over ``self.m()`` calls.
+  closure over ``self.m()`` calls.  MODULE-LEVEL functions spawned via
+  ``Thread(target=fn)`` get the same analysis over the module's
+  globals (rebinding through ``global``, container mutation, subscript
+  stores) against module-level locks; a Thread on a local closure or a
+  bound method resolves to no module function and contributes nothing.
 - **Shared state** — attributes the thread-reachable methods touch
   that are also touched by ``__init__`` or any main-side method
   (the cross-thread-visible object contract). Attributes holding
@@ -130,6 +134,13 @@ def _reads_of(node) -> Set[str]:
     return out
 
 
+def _name_reads_of(node) -> Set[str]:
+    """Every bare NAME loaded anywhere under ``node`` (module-global
+    read-modify-write detection)."""
+    return {n.id for n in ast.walk(node)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)}
+
+
 class _Write:
     __slots__ = ("attr", "line", "rmw", "guarded", "method")
 
@@ -213,6 +224,30 @@ class _ModuleScan:
         #: W210 sites found in module-level functions and methods
         self.time_findings: List[Tuple[int, str]] = []
         self.acquisitions: List[Tuple[str, Tuple[str, ...], int]] = []
+        #: module-level function scans (E201/E202 over shared globals)
+        self.functions: Dict[str, _MethodScan] = {}
+        #: module-level functions spawned via ``Thread(target=fn)``
+        self.fn_entries: Set[str] = set()
+        #: module-level names bound to mutable containers / thread-safe
+        #: primitives / anything at all (the shared-global candidates)
+        self.module_mutables: Set[str] = set()
+        self.module_threadsafe: Set[str] = set()
+        self.module_names: Set[str] = set()
+
+    def thread_reachable_functions(self) -> Set[str]:
+        """fn_entries plus the closure over plain ``fn()`` calls between
+        module-level functions — the module-scope analog of
+        ``_ClassScan.thread_reachable``."""
+        seen: Set[str] = set()
+        frontier = [f for f in self.fn_entries if f in self.functions]
+        while frontier:
+            f = frontier.pop()
+            if f in seen:
+                continue
+            seen.add(f)
+            frontier.extend(c for c, _, _ in self.functions[f].self_calls
+                            if c in self.functions)
+        return seen
 
 
 def _is_thread_ctor(call: ast.Call) -> bool:
@@ -223,6 +258,15 @@ def _thread_target_method(call: ast.Call) -> Optional[str]:
     for kw in call.keywords:
         if kw.arg == "target":
             return _self_attr(kw.value)
+    return None
+
+
+def _thread_target_name(call: ast.Call) -> Optional[str]:
+    """``Thread(target=fn)`` with a bare NAME target (module functions
+    and closures; resolved against module-level defs by the caller)."""
+    for kw in call.keywords:
+        if kw.arg == "target" and isinstance(kw.value, ast.Name):
+            return kw.value.id
     return None
 
 
@@ -238,6 +282,11 @@ class _Scanner:
         self.in_init = in_init
         self.guards: List[str] = []     # lock names currently held
         self.loop_depth = 0
+        self._globals: Set[str] = set()  # `global X` names (module fns)
+        self._locals: Set[str] = set()   # names LOCAL to the module fn
+        # (python scoping: any plain assignment anywhere in the function
+        # makes the name local for the WHOLE function — a local that
+        # shadows a module global must never be reported as one)
 
     # -- lock identification --------------------------------------------
     def _lock_name(self, expr) -> Optional[str]:
@@ -326,13 +375,42 @@ class _Scanner:
                 attr = _self_attr(node.target.value)
             if attr is not None:
                 self._record_write(attr, node.lineno, rmw=True)
+            elif self.cls is None:
+                name = self._module_target_name(node.target)
+                if name is not None:
+                    self._record_write(name, node.lineno, rmw=True)
         else:
             self._expr(node)
+
+    def _module_target_name(self, tgt) -> Optional[str]:
+        """A module-function assignment target that denotes module
+        state: a ``global``-declared NAME (rebinding), or a subscript /
+        known-mutable NAME defined at module level (in-place mutation —
+        no ``global`` statement required to ``X[k] = v``).  A name the
+        function binds locally shadows the module global and is never
+        module state."""
+        if isinstance(tgt, ast.Name):
+            if tgt.id in self._globals:
+                return tgt.id
+            return None
+        if isinstance(tgt, ast.Subscript) and \
+                isinstance(tgt.value, ast.Name) and \
+                tgt.value.id in self.module.module_names and \
+                tgt.value.id not in self._locals:
+            return tgt.value.id
+        return None
 
     def _assign_target(self, tgt, node, read: Set[str]) -> None:
         if isinstance(tgt, (ast.Tuple, ast.List)):
             for el in tgt.elts:
                 self._assign_target(el, node, read)
+            return
+        if self.cls is None:
+            name = self._module_target_name(tgt)
+            if name is not None:
+                self._record_write(name, tgt.lineno,
+                                   rmw=name in _name_reads_of(node.value)
+                                   if node.value is not None else False)
             return
         attr = _self_attr(tgt)
         sub = None
@@ -392,6 +470,11 @@ class _Scanner:
             a = _self_attr(n)
             if a is not None and isinstance(n.ctx, ast.Load):
                 self.scan.reads.append((a, self._guarded()))
+            if self.cls is None and isinstance(n, ast.Name) \
+                    and isinstance(n.ctx, ast.Load) \
+                    and n.id in self.module.module_names \
+                    and n.id not in self._locals:
+                self.scan.reads.append((n.id, self._guarded()))
             if isinstance(n, ast.Call):
                 self._call(n)
             if isinstance(n, (ast.BinOp, ast.Compare)):
@@ -399,17 +482,37 @@ class _Scanner:
 
     def _call(self, call: ast.Call) -> None:
         func = call.func
-        if self.cls is not None and _is_thread_ctor(call):
-            self.cls.creates_threads = True
-            target = _thread_target_method(call)
-            if target is not None:
-                self.cls.entries.add(target)
+        if _is_thread_ctor(call):
+            if self.cls is not None:
+                self.cls.creates_threads = True
+                target = _thread_target_method(call)
+                if target is not None:
+                    self.cls.entries.add(target)
+            # Thread(target=module_fn): a MODULE-LEVEL function becomes
+            # a thread entry — the globals it shares with the rest of
+            # the module are cross-thread state (resolved against the
+            # module's function defs later, so closures stay exempt)
+            name_target = _thread_target_name(call)
+            if name_target is not None:
+                self.module.fn_entries.add(name_target)
         # self.m(...)
         attr = _self_attr(func)
         if attr is not None and self.cls is not None:
             self.scan.self_calls.append((attr, tuple(self.guards),
                                          call.lineno))
             return
+        # fn(...) in a module-level function: closure edge for the
+        # module-scope thread-reachability computation
+        if self.cls is None and isinstance(func, ast.Name):
+            self.scan.self_calls.append((func.id, tuple(self.guards),
+                                         call.lineno))
+        # X.m(...) on a module-level mutable in a module function
+        if self.cls is None and isinstance(func, ast.Attribute) \
+                and isinstance(func.value, ast.Name) \
+                and func.value.id in self.module.module_mutables \
+                and func.value.id not in self._locals \
+                and func.attr in MUTATING_METHODS:
+            self._record_write(func.value.id, call.lineno, rmw=False)
         # self.X.m(...)
         if isinstance(func, ast.Attribute):
             owner = _self_attr(func.value)
@@ -508,11 +611,26 @@ class _Scanner:
 def _scan_module(path: str, rel: str, tree: ast.Module) -> _ModuleScan:
     module = _ModuleScan(rel)
     for node in tree.body:
-        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call) \
-                and _last_name(node.value) in LOCK_CTORS:
-            for tgt in node.targets:
-                if isinstance(tgt, ast.Name):
-                    module.module_locks.add(tgt.id)
+        if isinstance(node, ast.Assign):
+            names = [t.id for t in node.targets if isinstance(t, ast.Name)]
+        elif isinstance(node, ast.AnnAssign) and node.value is not None \
+                and isinstance(node.target, ast.Name):
+            names = [node.target.id]       # `COUNTS: dict = {}` counts too
+        else:
+            continue
+        if not names:
+            continue
+        module.module_names.update(names)
+        value = node.value
+        ctor = _last_name(value) if isinstance(value, ast.Call) else None
+        if ctor in LOCK_CTORS:
+            module.module_locks.update(names)
+        if ctor in THREADSAFE_CTORS:
+            module.module_threadsafe.update(names)
+        if ctor in MUTABLE_CTORS or isinstance(
+                value, (ast.List, ast.ListComp, ast.Dict, ast.DictComp,
+                        ast.Set, ast.SetComp)):
+            module.module_mutables.update(names)
     for node in tree.body:
         if isinstance(node, ast.ClassDef):
             module.classes.append(_scan_class(node, rel, module))
@@ -546,10 +664,56 @@ def _scan_class(node: ast.ClassDef, rel: str, module: _ModuleScan) \
     return cls
 
 
+def _local_bindings(fn, globals_: Set[str]) -> Set[str]:
+    """Names ``fn`` (or a nested scope inside it) binds with a plain
+    assignment / loop target / with-alias — by Python scoping those are
+    LOCAL to their function for its whole body, so a module global of
+    the same name is shadowed, not shared.  Collected over the full
+    subtree: nested closures share the scanner's walk, and a
+    closure-local must not read as module state either."""
+    out: Set[str] = set()
+    for n in ast.walk(fn):
+        targets = []
+        if isinstance(n, ast.Assign):
+            targets = n.targets
+        elif isinstance(n, (ast.AnnAssign, ast.AugAssign, ast.NamedExpr)):
+            targets = [n.target]
+        elif isinstance(n, (ast.For, ast.AsyncFor)):
+            targets = [n.target]
+        elif isinstance(n, ast.withitem) and n.optional_vars is not None:
+            targets = [n.optional_vars]
+        elif isinstance(n, ast.comprehension):
+            targets = [n.target]
+        elif isinstance(n, ast.arg):
+            # parameters (of fn AND nested scopes) bind locally too — a
+            # parameter shadowing a module name is never module state
+            out.add(n.arg)
+        for t in targets:
+            _binding_names(t, out)
+    return out - globals_
+
+
+def _binding_names(tgt, out: Set[str]) -> None:
+    """Names a target BINDS: a bare NAME (or tuple/starred unpacking of
+    them).  A subscript/attribute store mutates the container instead —
+    the container name is NOT bound, so it must not read as local."""
+    if isinstance(tgt, ast.Name):
+        out.add(tgt.id)
+    elif isinstance(tgt, (ast.Tuple, ast.List)):
+        for el in tgt.elts:
+            _binding_names(el, out)
+    elif isinstance(tgt, ast.Starred):
+        _binding_names(tgt.value, out)
+
+
 def _scan_function(node, module: _ModuleScan) -> None:
     scan = _MethodScan(node.name)
+    module.functions[node.name] = scan
     sc = _Scanner(None, scan, module, in_init=False)
     sc._wall_names = _wall_clock_names(node)
+    sc._globals = {name for n in ast.walk(node)
+                   if isinstance(n, ast.Global) for name in n.names}
+    sc._locals = _local_bindings(node, sc._globals)
     sc.walk(node.body)
 
 
@@ -714,6 +878,63 @@ def _class_findings(cls: _ClassScan) -> List[Diagnostic]:
                     "under the lock)",
                     fix_hint="take the lock, re-check for None inside "
                              "it, then assign"))
+    return out
+
+
+def _module_findings(mod: _ModuleScan) -> List[Diagnostic]:
+    """E201/E202 over module-level functions sharing globals via
+    ``threading.Thread(target=fn)`` — the module-scope mirror of
+    ``_class_findings``.  Fires only when some MODULE-LEVEL function is
+    actually spawned as a thread (a Thread on a local closure or a
+    bound method resolves to no module function and contributes
+    nothing)."""
+    out: List[Diagnostic] = []
+    reachable = mod.thread_reachable_functions()
+    if not reachable:
+        return out
+    exempt = mod.module_locks | mod.module_threadsafe
+    acc_thread: Set[str] = set()
+    acc_main: Set[str] = set()
+    for name, scan in mod.functions.items():
+        touched = {a for a, _ in scan.reads} | {w.attr for w in scan.writes}
+        if name in reachable:
+            acc_thread |= touched
+        else:
+            acc_main |= touched
+    # module-level bindings are initialized (and importable) on the main
+    # side by construction — the __init__ analog
+    shared = (acc_thread & (acc_main | mod.module_names)) - exempt
+    lock_hint = next(iter(sorted(mod.module_locks)), None)
+    hint = (f"guard the access with `with {lock_hint}:`" if lock_hint
+            else "add a module-level threading.Lock (or "
+                 "profiler.locks.InstrumentedLock) and guard every access")
+    for name, scan in mod.functions.items():
+        side = ("a thread-entry path" if name in reachable
+                else "the caller side while worker threads run")
+        for w in scan.writes:
+            if w.guarded or w.attr not in shared:
+                continue
+            if w.rmw:
+                out.append(Diagnostic(
+                    "DL4J-E202", Severity.ERROR,
+                    _loc(mod.path, w.line, name),
+                    f"read-modify-write on module global `{w.attr}` "
+                    f"outside any lock on {side} — "
+                    f"`threading.Thread(target={sorted(reachable)[0]})` "
+                    f"makes this module state cross-thread, and a "
+                    f"concurrent writer loses one of the updates",
+                    fix_hint=hint))
+            else:
+                out.append(Diagnostic(
+                    "DL4J-E201", Severity.ERROR,
+                    _loc(mod.path, w.line, name),
+                    f"unguarded mutation of module global `{w.attr}` on "
+                    f"{side} — shared with the "
+                    f"Thread(target=...) entry function(s) "
+                    f"{sorted(reachable & mod.fn_entries)}, so other "
+                    f"threads can observe (or clobber) intermediate "
+                    f"state",
+                    fix_hint=hint))
     return out
 
 
@@ -913,6 +1134,7 @@ def analyze_concurrency(target: str, suppress: Iterable[str] = (),
     for mod in modules:
         for cls in mod.classes:
             diags.extend(_class_findings(cls))
+        diags.extend(_module_findings(mod))
         seen_lines: Set[Tuple[str, int]] = set()
         for line, label in mod.time_findings:
             if (mod.path, line) in seen_lines:

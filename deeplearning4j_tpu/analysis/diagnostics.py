@@ -105,14 +105,14 @@ DIAGNOSTIC_CODES = {
                  "compiled program (warmup time, executable-cache HBM)",
     # E2xx/W21x concurrency lints (analysis/concurrency.py): AST-level
     # thread-safety analysis of the framework's own (or user) source.
-    "DL4J-E201": "unguarded cross-thread mutation: an attribute shared "
-                 "with a worker thread is assigned/mutated outside any "
-                 "lock, so other threads can observe or clobber "
-                 "intermediate state",
+    "DL4J-E201": "unguarded cross-thread mutation: an attribute (or a "
+                 "module global shared via threading.Thread(target=fn)) "
+                 "is assigned/mutated outside any lock, so other threads "
+                 "can observe or clobber intermediate state",
     "DL4J-E202": "unguarded read-modify-write: `self.x += 1` (or an "
-                 "equivalent read-then-assign) on shared state outside "
-                 "any lock — two racing writers lose one update (the "
-                 "lost-increment class)",
+                 "equivalent read-then-assign, incl. on module globals) "
+                 "on shared state outside any lock — two racing writers "
+                 "lose one update (the lost-increment class)",
     "DL4J-E203": "lock-order cycle: the static lock-acquisition graph "
                  "contains a cycle, so two threads taking the locks in "
                  "opposite orders deadlock",
@@ -132,6 +132,33 @@ DIAGNOSTIC_CODES = {
                  "parse this file, so none of its classes were checked — "
                  "a distinct code so suppressing a real finding family "
                  "never hides a syntax error",
+    # E3xx/W30x numerics & precision lints (analysis/numerics.py):
+    # dtype-flow + dynamic-range analysis under a PrecisionPolicy and an
+    # optional DataRangeSpec input declaration, before any compile.
+    "DL4J-E301": "precision-policy conflict: a low-precision stateful "
+                 "updater without fp32 master params (moments overflow "
+                 "or round to nothing), or a per-layer dtype override "
+                 "contradicting the declared policy",
+    "DL4J-E302": "precision-unsafe accumulation: softmax / large-axis "
+                 "mean-variance reductions / a loss head accumulating "
+                 "in the low-precision compute dtype with no fp32 "
+                 "island",
+    "DL4J-E303": "dynamic-range overflow: float16 compute without loss "
+                 "scaling, or a declared input range whose gradient / "
+                 "second-moment magnitude estimate exceeds what the "
+                 "dtype x updater combination tolerates (the raw-pixel "
+                 "Adam-overflow class)",
+    "DL4J-W301": "redundant cast churn: a non-island fp32 override "
+                 "sandwiched between low-precision layers bounces "
+                 "activations dtype->fp32->dtype at both boundaries "
+                 "every step",
+    "DL4J-W302": "loss-scaling misconfiguration: a scale where the "
+                 "compute dtype does not need one (bf16/fp32), a scale "
+                 "< 1, or one large enough to overflow the scaled loss "
+                 "itself",
+    "DL4J-W303": "unnormalized input: a declared [0, 255]-style range "
+                 "with no normalizer attached and no normalization "
+                 "layer first in the net",
     # E15x/W15x SameDiff graph lints (analysis/samediff.py).
     "DL4J-E151": "undefined graph input: an op node consumes a name no "
                  "variable, constant, placeholder, or node output defines",

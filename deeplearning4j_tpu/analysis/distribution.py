@@ -315,11 +315,14 @@ def _stage_assignment(mesh: MeshSpec, n_entries: int) -> Optional[List[int]]:
 def _approx_flops(layer, it, out_it) -> int:
     """Per-example forward FLOP estimate from declared shapes: 2*W for
     every matmul-bearing weight, times spatial positions for conv output
-    maps, times timesteps for recurrent input."""
+    maps, times timesteps for recurrent input.  Attention layers add
+    their score/context matmuls (2 x T^2 x E MACs each) — without that
+    term a transformer stage's FLOPs read as just its projections and
+    the W105 stage-balance lint undercounts it (the PR-4 carried
+    follow-up; same for conv-LSTM, whose gate convs now come from
+    ``ConvLSTM2D.param_shapes``)."""
     shapes = getattr(layer, "param_shapes", lambda: {})()
     w = sum(_prod(s) for s in shapes.values() if len(s) >= 2)
-    if not w:
-        return 0
     mult = 1
     if out_it is not None and getattr(out_it, "kind", None) == "cnn":
         mult = max(int(out_it.dims.get("height", 1)), 1) * \
@@ -327,7 +330,33 @@ def _approx_flops(layer, it, out_it) -> int:
     elif it is not None and getattr(it, "kind", None) == "rnn":
         t = int(it.dims.get("timesteps", -1) or -1)
         mult = t if t > 0 else 1
-    return 2 * w * mult
+    flops = 2 * w * mult
+    flops += _attention_flops(layer, it)
+    return flops
+
+
+def _attention_flops(layer, it) -> int:
+    """Score + context matmul FLOPs for attention layers: QK^T is
+    T_q x T_k x E MACs, attn x V the same again — 2 FLOPs per MAC.
+    Needs a statically-declared timestep count; degrades to 0 (the old
+    undercount) when T is unknown."""
+    n_heads = getattr(layer, "n_heads", None)
+    if not n_heads:
+        return 0
+    if it is None or getattr(it, "kind", None) != "rnn":
+        return 0
+    t_k = int(it.dims.get("timesteps", -1) or -1)
+    if t_k <= 0:
+        return 0
+    head_size = getattr(layer, "head_size", None)
+    e = int(n_heads) * int(head_size) if head_size \
+        else int(getattr(layer, "nIn", 0) or 0)
+    if not e:
+        return 0
+    # LearnedSelfAttention queries from n_queries learned vectors;
+    # RecurrentAttention queries once per output step (T_q = T_k)
+    t_q = int(getattr(layer, "n_queries", 0) or 0) or t_k
+    return 2 * 2 * t_q * t_k * e
 
 
 def _propagate_types(conf):

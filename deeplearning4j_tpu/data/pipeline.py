@@ -70,7 +70,6 @@ from __future__ import annotations
 import atexit
 import os
 import queue
-import threading
 import time
 import uuid
 from multiprocessing import get_context
@@ -80,6 +79,7 @@ from typing import List, Optional, Sequence
 import numpy as np
 
 from deeplearning4j_tpu import profiler as _prof
+from deeplearning4j_tpu.profiler.locks import InstrumentedRLock
 from deeplearning4j_tpu.data.dataset import DataSet, DataSetIterator
 from deeplearning4j_tpu.data.image import (ImageTransform, NativeImageLoader,
                                            ParentPathLabelGenerator,
@@ -442,7 +442,9 @@ class StagedImageIterator(DataSetIterator):
         # reset()/close() may race (a fit teardown against a lifecycle
         # hook): serialize them, and every _pending/_started update takes
         # the same (re-entrant) lock. next() stays consumer-thread-only.
-        self._lifecycle = threading.RLock()
+        # Instrumented (PR-8 adoption sweep): held per megabatch pull, so
+        # its hold histogram is the staged pipeline's consumer-side bill.
+        self._lifecycle = InstrumentedRLock("staged_pipeline_lifecycle")
         self._loader = NativeImageLoader(height, width, channels)
         self._pending = 0
         self._failed = None     # latched DataPipelineError (decode failure)

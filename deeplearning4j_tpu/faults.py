@@ -127,7 +127,8 @@ class FaultPlan:
                  slow_replica_at: Iterable[int] = (),
                  slow_seconds: float = 0.1,
                  serve_fail_at: Iterable[int] = (),
-                 serve_device_loss_at_batch: Optional[int] = None):
+                 serve_device_loss_at_batch: Optional[int] = None,
+                 nan_layer_params_at: Optional[dict] = None):
         self.seed = seed
         self.nan_grads_at = _as_step_set(nan_grads_at)
         self.data_error_at = _as_step_set(data_error_at)
@@ -143,6 +144,13 @@ class FaultPlan:
         self.slow_seconds = float(slow_seconds)
         self.serve_fail_at = _as_step_set(serve_fail_at)
         self.serve_device_loss_at_batch = serve_device_loss_at_batch
+        #: {step: layer} — poison ONE layer's params with NaN just before
+        #: update step ``step`` dispatches (layer = index for sequential
+        #: nets, name for graphs).  The provenance-sanitizer pin: a NaN
+        #: planted at layer k must be attributed to layer k, not to
+        #: whatever the loss scalar looks like K layers later.
+        self.nan_layer_params_at = {int(k): v for k, v in
+                                    (nan_layer_params_at or {}).items()}
         # consumed-state: each fault fires once
         self._nan_pending = set(self.nan_grads_at)
         self._data_pending = set(self.data_error_at)
@@ -152,6 +160,7 @@ class FaultPlan:
         self._slow_pending = set(self.slow_replica_at)
         self._serve_fail_pending = set(self.serve_fail_at)
         self._serve_loss_active = False
+        self._layer_poison_pending = set(self.nan_layer_params_at)
         self._hang_release = threading.Event()
         self._pull_index = 0
 
@@ -257,6 +266,39 @@ class FaultPlan:
         if k in self._nan_pending:
             self._nan_pending.discard(k)
             return True
+        return False
+
+    # ----------------------------------------------------- parameter seams
+    def poison_layer_params(self, model, step: int) -> bool:
+        """Fires once per planned layer-params poison: writes NaN into
+        ONE element of the planned layer's first parameter tensor
+        (through the device, like a real silent corruption / overflowed
+        update would land).  Called by the resilience session's
+        before-step/before-dispatch hook with the FIRST step of the
+        upcoming dispatch — a poison planned for a mid-megastep step
+        therefore lands at the first dispatch boundary AT OR AFTER its
+        planned step (under ``steps_per_dispatch=1`` that is exactly
+        the planned step)."""
+        due = sorted(s for s in self._layer_poison_pending if s <= step)
+        if not due:
+            return False
+        fire_at = due[0]
+        layer = self.nan_layer_params_at[fire_at]
+        self._layer_poison_pending.discard(fire_at)
+        import jax.numpy as jnp
+        params = model._params
+        entry = params[layer]                # int index (list) or name (dict)
+        for pname in sorted(entry):
+            arr = entry[pname]
+            if hasattr(arr, "dtype") and jnp.issubdtype(arr.dtype,
+                                                        jnp.floating):
+                idx = (0,) * arr.ndim
+                entry[pname] = arr.at[idx].set(jnp.nan)
+                # an out-of-band mutation the compiled-step replay cannot
+                # reproduce: the provenance sanitizer must re-snapshot
+                from deeplearning4j_tpu.profiler import sanitizer
+                sanitizer.invalidate(model)
+                return True
         return False
 
     # ------------------------------------------------------ checkpoint seams

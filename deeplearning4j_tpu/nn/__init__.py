@@ -1,4 +1,14 @@
-"""NN layer/config/network API (ref: deeplearning4j-nn — SURVEY.md §2.2)."""
+"""NN layer/config/network API (ref: deeplearning4j-nn — SURVEY.md §2.2).
+
+The config/precision half of this package is jax-free (the static
+analyzer imports it in environments with no accelerator stack — pinned
+by a jax-blocked subprocess test), so the jax-backed halves (layers,
+augment, the network classes) load lazily via PEP 562: importing
+``deeplearning4j_tpu.nn.precision`` or ``.config`` pulls in no jax,
+while ``from deeplearning4j_tpu.nn import MultiLayerNetwork`` (and
+``deeplearning4j_tpu.nn.layers`` attribute access) behave exactly as
+before.
+"""
 
 from deeplearning4j_tpu.nn.config import (  # noqa: F401
     InputType,
@@ -6,6 +16,29 @@ from deeplearning4j_tpu.nn.config import (  # noqa: F401
     MultiLayerConfiguration,
     NeuralNetConfiguration,
 )
-from deeplearning4j_tpu.nn import layers  # noqa: F401
-from deeplearning4j_tpu.nn.augment import DeviceAugmentation  # noqa: F401
-from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork  # noqa: F401
+from deeplearning4j_tpu.nn.precision import PrecisionPolicy  # noqa: F401
+
+#: name -> (module, attr-or-None): attr None re-exports the module itself
+_LAZY = {
+    "layers": ("deeplearning4j_tpu.nn.layers", None),
+    "augment": ("deeplearning4j_tpu.nn.augment", None),
+    "precision": ("deeplearning4j_tpu.nn.precision", None),
+    "multilayer": ("deeplearning4j_tpu.nn.multilayer", None),
+    "graph": ("deeplearning4j_tpu.nn.graph", None),
+    "preprocessors": ("deeplearning4j_tpu.nn.preprocessors", None),
+    "DeviceAugmentation": ("deeplearning4j_tpu.nn.augment",
+                           "DeviceAugmentation"),
+    "MultiLayerNetwork": ("deeplearning4j_tpu.nn.multilayer",
+                          "MultiLayerNetwork"),
+}
+
+
+def __getattr__(name):
+    entry = _LAZY.get(name)
+    if entry is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+    module = importlib.import_module(entry[0])
+    value = module if entry[1] is None else getattr(module, entry[1])
+    globals()[name] = value          # cache: __getattr__ runs once per name
+    return value

@@ -32,8 +32,8 @@ from deeplearning4j_tpu.nn.config import InputType, NeuralNetConfiguration
 from deeplearning4j_tpu.nn.multilayer import (_maybe_attach_env_profiler,
                                               _predict_batches,
                                               _process_and_apply_grads)
+from deeplearning4j_tpu.profiler import sanitizer as _sanitizer
 from deeplearning4j_tpu.train import stepping as _stepping
-from deeplearning4j_tpu.utils import environment as _environment
 
 _MASK_AWARE = (L.LSTM, L.SimpleRnn, L.Bidirectional, L.LastTimeStep,
                L.GlobalPoolingLayer, L.SelfAttentionLayer,
@@ -406,6 +406,7 @@ class ComputationGraph:
         self._megastep_cache = {}
         self._fwd_cache = None
         self._augment = None    # DeviceAugmentation (see setDeviceAugmentation)
+        self._precision = None  # PrecisionPolicy (see setPrecisionPolicy)
         self._initialized = False
 
     def validate(self, batch_size: int = None, data_devices: int = None,
@@ -433,12 +434,21 @@ class ComputationGraph:
         self._megastep_cache = {}
         self._fwd_cache = None
         self._initialized = True
+        _sanitizer.invalidate(self)   # re-init = out-of-band state reset
         return self
 
     # --------------------------------------------------------------- forward
+    def _compute_dtype(self):
+        """Effective compute dtype: attached PrecisionPolicy wins, else
+        the config dataType (see MultiLayerNetwork._compute_dtype)."""
+        pol = self._precision
+        if pol is not None:
+            return pol.compute_jnp()
+        return L.compute_dtype_of(self.conf.base.dtype)
+
     def _forward(self, params, states, inputs: Dict[str, Any], train, key,
                  fmask=None):
-        cdt = L.compute_dtype_of(self.conf.base.dtype)
+        cdt = self._compute_dtype()
         env = {k: (v.astype(jnp.float32)
                    if cdt is None and getattr(v, "dtype", None) == jnp.uint8
                    else v)
@@ -568,6 +578,10 @@ class ComputationGraph:
         seed = base.seed
 
         augment = self._augment
+        # static loss scaling under the precision seam — see
+        # MultiLayerNetwork._make_train_step
+        pol = self._precision
+        loss_scale = pol.loss_scale if pol is not None else None
 
         def step(params, states, opt_state, t, ins, labels, lmasks):
             # per-step RNG from the donated device counter (see
@@ -582,9 +596,17 @@ class ComputationGraph:
                        for name, v in ins.items()}
 
             def loss_fn(p):
-                return self._loss_and_reg(p, states, ins, labels, True, key,
-                                          None, lmasks if with_lmasks else None)
+                loss, ns = self._loss_and_reg(
+                    p, states, ins, labels, True, key,
+                    None, lmasks if with_lmasks else None)
+                if loss_scale:
+                    loss = loss * loss_scale
+                return loss, ns
             (loss, new_states), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            if loss_scale:
+                inv = 1.0 / loss_scale
+                loss = loss * inv           # listeners/score see true loss
+                grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
             new_params, new_opt = _process_and_apply_grads(
                 base, updater, params, grads, opt_state, t.astype(jnp.float32))
             return new_params, new_states, new_opt, t + 1, loss
@@ -626,10 +648,35 @@ class ComputationGraph:
             self._megastep_cache.clear()
         return self
 
+    def setPrecisionPolicy(self, policy) -> "ComputationGraph":
+        """Attach (or detach with ``None``) a
+        :class:`~deeplearning4j_tpu.nn.precision.PrecisionPolicy` (or a
+        dtype string like ``"bf16"``) — semantics identical to
+        ``MultiLayerNetwork.setPrecisionPolicy`` (fp32 master params,
+        loss scaling around the backward pass, signature-keyed cache
+        bust on change, zero steady-state recompiles on re-attach)."""
+        from deeplearning4j_tpu.nn.precision import (PrecisionPolicy,
+                                                     runtime_check)
+        policy = PrecisionPolicy.coerce(policy)
+        if policy is not None:
+            runtime_check(policy)
+        cur = self._precision
+        same = (policy.signature() if policy is not None else None) == \
+            (cur.signature() if cur is not None else None)
+        self._precision = policy
+        if not same:
+            self._train_step_cache.clear()
+            self._megastep_cache.clear()
+            self._fwd_cache = None
+        return self
+
     def fit(self, data, labels=None, epochs: int = 1,
             steps_per_dispatch: int = 1, prefetch: int = 2,
-            checkpoint=None, nan_policy=None, faults=None, augment=None):
+            checkpoint=None, nan_policy=None, faults=None, augment=None,
+            precision=None):
         """Accepts a DataSetIterator, DataSet, MultiDataSet, or arrays.
+        ``precision=`` attaches a mixed-precision policy (see
+        :meth:`setPrecisionPolicy`).
         ``steps_per_dispatch=K`` runs K update steps per compiled dispatch
         with double-buffered device prefetch (``prefetch=0`` = synchronous
         consumption on the calling thread) — see MultiLayerNetwork.fit.
@@ -644,6 +691,8 @@ class ComputationGraph:
         self._ensure_opt_state()
         if augment is not None:
             self.setDeviceAugmentation(augment)
+        if precision is not None:
+            self.setPrecisionPolicy(precision)
         _maybe_attach_env_profiler(self)
         session = None
         if checkpoint is not None or nan_policy is not None \
@@ -721,6 +770,9 @@ class ComputationGraph:
         res = getattr(self, "_resilience", None)
         if res is not None:
             res.before_step()
+        # provenance sanitizer — see MultiLayerNetwork._fit_one
+        tok = _sanitizer.snapshot(self, "graph", ins=ins, labels=labels,
+                                  lmasks=lmasks)
         for lst in self._listeners:
             if hasattr(lst, "onIterationStart"):
                 # 1-based, matching iterationDone: hook pair refers to the
@@ -746,7 +798,8 @@ class ComputationGraph:
         # on-device; score() converts lazily (per-step host sync is ~20x the
         # step cost through a high-latency device link)
         self._score = loss
-        _environment.panic_check(loss, f"loss at iteration {self._iteration}")
+        _sanitizer.check(self, tok, loss,
+                         context=f"loss at iteration {self._iteration}")
         self._last_batch_size = int(next(iter(ins.values())).shape[0])
         self._iteration += 1
         for lst in self._listeners:
@@ -785,6 +838,8 @@ class ComputationGraph:
         res = getattr(self, "_resilience", None)
         if res is not None:
             res.before_dispatch()
+        tok = _sanitizer.snapshot(self, "graph_mega", ins=ins, labels=labels,
+                                  lmasks=lmasks)   # see _fit_one
         dummy = [jnp.zeros((k, 1))] * len(labels)
         if _prof.instrumentation_active():
             _stepping.STEPS_PER_DISPATCH.set(k)
@@ -801,7 +856,8 @@ class ComputationGraph:
             self._params, self._states, self._opt_state, self._t_dev, \
                 losses = out
         _stepping.record_megastep(self, losses, k,
-                                  int(next(iter(ins.values())).shape[1]))
+                                  int(next(iter(ins.values())).shape[1]),
+                                  san_token=tok)
 
     # ------------------------------------------------------------- utilities
     def score(self, ds=None) -> float:

@@ -85,7 +85,8 @@ class Layer:
     def __init__(self, nOut: int = None, nIn: int = None, activation: str = None,
                  weightInit: str = None, biasInit: float = 0.0,
                  dropOut: float = 0.0, l1: float = None, l2: float = None,
-                 name: str = None, tiedWith: str = None, **extra):
+                 name: str = None, tiedWith: str = None,
+                 dataType: str = None, **extra):
         _reject_unknown_kwargs(type(self), extra)
         self.nOut = nOut
         self.nIn = nIn
@@ -99,6 +100,13 @@ class Layer:
         # weight-tie group label: layers sharing one group must land on
         # the same pipeline stage (analysis/distribution.py E103)
         self.tied_with = tiedWith
+        # per-layer dtype override under a PrecisionPolicy: "float32"
+        # declares an explicit fp32 island, anything contradicting the
+        # network policy is the analysis pass's E301/W301 material
+        if dataType is not None:
+            from deeplearning4j_tpu.nn.precision import normalize_dtype
+            dataType = normalize_dtype(dataType)
+        self.dtype_override = dataType
 
     # -- config plumbing --
     def set_defaults(self, base):
@@ -850,6 +858,20 @@ class ConvLSTM2D(Layer):
     def infer_nin(self, it: InputType):
         self.nIn = it.channels
 
+    def mxu_lane_dims(self):
+        return [4 * self.nOut] if self.nOut else []
+
+    def param_shapes(self):
+        """Gate convs, matching ``initialize`` exactly — the base class's
+        dense [nIn, nOut] guess undercounted both the HBM footprint and
+        the W105 FLOP estimate for conv-LSTM stages."""
+        if not self.nIn or not self.nOut:
+            return {}
+        H = self.nOut
+        return {"W": (4 * H, self.nIn) + self.kernel,
+                "RW": (4 * H, H) + self.kernel,
+                "b": (4 * H,)}
+
     def initialize(self, key):
         k1, k2 = jax.random.split(key)
         H = self.nOut
@@ -1545,8 +1567,22 @@ def compute_dtype_of(conf_dtype) -> Optional[Any]:
 
 
 def policy_cast(layer, params, x, compute_dt):
-    """Cast (params, input) for one layer under the dtype policy."""
+    """Cast (params, input) for one layer under the dtype policy.
+
+    A per-layer ``dataType=`` override refines the policy: "float32"
+    declares an explicit fp32 island (params and activations stay/return
+    to fp32 through this layer); an override matching the compute dtype
+    is a no-op.  Overrides that contradict the policy are the analysis
+    pass's E301 — the runtime honors fp32 islands and policy-matching
+    overrides only."""
     if compute_dt is None:
+        return params, x
+    override = getattr(layer, "dtype_override", None)
+    if override == "float32" and not isinstance(layer, BaseOutputLayer):
+        if jnp.issubdtype(x.dtype, jnp.floating) and x.dtype != jnp.float32:
+            x = x.astype(jnp.float32)
+        elif x.dtype == jnp.uint8:
+            x = x.astype(jnp.float32)
         return params, x
     if isinstance(layer, BaseOutputLayer):
         if jnp.issubdtype(x.dtype, jnp.floating) and x.dtype != jnp.float32:

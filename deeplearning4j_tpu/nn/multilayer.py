@@ -22,6 +22,7 @@ import numpy as np
 
 from deeplearning4j_tpu import profiler as _prof
 from deeplearning4j_tpu.analysis import churn as _churn
+from deeplearning4j_tpu.profiler import sanitizer as _sanitizer
 from deeplearning4j_tpu.data.dataset import (AsyncDataSetIterator, DataSet,
                                              DataSetIterator,
                                              IterableDataSetIterator)
@@ -166,6 +167,7 @@ class MultiLayerNetwork:
         self._tbptt_step_cache = {}
         self._fwd_cache = None
         self._augment = None    # DeviceAugmentation (see setDeviceAugmentation)
+        self._precision = None  # PrecisionPolicy (see setPrecisionPolicy)
         self._score = float("nan")
         self._initialized = False
 
@@ -205,11 +207,22 @@ class MultiLayerNetwork:
         self._tbptt_step_cache = {}
         self._fwd_cache = None
         self._initialized = True
+        _sanitizer.invalidate(self)   # re-init = out-of-band state reset
         return self
 
     # --------------------------------------------------------------- forward
+    def _compute_dtype(self):
+        """Effective compute dtype under the precision seam: an attached
+        :class:`~deeplearning4j_tpu.nn.precision.PrecisionPolicy` wins,
+        else the configuration's ``dataType`` drives the legacy policy
+        (bf16 -> mixed, anything else -> no casts)."""
+        pol = self._precision
+        if pol is not None:
+            return pol.compute_jnp()
+        return L.compute_dtype_of(self.conf.base.dtype)
+
     def _forward(self, params, states, x, train: bool, key, fmask=None):
-        cdt = L.compute_dtype_of(self.conf.base.dtype)
+        cdt = self._compute_dtype()
         if cdt is None and getattr(x, "dtype", None) == jnp.uint8:
             x = x.astype(jnp.float32)   # on-device image-byte cast (fp32 nets)
         new_states = []
@@ -296,6 +309,12 @@ class MultiLayerNetwork:
         seed = base.seed
 
         augment = self._augment
+        # static loss scaling (nn.precision): the loss is scaled INSIDE
+        # value_and_grad and the grads divided straight back out, so the
+        # tiny fp16 gradient tail survives the backward pass while the
+        # updater still sees true-magnitude fp32 gradients
+        pol = self._precision
+        loss_scale = pol.loss_scale if pol is not None else None
 
         def step(params, states, opt_state, t, x, y, fmask, lmask):
             # per-step RNG derived ON DEVICE from the (donated) iteration
@@ -312,10 +331,17 @@ class MultiLayerNetwork:
             tf = t.astype(jnp.float32)
 
             def loss_fn(p):
-                return self._loss_and_reg(p, states, x, y, True, key,
-                                          fmask if with_fmask else None,
-                                          lmask if with_lmask else None)
+                loss, ns = self._loss_and_reg(p, states, x, y, True, key,
+                                              fmask if with_fmask else None,
+                                              lmask if with_lmask else None)
+                if loss_scale:
+                    loss = loss * loss_scale
+                return loss, ns
             (loss, new_states), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            if loss_scale:
+                inv = 1.0 / loss_scale
+                loss = loss * inv           # listeners/score see true loss
+                grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
             new_params, new_opt = _process_and_apply_grads(
                 base, updater, params, grads, opt_state, tf)
             if frozen:
@@ -365,11 +391,43 @@ class MultiLayerNetwork:
             self._megastep_cache.clear()
         return self
 
+    def setPrecisionPolicy(self, policy) -> "MultiLayerNetwork":
+        """Attach (or detach with ``None``) a
+        :class:`~deeplearning4j_tpu.nn.precision.PrecisionPolicy` (or a
+        dtype string like ``"bf16"``): non-island layers compute in the
+        policy's dtype inside the compiled step while master params and
+        updater state stay fp32, and ``loss_scale`` (fp16) is applied/
+        removed around the backward pass.  A policy with a different
+        :meth:`signature` invalidates the compiled step caches (one
+        recompile); re-attaching an equal policy keeps them — steady
+        state stays at zero recompiles.  Low-precision master params
+        are rejected (the E301 hazard class)."""
+        from deeplearning4j_tpu.nn.precision import (PrecisionPolicy,
+                                                     runtime_check)
+        policy = PrecisionPolicy.coerce(policy)
+        if policy is not None:
+            runtime_check(policy)
+        cur = self._precision
+        same = (policy.signature() if policy is not None else None) == \
+            (cur.signature() if cur is not None else None)
+        self._precision = policy
+        if not same:
+            self._train_step_cache.clear()
+            self._megastep_cache.clear()
+            self._tbptt_step_cache = {}
+            self._fwd_cache = None
+        return self
+
     def fit(self, data, labels=None, epochs: int = 1,
             steps_per_dispatch: int = 1, prefetch: int = 2,
-            checkpoint=None, nan_policy=None, faults=None, augment=None):
+            checkpoint=None, nan_policy=None, faults=None, augment=None,
+            precision=None):
         """ref: MultiLayerNetwork.fit(DataSetIterator) — accepts an
         iterator, a DataSet, or (features, labels) arrays.
+
+        ``precision=PrecisionPolicy("bfloat16")`` (or just ``"bf16"``)
+        attaches the mixed-precision policy for this and later fits —
+        see :meth:`setPrecisionPolicy`.
 
         ``steps_per_dispatch=K`` batches K consecutive same-signature
         minibatches into ONE compiled ``lax.scan`` program performing K
@@ -418,8 +476,17 @@ class MultiLayerNetwork:
         self._ensure_opt_state()
         if augment is not None:
             self.setDeviceAugmentation(augment)
+        if precision is not None:
+            self.setPrecisionPolicy(precision)
         _maybe_attach_env_profiler(self)
         tbptt_len = self._tbptt_length()
+        if tbptt_len is not None and self._precision is not None:
+            import warnings
+            warnings.warn(
+                "the TBPTT fit path ignores the attached PrecisionPolicy "
+                "— truncated-BPTT segments train in plain fp32 with no "
+                "loss scaling (mixed precision x TBPTT is a ROADMAP "
+                "carried follow-up)", stacklevel=2)
         session = None
         if checkpoint is not None or nan_policy is not None \
                 or faults is not None:
@@ -500,6 +567,13 @@ class MultiLayerNetwork:
         res = getattr(self, "_resilience", None)
         if res is not None:
             res.before_step()
+        # provenance sanitizer (profiler.sanitizer): one enum read when
+        # OFF; under NAN_PANIC/INF_PANIC snapshots pre-step state so a
+        # nonfinite loss can be attributed to its first (layer, op, step).
+        # Placed AFTER the resilience hook so injected layer poisons are
+        # part of the snapshot.
+        tok = _sanitizer.snapshot(self, "single", x=x, y=y, fmask=fmask,
+                                  lmask=lmask)
         for lst in self._listeners:
             if hasattr(lst, "onIterationStart"):
                 # 1-based, matching iterationDone: hook pair refers to the
@@ -532,7 +606,8 @@ class MultiLayerNetwork:
         # step through the (high-latency) host<->device link every iteration;
         # score() converts lazily when someone actually asks
         self._score = loss
-        _environment.panic_check(loss, f"loss at iteration {self._iteration}")
+        _sanitizer.check(self, tok, loss,
+                         context=f"loss at iteration {self._iteration}")
         self._last_batch_size = int(ds.features.shape[0])
         self._iteration += 1
         for lst in self._listeners:
@@ -566,6 +641,8 @@ class MultiLayerNetwork:
         res = getattr(self, "_resilience", None)
         if res is not None:
             res.before_dispatch()
+        tok = _sanitizer.snapshot(self, "mega", x=x, y=y, fmask=fmask,
+                                  lmask=lmask)   # see _fit_one
         dummy = jnp.zeros((k, 1))
         if _prof.instrumentation_active():
             _stepping.STEPS_PER_DISPATCH.set(k)
@@ -582,7 +659,8 @@ class MultiLayerNetwork:
                 return      # abandoned dispatch: see dispatch_commit
             self._params, self._states, self._opt_state, self._t_dev, \
                 losses = out
-        _stepping.record_megastep(self, losses, k, int(x.shape[1]))
+        _stepping.record_megastep(self, losses, k, int(x.shape[1]),
+                                  san_token=tok)
 
     # ----------------------------------------------------------------- score
     def score(self, ds: DataSet = None) -> float:

@@ -1,0 +1,176 @@
+"""PrecisionPolicy — the declarative mixed-precision seam.
+
+The conv MFU gap (ROADMAP: ResNet-50 at 0.26, tiny-YOLO at 0.10 while
+GEMM hits 87% of peak) runs through bf16 compute on the MXU.  The seed
+already had the mechanism — ``NeuralNetConfiguration.dataType
+("bfloat16")`` casts non-island layers to bf16 inside the compiled step
+(``nn.layers.policy_cast``) while master params, BatchNorm statistics,
+and the loss head stay fp32 — but the policy itself was a bare string
+with no seam to hang loss scaling, per-layer overrides, or static
+analysis off.  This module is that seam:
+
+- :class:`PrecisionPolicy` declares ``(compute, params, loss_scale)``
+  once, hashable via :meth:`signature` so the networks' step caches
+  key on it (attach an equal policy -> zero recompiles; change it ->
+  one clean cache bust, same contract as ``setDeviceAugmentation``).
+- ``model.setPrecisionPolicy(policy)`` / ``fit(precision=...)`` wire it
+  through the existing updater seam: the updater always sees fp32
+  master params and fp32 gradients (unscaled), so every updater in
+  ``train.updaters`` works unchanged under the policy.
+- ``loss_scale`` (static) multiplies the loss inside the compiled step
+  and divides the gradients straight back out before clipping/updater
+  math — the float16 survival kit (bf16 shares fp32's exponent range
+  and does not need it; ``analysis/numerics.py`` W302 flags a
+  pointless scale, E303 a missing one).
+- The same object drives the static numerics pass
+  (``analysis/numerics.py``, ``--policy bf16`` on the CLI): E301
+  policy conflicts, E302 precision-unsafe accumulation, E303 dynamic-
+  range overflow are decided from this declaration before any compile.
+
+IMPORTANT: jax-free at module scope — the analysis package lints
+policies in environments where no accelerator stack imports
+(``tests/test_analysis.py`` pins this via a jax-blocked subprocess).
+``compute_jnp()`` imports jax lazily, only on the runtime path.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+#: canonical dtype spellings accepted everywhere a policy names a dtype
+_DTYPE_ALIASES = {
+    "float32": "float32", "fp32": "float32", "f32": "float32",
+    "single": "float32",
+    "bfloat16": "bfloat16", "bf16": "bfloat16",
+    "float16": "float16", "fp16": "float16", "f16": "float16",
+    "half": "float16",
+}
+
+#: dtypes with a reduced mantissa/exponent the numerics lints reason about
+LOW_PRECISION = frozenset({"bfloat16", "float16"})
+
+#: finite maxima the static range model compares against (IEEE half /
+#: bfloat16 / single) — hard-coded so the analysis side needs no jax/numpy
+DTYPE_MAX = {"float16": 65504.0, "bfloat16": 3.39e38, "float32": 3.40e38}
+
+
+def normalize_dtype(name) -> str:
+    key = str(name).strip().lower()
+    if key not in _DTYPE_ALIASES:
+        raise ValueError(
+            f"unknown precision dtype {name!r} (use one of "
+            f"{sorted(set(_DTYPE_ALIASES.values()))} or an alias like "
+            f"'bf16'/'fp16')")
+    return _DTYPE_ALIASES[key]
+
+
+class PrecisionPolicy:
+    """Declarative mixed-precision policy: ``compute`` is the dtype
+    matmul/conv layers run in on the MXU, ``params`` the master-weight
+    (and updater-state) dtype, ``loss_scale`` an optional static loss
+    scaling factor.  ``PrecisionPolicy("bfloat16")`` is the TPU-native
+    mixed policy: bf16 compute, fp32 masters, no scale."""
+
+    __slots__ = ("compute", "params", "loss_scale")
+
+    def __init__(self, compute: str = "float32", params: str = "float32",
+                 loss_scale: Optional[float] = None):
+        self.compute = normalize_dtype(compute)
+        self.params = normalize_dtype(params)
+        if loss_scale is not None:
+            loss_scale = float(loss_scale)
+            if loss_scale <= 0:
+                raise ValueError(
+                    f"loss_scale must be positive, got {loss_scale}")
+        self.loss_scale = loss_scale
+
+    # ---------------------------------------------------------- coercion
+    @staticmethod
+    def coerce(value) -> Optional["PrecisionPolicy"]:
+        """None | PrecisionPolicy | dtype string ("bf16") | dict ->
+        PrecisionPolicy (or None).  A bare dtype string means "that
+        compute dtype with fp32 master params and no loss scale" — the
+        CLI's ``--policy bf16`` spelling."""
+        if value is None or isinstance(value, PrecisionPolicy):
+            return value
+        if isinstance(value, str):
+            return PrecisionPolicy(compute=value)
+        if isinstance(value, dict):
+            return PrecisionPolicy(**value)
+        raise TypeError(f"cannot coerce {type(value).__name__} to a "
+                        "PrecisionPolicy (pass a policy, a dtype string, "
+                        "or a {'compute': ..., 'params': ...} dict)")
+
+    @staticmethod
+    def from_config_dtype(conf_dtype) -> Optional["PrecisionPolicy"]:
+        """The implicit policy a configuration's ``dataType`` declares:
+        bf16/fp16 configs run the mixed policy with fp32 masters;
+        fp32/f64 configs have no policy (None)."""
+        try:
+            name = normalize_dtype(conf_dtype)
+        except ValueError:
+            return None                      # float64 etc: no mixed policy
+        if name in LOW_PRECISION:
+            return PrecisionPolicy(compute=name)
+        return None
+
+    # ---------------------------------------------------------- analysis
+    @property
+    def is_low_precision(self) -> bool:
+        return self.compute in LOW_PRECISION
+
+    def compute_max(self) -> float:
+        return DTYPE_MAX[self.compute]
+
+    def params_max(self) -> float:
+        return DTYPE_MAX[self.params]
+
+    def signature(self):
+        """Hashable identity for the networks' signature()-keyed step
+        caches: two equal policies share every compiled program."""
+        return (self.compute, self.params, self.loss_scale)
+
+    # ----------------------------------------------------------- runtime
+    def compute_jnp(self):
+        """The jnp compute dtype for ``nn.layers.policy_cast`` — None
+        for a pure-fp32 policy (no casts traced).  Lazy jax import: the
+        only method on this class that touches the runtime stack."""
+        if self.compute == "float32":
+            return None
+        import jax.numpy as jnp
+        return {"bfloat16": jnp.bfloat16, "float16": jnp.float16}[self.compute]
+
+    def to_config(self):
+        return {"compute": self.compute, "params": self.params,
+                "loss_scale": self.loss_scale}
+
+    @staticmethod
+    def from_config(d):
+        return PrecisionPolicy(**d)
+
+    def __eq__(self, other):
+        return isinstance(other, PrecisionPolicy) \
+            and self.signature() == other.signature()
+
+    def __hash__(self):
+        return hash(self.signature())
+
+    def __repr__(self):
+        return (f"PrecisionPolicy(compute={self.compute!r}, "
+                f"params={self.params!r}, loss_scale={self.loss_scale})")
+
+
+def runtime_check(policy: PrecisionPolicy) -> PrecisionPolicy:
+    """Gate for ``setPrecisionPolicy``: the runtime keeps master params
+    (and therefore updater state) in fp32 — a low-precision ``params``
+    declaration is exactly the configuration the E301 lint exists to
+    reject, so attaching one raises instead of silently truncating the
+    moments."""
+    if policy.params != "float32":
+        raise ValueError(
+            f"PrecisionPolicy(params={policy.params!r}): the runtime "
+            "keeps fp32 master params — low-precision updater state is "
+            "the E301 hazard class (second moments overflow/underflow). "
+            "Declare params='float32' (the compute dtype may still be "
+            f"{policy.compute!r}).")
+    return policy

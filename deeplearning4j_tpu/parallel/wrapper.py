@@ -334,7 +334,11 @@ class ParallelInference:
             self._watchdog = DispatchWatchdog(deadline=replica_timeout,
                                               grace=replica_timeout)
         self._queue: "queue.Queue" = queue.Queue(maxsize=self.max_queue)
-        self._submit_lock = threading.Lock()
+        # instrumented (PR-8 adoption sweep): taken per submit AND by the
+        # recovery path's mesh swap — wait-time spikes here are the
+        # client-visible symptom of a dead-replica rebuild
+        from deeplearning4j_tpu.profiler.locks import InstrumentedLock
+        self._submit_lock = InstrumentedLock("parallel_inference_submit")
         self._shutdown = False
         self._worker = threading.Thread(target=self._serve, daemon=True)
         self._worker.start()

@@ -1,0 +1,654 @@
+"""Nonfinite-provenance sanitizer — NAN_PANIC that names the culprit.
+
+The seed's ``NAN_PANIC``/``INF_PANIC`` modes raise "NaN detected in
+loss at iteration 12" — true, and useless: by the time a nonfinite
+reaches the loss it has flowed through every layer, and the question
+that matters ("WHICH layer, WHICH op, WHICH step first went bad — the
+PR-4 YOLO triage burned a day answering it by hand") is unanswerable
+from the loss scalar.  This module extends the panic modes into a
+provenance sanitizer:
+
+- **Hot path** (one flag check when OFF, the standard instrumentation
+  gate): while a panic mode is active, each model keeps a *provenance
+  window* — a device-side snapshot of (params, states, opt_state)
+  taken every ``snapshot_every`` dispatches (default 8; ONE fused copy
+  dispatch via the ``train.resilience`` ``_device_copy``, lazy, no
+  host sync) plus references to every batch since (the compiled step
+  does NOT donate its batch args, so they stay valid).  Out-of-band
+  state mutations (fault poisons, checkpoint restores, elastic
+  rollbacks) void the window via :func:`invalidate` / the iteration-
+  gap check, forcing a fresh snapshot.
+- **Failure path**: when the post-dispatch loss scan finds a
+  nonfinite, the sanitizer rolls the snapshot forward through the
+  retained dispatches via the model's OWN compiled single-step program
+  (bit-exact — the scanned megastep body is byte-identical to it),
+  then REPLAYS the failing step eagerly, layer by layer, with the same
+  ``fold_in(seed, t)`` RNG stream, policy casts, and augmentation
+  prelude the compiled step traced — and attributes the FIRST
+  nonfinite to a specific (layer, op, step): params / forward / loss /
+  backward / updater.  Under a ``lax.scan`` megastep the K-loss vector
+  names the first bad step j.  The raise is a
+  :class:`NonfiniteAttributionError` (a ``NumericsPanicError``, so
+  every existing NAN_PANIC handler still catches it) carrying the
+  site, also exported as the ``dl4j_nonfinite_first_site{model,layer,
+  op}`` info metric (value = the 1-based step).
+- **Opt-in value-range tracking** (:func:`track_value_ranges`): every
+  N-th step additionally records per-layer activation |max| into
+  ``dl4j_tensor_absmax{model,layer}`` (log-scaled buckets spanning
+  1e-4..1e38) and, for bf16/fp16 policies, sets
+  ``dl4j_overflow_proximity`` = max |act| / finfo(compute).max — the
+  "how close is this run to E303" gauge the bf16 rollout watches.
+
+Costs: OFF = one enum read per dispatch.  ON = the loss sync the panic
+modes always paid + one fused copy dispatch every ``snapshot_every``
+steps (``benchmarks/probe_numerics_overhead.py`` pins provenance at
+< 5% over the legacy panic gate; measured ~1%); the roll-forward /
+eager replay and range walks run only on failure / sampled steps.
+TBPTT fits keep the plain loss-level panic (segment-state replay is
+not wired; ``environment.panic_check``).
+
+Like the rest of ``profiler/``, module scope imports no jax — jax
+enters lazily on the first active snapshot.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from deeplearning4j_tpu.profiler.metrics import get_registry
+from deeplearning4j_tpu.profiler.modes import ProfilingMode, get_profiling_mode
+from deeplearning4j_tpu.utils.environment import NumericsPanicError
+
+#: |max| buckets for dl4j_tensor_absmax: decades up to fp16-max, then the
+#: bf16/fp32 range — a histogram shaped for "how far from overflow".
+ABSMAX_BUCKETS = (1e-4, 1e-2, 1.0, 1e1, 1e2, 1e3, 1e4, 65504.0,
+                  1e6, 1e9, 1e12, 1e18, 1e24, 1e30, 1e38)
+
+_FIRST_SITE = get_registry().gauge(
+    "dl4j_nonfinite_first_site",
+    "First nonfinite site attributed by the provenance sanitizer "
+    "(value = 1-based update step; labels name the model, layer, op)",
+    labelnames=("model", "layer", "op"))
+_PANICS = get_registry().counter(
+    "dl4j_nonfinite_panics_total",
+    "Nonfinite losses caught (and attributed) by the panic sanitizer")
+_ABSMAX = get_registry().histogram(
+    "dl4j_tensor_absmax",
+    "Per-layer activation |max| samples from opt-in value-range tracking",
+    labelnames=("model", "layer"), buckets=ABSMAX_BUCKETS)
+_PROXIMITY = get_registry().gauge(
+    "dl4j_overflow_proximity",
+    "max per-layer activation |max| / finfo(compute dtype).max from the "
+    "most recent range-tracking walk (bf16/fp16 policies; 1.0 = at the "
+    "overflow ceiling)")
+
+# -------------------------------------------------- value-range tracking
+_TRACK_RANGES = False
+_TRACK_EVERY = 1
+_PROVENANCE = True
+#: dispatches between device-side state snapshots: the copy cost is paid
+#: 1/N of the time and attribution rolls the last snapshot forward
+#: through the SAME compiled step programs (bit-exact) using the
+#: retained batches — memory bound: N dispatches' worth of batch refs
+_SNAPSHOT_EVERY = 8
+
+
+def enable_provenance(enabled: bool = True,
+                      snapshot_every: Optional[int] = None) -> None:
+    """``enable_provenance(False)`` keeps the NAN_PANIC/INF_PANIC loss
+    gate but skips the snapshots — the legacy attribution-free behavior
+    (and the overhead probe's baseline for "what does provenance itself
+    cost on top of the panic sync").  ``snapshot_every`` tunes the
+    snapshot cadence (1 = copy state every dispatch: cheapest
+    attribution, costliest steady state)."""
+    global _PROVENANCE, _SNAPSHOT_EVERY
+    _PROVENANCE = bool(enabled)
+    if snapshot_every is not None:
+        _SNAPSHOT_EVERY = max(1, int(snapshot_every))
+
+
+def track_value_ranges(enable: bool = True, every: int = 1) -> None:
+    """Opt-in absmax/value-range tracking: while a panic mode is active,
+    every ``every``-th update step runs one eager per-layer forward on
+    the live batch and records ``dl4j_tensor_absmax`` samples plus the
+    overflow-proximity gauge.  A full extra forward per sampled step —
+    a diagnostic dial, not a production default."""
+    global _TRACK_RANGES, _TRACK_EVERY
+    _TRACK_RANGES = bool(enable)
+    _TRACK_EVERY = max(1, int(every))
+
+
+class NonfiniteAttributionError(NumericsPanicError):
+    """NAN_PANIC/INF_PANIC with provenance: carries the first-nonfinite
+    (layer, op, step) the replay attributed."""
+
+    def __init__(self, message: str, layer: str = "", op: str = "",
+                 step: int = 0):
+        super().__init__(message)
+        self.layer = layer
+        self.op = op
+        self.step = step
+
+
+def active() -> bool:
+    """One enum read: True while a panic mode wants the sanitizer armed."""
+    return get_profiling_mode() in (ProfilingMode.NAN_PANIC,
+                                    ProfilingMode.INF_PANIC)
+
+
+class _ModelSan:
+    """Per-model provenance state: the last device-side snapshot plus
+    the (kind, batch, start-iteration, steps) of every dispatch since —
+    enough to roll the snapshot forward to ANY step in the window
+    through the model's own compiled step programs."""
+
+    __slots__ = ("params", "states", "opt_state", "snap_step",
+                 "expected_next", "ring")
+
+    def __init__(self, params, states, opt_state, snap_step):
+        self.params = params
+        self.states = states
+        self.opt_state = opt_state
+        self.snap_step = snap_step
+        self.expected_next = snap_step
+        self.ring: list = []      # (kind, batch dict, start_iter, steps)
+
+
+class _Token:
+    """One dispatch's provenance handle: the shared per-model state plus
+    this dispatch's position in its ring."""
+
+    __slots__ = ("state", "ring_index", "step0", "batch", "kind")
+
+    def __init__(self, state, ring_index, step0, batch, kind):
+        self.state = state
+        self.ring_index = ring_index
+        self.step0 = step0          # 0-based iteration count before dispatch
+        self.batch = batch          # dict of arrays the step consumed
+        self.kind = kind            # "single" | "mega" | "graph" | "graph_mega"
+
+
+_STATES: "weakref.WeakKeyDictionary" = None  # created on first use
+
+
+def invalidate(model) -> None:
+    """Void the provenance window after an OUT-OF-BAND model-state
+    mutation the compiled-step replay cannot reproduce — fault-injected
+    parameter poisons, checkpoint restores.  The next dispatch takes a
+    fresh snapshot, so attribution stays exact across the mutation."""
+    if _STATES is not None:
+        _STATES.pop(model, None)
+
+
+def _steps_of(kind: str, batch: dict) -> int:
+    if kind == "mega":
+        return int(batch["x"].shape[0])
+    if kind == "graph_mega":
+        return int(batch["labels"][0].shape[0])
+    return 1
+
+
+def snapshot(model, kind: str, **batch) -> Optional[_Token]:
+    """Arm provenance for one dispatch.  Returns None (cost: one enum
+    read) unless a panic mode is active.  The device-side state copy
+    (ONE compiled dispatch for all three trees) is taken every
+    ``snapshot_every`` dispatches — in between, only the batch refs are
+    retained and attribution replays forward from the last snapshot.  A
+    gap in the iteration sequence (an elastic rollback, an abandoned
+    dispatch) voids the window and forces a fresh snapshot."""
+    if not (_PROVENANCE and active()):
+        return None
+    global _STATES
+    if _STATES is None:
+        import weakref
+        _STATES = weakref.WeakKeyDictionary()
+    it = model._iteration
+    st = _STATES.get(model)
+    if st is None or st.expected_next != it \
+            or it - st.snap_step >= _SNAPSHOT_EVERY:
+        from deeplearning4j_tpu.train.resilience import _device_copy
+        params, states, opt = _device_copy(
+            (model._params, model._states, model._opt_state))
+        st = _ModelSan(params, states, opt, it)
+        _STATES[model] = st
+    batch = dict(batch)
+    st.ring.append((kind, batch, it, _steps_of(kind, batch)))
+    st.expected_next = it + st.ring[-1][3]
+    return _Token(st, len(st.ring) - 1, it, batch, kind)
+
+
+def check(model, token: Optional[_Token], losses,
+          context: str = "loss") -> None:
+    """Post-dispatch numerics gate: under a panic mode, pull the loss
+    (vector) and raise on NaN/Inf — with first-nonfinite attribution
+    when a snapshot token is available.  Also drives the opt-in
+    value-range walk.  No-op (zero device syncs) when no panic mode is
+    active — call sites pay one enum read."""
+    mode = get_profiling_mode()
+    if mode not in (ProfilingMode.NAN_PANIC, ProfilingMode.INF_PANIC):
+        return
+    import numpy as np
+    vals = np.asarray(losses).reshape(-1)
+    # the loss gate keeps each mode's LEGACY scan (NaN-only / Inf-only,
+    # matching environment.panic_check and the op-level _panic_scan);
+    # once triggered, the attribution walk looks for the first
+    # NONFINITE of any kind — an inf input that became a NaN loss is
+    # attributed to the inf, which is the actual first bad site
+    if mode is ProfilingMode.NAN_PANIC:
+        bad = np.isnan(vals)
+        label = "NAN_PANIC"
+    else:
+        bad = np.isinf(vals)
+        label = "INF_PANIC"
+    if not bad.any():
+        if token is not None and _TRACK_RANGES \
+                and (token.step0 % _TRACK_EVERY) == 0:
+            _record_ranges(model, token)
+        return
+    _PANICS.inc()
+    j = int(np.argmax(bad))                  # first bad step in the dispatch
+    step = (token.step0 if token is not None else model._iteration) + j + 1
+    site = None
+    if token is not None:
+        try:
+            site = _attribute(model, token, j)
+        except Exception as e:               # a diagnostic must not mask
+            site = ("<replay-failed>", f"error:{type(e).__name__}")
+    if site is None:
+        raise NumericsPanicError(
+            f"{label}: nonfinite detected in {context} "
+            f"(step {step}; no provenance snapshot available)")
+    layer, op = site
+    _FIRST_SITE.labels(model=type(model).__name__, layer=layer,
+                       op=op).set(step)
+    raise NonfiniteAttributionError(
+        f"{label}: nonfinite detected in {context} — first nonfinite "
+        f"attributed to layer '{layer}', op '{op}', step {step}",
+        layer=layer, op=op, step=step)
+
+
+# ------------------------------------------------------------ attribution
+def _bad_fn():
+    import numpy as np
+
+    def bad(a):
+        v = np.asarray(a, dtype=np.float64) \
+            if str(getattr(a, "dtype", "")) == "bfloat16" else np.asarray(a)
+        return not np.isfinite(v).all()
+    return bad
+
+
+def _tree_bad(tree, bad) -> bool:
+    import jax
+    return any(bad(leaf) for leaf in jax.tree_util.tree_leaves(tree)
+               if hasattr(leaf, "dtype"))
+
+
+def _roll_dispatch(model, kind: str, batch: dict, start_it: int,
+                   n_steps: int, params, states, opt):
+    """Advance (params, states, opt) ``n_steps`` update steps through
+    the model's OWN compiled single-step program.  For megastep
+    dispatches the scanned body is byte-identical to the single-step
+    body, so j single steps over the K slices == j scanned steps."""
+    import jax.numpy as jnp
+    if n_steps <= 0:
+        return params, states, opt
+    if kind in ("single", "mega"):
+        mega = kind == "mega"
+        b = batch
+        sig = (b.get("fmask") is not None, b.get("lmask") is not None)
+        if sig not in model._train_step_cache:
+            model._train_step_cache[sig] = model._make_train_step(*sig)
+        step = model._train_step_cache[sig]
+        dummy = jnp.zeros((1,))
+        for i in range(n_steps):
+            sel = (lambda a: a[i]) if mega else (lambda a: a)
+            params, states, opt, _, _ = step(
+                params, states, opt,
+                jnp.asarray(start_it + i, jnp.int32),
+                sel(b["x"]), sel(b["y"]),
+                sel(b["fmask"]) if b.get("fmask") is not None else dummy,
+                sel(b["lmask"]) if b.get("lmask") is not None else dummy)
+    else:                                       # graph / graph_mega
+        mega = kind == "graph_mega"
+        b = batch
+        sig = b.get("lmasks") is not None
+        if sig not in model._train_step_cache:
+            model._train_step_cache[sig] = model._make_train_step(sig)
+        step = model._train_step_cache[sig]
+        dummy = [jnp.zeros((1,))] * len(b["labels"])
+        for i in range(n_steps):
+            sel = (lambda a: a[i]) if mega else (lambda a: a)
+            ins_i = {k: sel(v) for k, v in b["ins"].items()}
+            labels_i = [sel(a) for a in b["labels"]]
+            lm_i = [sel(m) for m in b["lmasks"]] \
+                if b.get("lmasks") is not None else dummy
+            params, states, opt, _, _ = step(
+                params, states, opt,
+                jnp.asarray(start_it + i, jnp.int32),
+                ins_i, labels_i, lm_i)
+    return params, states, opt
+
+
+def _attribute(model, token: _Token, j: int) -> Tuple[str, str]:
+    """Replay step ``token.step0 + j``: roll the last snapshot forward
+    through every retained dispatch before this one (and j steps into
+    this one), then walk the failing step eagerly.  The roll-forward
+    DONATES the snapshot buffers into the compiled steps, so the
+    per-model provenance window is consumed — dropped from the store
+    either way, since the raise ends the fit."""
+    st = token.state
+    if _STATES is not None:
+        _STATES.pop(model, None)
+    params, states, opt = st.params, st.states, st.opt_state
+    for kind_i, batch_i, it_i, steps_i in st.ring[:token.ring_index]:
+        params, states, opt = _roll_dispatch(
+            model, kind_i, batch_i, it_i, steps_i, params, states, opt)
+    params, states, opt = _roll_dispatch(
+        model, token.kind, token.batch, token.step0, j, params, states, opt)
+    t = token.step0 + j
+    b = token.batch
+    if token.kind in ("single", "mega"):
+        idx = (lambda a: a[j]) if token.kind == "mega" else (lambda a: a)
+        return _attribute_multilayer(
+            model, params, states, opt, t, idx(b["x"]), idx(b["y"]),
+            idx(b["fmask"]) if b.get("fmask") is not None else None,
+            idx(b["lmask"]) if b.get("lmask") is not None else None)
+    idx = (lambda a: a[j]) if token.kind == "graph_mega" else (lambda a: a)
+    return _attribute_graph(
+        model, params, states, opt, t,
+        {k: idx(v) for k, v in b["ins"].items()},
+        [idx(a) for a in b["labels"]],
+        [idx(m) for m in b["lmasks"]] if b.get("lmasks") is not None
+        else None)
+
+
+# ------------------------------------------------- shared eager walkers
+def _walk_multilayer(model, params, states, x, fmask, t, train):
+    """THE eager per-layer walk mirroring ``MultiLayerNetwork._forward``
+    (same casts, same RNG stream) — the single copy both the
+    attribution and the absmax recorder consume, so a ``_forward``
+    change has exactly one mirror to keep in sync.  Yields
+    ``(name, layer, cast_params, activation)`` per layer."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.nn import layers as L
+    from deeplearning4j_tpu.nn.multilayer import _MASK_AWARE
+    cdt = model._compute_dtype()
+    if cdt is None and getattr(x, "dtype", None) == jnp.uint8:
+        x = x.astype(jnp.float32)
+    key = jax.random.fold_in(jax.random.PRNGKey(model.conf.base.seed),
+                             jnp.asarray(t, jnp.int32))
+    for i, layer in enumerate(model.layers):
+        if i in model.conf.preprocessors:
+            x = model.conf.preprocessors[i](x)
+        p = params[i]
+        if cdt is not None:
+            p, x = L.policy_cast(layer, p, x, cdt)
+        key, sub = jax.random.split(key)
+        if isinstance(layer, _MASK_AWARE):
+            x, _ = layer.apply(p, states[i], x, train, sub, mask=fmask)
+        else:
+            x, _ = layer.apply(p, states[i], x, train, sub)
+        yield f"{i}:{layer.name}", layer, p, x
+
+
+def _walk_graph(model, params, states, env, t, train):
+    """THE eager per-node walk mirroring ``ComputationGraph._forward``
+    (see ``_walk_multilayer``).  ``env`` maps input names to PREPARED
+    arrays (cast/augmented by the caller) and is filled with every
+    node's output as the walk progresses.  Yields
+    ``(node, cast_params_or_None, output)``."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.nn import layers as L
+    from deeplearning4j_tpu.nn.graph import _MASK_AWARE
+    cdt = model._compute_dtype()
+    key = jax.random.fold_in(jax.random.PRNGKey(model.conf.base.seed),
+                             jnp.asarray(t, jnp.int32))
+    for node in model.conf.topo:
+        xs = [env[i] for i in node.inputs]
+        if node.kind == "layer":
+            xv = xs[0]
+            if node.name in model.conf.preprocessors:
+                xv = model.conf.preprocessors[node.name](xv)
+            p = params[node.name]
+            if cdt is not None:
+                p, xv = L.policy_cast(node.obj, p, xv, cdt)
+            key, sub = jax.random.split(key)
+            if isinstance(node.obj, _MASK_AWARE):
+                out, _ = node.obj.apply(p, states[node.name], xv, train,
+                                        sub, mask=None)
+            else:
+                out, _ = node.obj.apply(p, states[node.name], xv, train,
+                                        sub)
+        else:
+            if cdt is not None and len(xs) > 1:
+                if any(getattr(a, "dtype", None) == jnp.bfloat16
+                       for a in xs):
+                    xs = [a.astype(jnp.bfloat16)
+                          if getattr(a, "dtype", None) == jnp.float32
+                          else a for a in xs]
+            p = None
+            out = node.obj.apply(*xs)
+        env[node.name] = out
+        yield node, p, out
+
+
+def _attribute_multilayer(model, params, states, opt, t, x, y, fmask,
+                          lmask) -> Tuple[str, str]:
+    """First-nonfinite site over the shared multilayer walk."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.nn import augment as _augment_mod
+    bad = _bad_fn()
+    x = jnp.asarray(x)
+    if bad(x):
+        return "<input>", "batch"
+    x = _augment_mod.maybe_augment(model._augment, x,
+                                   jnp.asarray(t, jnp.int32))
+    if bad(x):
+        return "<input>", "augment"
+    x_step = x                  # the step body's input (post-augment):
+    out = x                     # what the backward replay re-forwards
+    for name, layer, p, out in _walk_multilayer(model, params, states, x,
+                                                fmask, t, train=True):
+        if _tree_bad(p, bad):
+            return name, "params"
+        if bad(out):
+            return name, f"forward:{type(layer).__name__}"
+    head = len(model.layers) - 1
+    head_name = f"{head}:{model.layers[head].name}"
+    loss = model.layers[-1].compute_loss(jnp.asarray(y), out, mask=lmask)
+    if bad(loss):
+        return head_name, f"loss:{getattr(model.layers[-1], 'loss_fn', '?')}"
+    return _grad_site_mln(model, params, states, opt, t, x_step, y, fmask,
+                          lmask)
+
+
+def _attribute_graph(model, params, states, opt, t, ins, labels,
+                     lmasks) -> Tuple[str, str]:
+    """First-nonfinite site over the shared graph walk."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.nn import augment as _augment_mod
+    bad = _bad_fn()
+    cdt = model._compute_dtype()
+    env = {}
+    for k, v in ins.items():
+        v = jnp.asarray(v)
+        if bad(v):
+            return f"<input:{k}>", "batch"
+        if model._augment is not None:
+            v = _augment_mod.maybe_augment(model._augment, v,
+                                           jnp.asarray(t, jnp.int32))
+        if cdt is None and getattr(v, "dtype", None) == jnp.uint8:
+            v = v.astype(jnp.float32)
+        env[k] = v
+    for node, p, out in _walk_graph(model, params, states, env, t,
+                                    train=True):
+        if p is not None and _tree_bad(p, bad):
+            return node.name, "params"
+        if bad(out):
+            kind = "forward" if node.kind == "layer" else "vertex"
+            return node.name, f"{kind}:{type(node.obj).__name__}"
+    for i, name in enumerate(model.conf.graph_outputs):
+        node = model.conf.node_by_name[name]
+        lm = lmasks[i] if lmasks is not None else None
+        loss = node.obj.compute_loss(jnp.asarray(labels[i]), env[name],
+                                     mask=lm)
+        if bad(loss):
+            return name, f"loss:{getattr(node.obj, 'loss_fn', '?')}"
+    return _grad_site_graph(model, params, states, opt, t, ins, labels,
+                            lmasks)
+
+
+# ------------------------------------------------- backward/updater sites
+def _first_bad_leaf(tree, names, bad) -> Optional[str]:
+    """Name of the first layer whose grad/state subtree has a nonfinite."""
+    import jax
+    for name, sub in zip(names, tree):
+        if any(bad(leaf) for leaf in jax.tree_util.tree_leaves(sub)
+               if hasattr(leaf, "dtype")):
+            return name
+    return None
+
+
+def _loss_scale_of(model):
+    pol = getattr(model, "_precision", None)
+    return pol.loss_scale if pol is not None else None
+
+
+def _grad_site_mln(model, params, states, opt, t, x, y, fmask,
+                   lmask) -> Tuple[str, str]:
+    import jax
+    import jax.numpy as jnp
+    bad = _bad_fn()
+    scale = _loss_scale_of(model)
+    key = jax.random.fold_in(jax.random.PRNGKey(model.conf.base.seed),
+                             jnp.asarray(t, jnp.int32))
+
+    def loss_fn(p):
+        loss = model._loss_and_reg(p, states, jnp.asarray(x),
+                                   jnp.asarray(y), True, key, fmask,
+                                   lmask)[0]
+        return loss * scale if scale else loss
+    grads = jax.grad(loss_fn)(params)
+    names = [f"{i}:{l.name}" for i, l in enumerate(model.layers)]
+    # the compiled step checks/applies grads SCALED first (overflow in the
+    # scaled grads is the classic fp16 failure), then unscales for the
+    # updater — mirror both halves
+    hit = _first_bad_leaf(grads, names, bad)
+    if hit is not None:
+        return hit, "backward"
+    if scale:
+        grads = jax.tree_util.tree_map(lambda g: g / scale, grads)
+    return _updater_site(model, params, grads, opt, t, names, bad)
+
+
+def _grad_site_graph(model, params, states, opt, t, ins, labels,
+                     lmasks) -> Tuple[str, str]:
+    import jax
+    import jax.numpy as jnp
+    bad = _bad_fn()
+    key = jax.random.fold_in(jax.random.PRNGKey(model.conf.base.seed),
+                             jnp.asarray(t, jnp.int32))
+    ins_j = {k: jnp.asarray(v) for k, v in ins.items()}
+    labels_j = [jnp.asarray(a) for a in labels]
+    scale = _loss_scale_of(model)
+
+    def loss_fn(p):
+        loss = model._loss_and_reg(p, states, ins_j, labels_j, True, key,
+                                   None, lmasks)[0]
+        return loss * scale if scale else loss
+    grads = jax.grad(loss_fn)(params)
+    names = sorted(grads)
+    hit = _first_bad_leaf([grads[n] for n in names], names, bad)
+    if hit is not None:
+        return hit, "backward"
+    if scale:
+        grads = jax.tree_util.tree_map(lambda g: g / scale, grads)
+    return _updater_site(model, params, grads, opt, t, names, bad,
+                         graph=True)
+
+
+def _updater_site(model, params, grads, opt, t, names, bad,
+                  graph: bool = False) -> Tuple[str, str]:
+    """Apply one updater step eagerly and name the first layer whose new
+    opt-state/params go nonfinite; falls back to the loss head."""
+    from deeplearning4j_tpu.nn.multilayer import _process_and_apply_grads
+    base = model.conf.base
+    new_params, new_opt = _process_and_apply_grads(
+        base, base.updater, params, grads, opt, float(t))
+    upd_name = type(base.updater).__name__
+    if graph:
+        order = names
+        new_p = [new_params[n] for n in order]
+        new_o = [new_opt[n] for n in order]
+    else:
+        order = names
+        new_p, new_o = new_params, new_opt
+    hit = _first_bad_leaf(new_o, order, bad)
+    if hit is not None:
+        return hit, f"updater:{upd_name}"
+    hit = _first_bad_leaf(new_p, order, bad)
+    if hit is not None:
+        return hit, f"updater:{upd_name}"
+    return order[-1] if order else "<model>", "dispatch"
+
+
+# --------------------------------------------------- value-range tracking
+def _record_ranges(model, token: _Token) -> None:
+    """One eager forward recording per-layer activation |max| — the
+    opt-in dl4j_tensor_absmax / overflow-proximity walk."""
+    import numpy as np
+    try:
+        sites = _collect_absmax(model, token)
+    except Exception:
+        return                              # diagnostics never break a fit
+    if not sites:
+        return
+    mname = type(model).__name__
+    peak = 0.0
+    for layer, amax in sites:
+        _ABSMAX.labels(model=mname, layer=layer).observe(amax)
+        if np.isfinite(amax):
+            peak = max(peak, amax)
+    pol = getattr(model, "_precision", None)
+    if pol is not None and pol.is_low_precision:
+        _PROXIMITY.set(peak / pol.compute_max())
+
+
+def _collect_absmax(model, token: _Token) -> List[Tuple[str, float]]:
+    """Per-layer |max| over the SAME shared walkers attribution uses
+    (live post-step params — a magnitude diagnostic, not a replay)."""
+    import jax.numpy as jnp
+    import numpy as np
+    b = token.batch
+    out: List[Tuple[str, float]] = []
+
+    def amax(a):
+        v = np.asarray(a, dtype=np.float64) \
+            if str(getattr(a, "dtype", "")) == "bfloat16" else np.asarray(a)
+        return float(np.max(np.abs(v))) if v.size else 0.0
+
+    cdt = model._compute_dtype()
+    if token.kind in ("single", "mega"):
+        x = jnp.asarray(b["x"][0] if token.kind == "mega" else b["x"])
+        fmask = b.get("fmask")
+        if fmask is not None and token.kind == "mega":
+            fmask = fmask[0]
+        for name, _, _, act in _walk_multilayer(
+                model, model._params, model._states, x, fmask,
+                token.step0, train=False):
+            out.append((name, amax(act)))
+    else:
+        idx = (lambda a: a[0]) if token.kind == "graph_mega" else (lambda a: a)
+        env = {}
+        for k, v in b["ins"].items():
+            v = jnp.asarray(idx(v))
+            if cdt is None and v.dtype == jnp.uint8:
+                v = v.astype(jnp.float32)
+            env[k] = v
+        for node, _, o in _walk_graph(model, model._params, model._states,
+                                      env, token.step0, train=False):
+            out.append((node.name, amax(o)))
+    return out

@@ -732,6 +732,10 @@ class TrainingSession:
                 warnings.warn(
                     "resume: iterator does not support seek(); replaying "
                     "the interrupted epoch from its start", stacklevel=2)
+        # a restore is an out-of-band state mutation the provenance
+        # sanitizer's replay window cannot reproduce
+        from deeplearning4j_tpu.profiler import sanitizer as _san
+        _san.invalidate(self.model)
         res_state = (info.get("extra") or {}).get("resilience") or {}
         lr_scale = res_state.get("lr_scale", 1.0)
         upd = self.model.conf.base.updater
@@ -775,6 +779,12 @@ class TrainingSession:
 
     # --------------------------------------------------------------- hooks
     def before_step(self):
+        if self.faults is not None:
+            # planned layer-params poison (provenance-sanitizer pin):
+            # lands BEFORE any recovery snapshot and before the
+            # sanitizer's own pre-step snapshot, so both observe it
+            self.faults.poison_layer_params(self.model,
+                                            self.model._iteration + 1)
         rec = self.recovery
         if rec is not None and rec.policy in (NanPolicy.SKIP_STEP,
                                               NanPolicy.BACKOFF_LR):

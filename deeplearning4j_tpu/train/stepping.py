@@ -40,7 +40,7 @@ import numpy as np
 
 from deeplearning4j_tpu import profiler as _prof
 from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
-from deeplearning4j_tpu.utils import environment as _environment
+from deeplearning4j_tpu.profiler import sanitizer as _sanitizer
 
 # How many update steps the most recent compiled dispatch performed.
 STEPS_PER_DISPATCH = _prof.get_registry().gauge(
@@ -213,11 +213,13 @@ def scan_megastep(body, num_carry: int):
     return megastep
 
 
-def record_megastep(model, losses, steps: int, batch_size: int) -> None:
+def record_megastep(model, losses, steps: int, batch_size: int,
+                    san_token=None) -> None:
     """Shared post-dispatch bookkeeping for ``_fit_mega`` (both network
-    classes): numerics panic gate over the K-loss vector, then per-step
-    listener delivery — each ``losses[j]`` stays a lazy device scalar
-    unless a listener actually pulls ``score()``.
+    classes): numerics panic gate over the K-loss vector (with first-
+    nonfinite provenance when the sanitizer armed ``san_token``), then
+    per-step listener delivery — each ``losses[j]`` stays a lazy device
+    scalar unless a listener actually pulls ``score()``.
 
     Listener semantics under megasteps: all K callback pairs fire AFTER
     the dispatch, so a listener that inspects model state (params,
@@ -226,8 +228,9 @@ def record_megastep(model, losses, steps: int, batch_size: int) -> None:
     intervals, EvaluativeListener) should use an interval K divides — or
     choose K to divide the interval — so callbacks land on dispatch
     boundaries where state and iteration number agree."""
-    _environment.panic_check(
-        losses, f"megastep losses at iterations "
+    _sanitizer.check(
+        model, san_token, losses,
+        context=f"megastep losses at iterations "
                 f"{model._iteration + 1}..{model._iteration + steps}")
     if _prof.instrumentation_active():
         TRAIN_ITERATIONS.inc(steps)

@@ -1720,3 +1720,565 @@ class TestInputPipelineLint:
                                   width=16, device_img_per_sec=10000)
         r2 = analyze(conf, input_pipeline=spec2)
         assert "DL4J-W108" in [d.code for d in r2.diagnostics]
+
+
+# ------------------------------------------------- numerics lints (ISSUE 11)
+class TestNumericsDiagnostics:
+    """E301-E303 / W301-W303: one seeded misconfiguration AND one clean
+    bill per code, under explicit policies and DataRangeSpec input
+    declarations."""
+
+    def _mlp(self, updater=None, **layer_kw):
+        from deeplearning4j_tpu.nn.layers import LossLayer  # noqa: F401
+        return (_builder(updater).list()
+                .layer(DenseLayer(nOut=16, activation="relu", **layer_kw))
+                .layer(OutputLayer(nOut=3, lossFunction="mcxent",
+                                   activation="softmax"))
+                .setInputType(InputType.feedForward(8))
+                .build())
+
+    def test_e301_low_precision_updater_state(self):
+        from deeplearning4j_tpu.nn.precision import PrecisionPolicy
+        conf = self._mlp(updater=Adam(1e-3))
+        pol = PrecisionPolicy("float16", params="float16", loss_scale=1024)
+        report = analyze(conf, policy=pol)
+        assert "DL4J-E301" in report.codes()
+        assert not report.ok()
+        # fp32 masters (the default coercion): clean
+        assert "DL4J-E301" not in analyze(conf, policy="fp16",
+                                          suppress=["E303"]).codes()
+        # stateless Sgd tolerates low-precision state declarations
+        assert "DL4J-E301" not in analyze(
+            self._mlp(), policy=pol).codes()
+
+    def test_e301_contradicting_layer_override(self):
+        conf = self._mlp(dataType="float16")
+        report = analyze(conf, policy="bf16")
+        assert "DL4J-E301" in report.codes()
+        # matching override and explicit fp32 island are both fine
+        assert "DL4J-E301" not in analyze(
+            self._mlp(dataType="bf16"), policy="bf16").codes()
+        assert "DL4J-E301" not in analyze(
+            self._mlp(dataType="float32"), policy="bf16").codes()
+
+    def test_e302_large_softmax_axis(self):
+        conf = (_builder().list()
+                .layer(DenseLayer(nOut=1024, activation="softmax"))
+                .layer(OutputLayer(nOut=3))
+                .setInputType(InputType.feedForward(8)).build())
+        assert "DL4J-E302" in analyze(conf, policy="bf16").codes()
+        # clean: fp32 policy, small axis, or an explicit fp32 island
+        assert "DL4J-E302" not in analyze(conf).codes()
+        small = (_builder().list()
+                 .layer(DenseLayer(nOut=64, activation="softmax"))
+                 .layer(OutputLayer(nOut=3))
+                 .setInputType(InputType.feedForward(8)).build())
+        assert "DL4J-E302" not in analyze(small, policy="bf16").codes()
+        island = (_builder().list()
+                  .layer(DenseLayer(nOut=1024, activation="softmax",
+                                    dataType="float32"))
+                  .layer(OutputLayer(nOut=3))
+                  .setInputType(InputType.feedForward(8)).build())
+        assert "DL4J-E302" not in analyze(island, policy="bf16").codes()
+
+    def test_e302_loss_head_dragged_low(self):
+        conf = (_builder().list()
+                .layer(DenseLayer(nOut=16))
+                .layer(OutputLayer(nOut=3, dataType="bf16"))
+                .setInputType(InputType.feedForward(8)).build())
+        assert "DL4J-E302" in analyze(conf, policy="bf16").codes()
+
+    def test_e302_attention_timestep_axis(self):
+        from deeplearning4j_tpu.nn.layers import (RnnOutputLayer,
+                                                  SelfAttentionLayer)
+        def att(t):
+            return (_builder().list()
+                    .layer(SelfAttentionLayer(nOut=64, nHeads=4,
+                                              headSize=16))
+                    .layer(RnnOutputLayer(nOut=3, lossFunction="mcxent"))
+                    .setInputType(InputType.recurrent(64, t)).build())
+        assert "DL4J-E302" in analyze(att(2048), policy="bf16").codes()
+        assert "DL4J-E302" not in analyze(att(128), policy="bf16").codes()
+
+    def test_e303_fp16_without_loss_scaling(self):
+        conf = self._mlp()
+        report = analyze(conf, policy="fp16")
+        assert "DL4J-E303" in report.codes()
+        from deeplearning4j_tpu.nn.precision import PrecisionPolicy
+        assert "DL4J-E303" not in analyze(
+            conf, policy=PrecisionPolicy("float16",
+                                         loss_scale=2 ** 15)).codes()
+
+    def test_e303_yolo_overflow_fixture(self):
+        """THE acceptance pin: the statically-reconstructed YOLO bug —
+        raw [0, 255] input + fp16-class updater state — is E303 at
+        validate() time."""
+        from deeplearning4j_tpu.nn.layers import LossLayer
+        from deeplearning4j_tpu.nn.precision import PrecisionPolicy
+        conf = (_builder(Adam(1e-3)).list()
+                .layer(DenseLayer(nOut=32, activation="relu"))
+                .layer(LossLayer(lossFunction="mse"))
+                .setInputType(InputType.feedForward(16)).build())
+        pol = PrecisionPolicy("float16", params="float16",
+                              loss_scale=2 ** 15)
+        report = conf.validate(policy=pol, data_range="0..255")
+        assert "DL4J-E303" in report.codes(), report.format()
+        # fp32 updater state holds the ~4e9 second moment: W303 only
+        r32 = conf.validate(data_range="0..255")
+        assert "DL4J-E303" not in r32.codes()
+        assert "DL4J-W303" in r32.codes()
+        # normalized input: both clean
+        rn = conf.validate(policy=pol, data_range="0..1")
+        assert "DL4J-E303" not in rn.codes()
+        assert "DL4J-W303" not in rn.codes()
+
+    def test_w301_fp32_sandwich(self):
+        conf = (_builder().list()
+                .layer(DenseLayer(nOut=16))
+                .layer(DenseLayer(nOut=16, dataType="float32"))
+                .layer(DenseLayer(nOut=16))
+                .layer(OutputLayer(nOut=3))
+                .setInputType(InputType.feedForward(8)).build())
+        assert "DL4J-W301" in analyze(conf, policy="bf16").codes()
+        # an island at the EDGE (before the fp32 loss head) is not churn
+        edge = (_builder().list()
+                .layer(DenseLayer(nOut=16))
+                .layer(DenseLayer(nOut=16, dataType="float32"))
+                .layer(OutputLayer(nOut=3))
+                .setInputType(InputType.feedForward(8)).build())
+        assert "DL4J-W301" not in analyze(edge, policy="bf16").codes()
+        assert "DL4J-W301" not in analyze(conf).codes()
+
+
+    def test_w301_sequential_only(self):
+        """Review regression: W301 reasons about layer adjacency, which
+        graph node order is not — the lint stays off for graphs."""
+        g = (_graph_builder()
+             .addLayer("a", DenseLayer(nOut=16), "in")
+             .addLayer("b", DenseLayer(nOut=16, dataType="float32"), "in")
+             .addLayer("c", DenseLayer(nOut=16), "in")
+             .addLayer("m", DenseLayer(nOut=16), "a", "b")
+             .addLayer("out", OutputLayer(nOut=2), "m")
+             .setOutputs("out"))
+        assert "DL4J-W301" not in analyze(g.build(), policy="bf16",
+                                          suppress=["E003"]).codes()
+
+    def test_w302_loss_scale_misconfigurations(self):
+        from deeplearning4j_tpu.nn.precision import PrecisionPolicy
+        conf = self._mlp()
+        assert "DL4J-W302" in analyze(
+            conf, policy=PrecisionPolicy("bfloat16",
+                                         loss_scale=1024)).codes()
+        assert "DL4J-W302" in analyze(
+            conf, policy=PrecisionPolicy("float16",
+                                         loss_scale=0.5)).codes()
+        assert "DL4J-W302" in analyze(
+            conf, policy=PrecisionPolicy("float16",
+                                         loss_scale=2.0 ** 30)).codes()
+        assert "DL4J-W302" not in analyze(
+            conf, policy=PrecisionPolicy("float16",
+                                         loss_scale=2 ** 15)).codes()
+
+    def test_w303_unnormalized_input(self):
+        conf = self._mlp(updater=Adam(1e-3))
+        assert "DL4J-W303" in analyze(conf, data_range="0..255").codes()
+        assert "DL4J-W303" not in analyze(
+            conf, data_range="0..255,normalized").codes()
+        assert "DL4J-W303" not in analyze(conf, data_range="-1..1").codes()
+        # a BatchNormalization FIRST does the normalizer's job
+        from deeplearning4j_tpu.nn.layers import BatchNormalization
+        bn = (_builder(Adam(1e-3)).list()
+              .layer(BatchNormalization())
+              .layer(DenseLayer(nOut=16, activation="relu"))
+              .layer(OutputLayer(nOut=3))
+              .setInputType(InputType.feedForward(8)).build())
+        assert "DL4J-W303" not in analyze(bn, data_range="0..255").codes()
+
+    def test_data_range_spec_parse_and_coerce(self):
+        from deeplearning4j_tpu.analysis.numerics import DataRangeSpec
+        r = DataRangeSpec.parse("0..255")
+        assert (r.lo, r.hi, r.normalized) == (0.0, 255.0, False)
+        assert DataRangeSpec.parse("-1..1,normalized").normalized
+        assert DataRangeSpec.coerce((0, 255)).hi == 255
+        assert DataRangeSpec.coerce({"lo": 0, "hi": 1}).max_abs == 1.0
+        with pytest.raises(ValueError):
+            DataRangeSpec.parse("255")
+        with pytest.raises(ValueError):
+            DataRangeSpec.parse("0..255,bogus")
+        with pytest.raises(ValueError):
+            DataRangeSpec(5, 1)
+        with pytest.raises(TypeError):
+            DataRangeSpec.coerce(object())
+
+    def test_policy_resolution_precedence(self):
+        """Explicit policy > attached network policy > config dataType."""
+        from deeplearning4j_tpu.analysis.numerics import resolve_policy
+        conf = self._mlp()
+        assert resolve_policy(conf).compute == "float32"
+        conf.base.dtype = "bfloat16"
+        assert resolve_policy(conf).compute == "bfloat16"
+        net = MultiLayerNetwork(self._mlp())
+        net.setPrecisionPolicy("bf16")
+        assert resolve_policy(net.conf, model=net).compute == "bfloat16"
+        assert resolve_policy(net.conf, policy="fp16",
+                              model=net).compute == "float16"
+
+    def test_attached_policy_feeds_validate(self):
+        net = MultiLayerNetwork((_builder().list()
+                                 .layer(DenseLayer(nOut=1024,
+                                                   activation="softmax"))
+                                 .layer(OutputLayer(nOut=3))
+                                 .setInputType(InputType.feedForward(8))
+                                 .build()))
+        assert "DL4J-E302" not in net.validate().codes()
+        net.setPrecisionPolicy("bf16")
+        assert "DL4J-E302" in net.validate().codes()
+
+    def test_numerics_codes_documented_and_suppressible(self):
+        for code in ("DL4J-E301", "DL4J-E302", "DL4J-E303",
+                     "DL4J-W301", "DL4J-W302", "DL4J-W303"):
+            assert code in DIAGNOSTIC_CODES
+        conf = self._mlp(updater=Adam(1e-3))
+        assert "DL4J-W303" not in analyze(conf, data_range="0..255",
+                                          suppress=["W303"]).codes()
+
+    def test_graph_config_numerics(self):
+        g = (_graph_builder()
+             .addLayer("fc", DenseLayer(nOut=16, dataType="float16"), "in")
+             .addLayer("out", OutputLayer(nOut=2), "fc")
+             .setOutputs("out"))
+        assert "DL4J-E301" in analyze(g.build(), policy="bf16").codes()
+
+    def test_zoo_clean_under_default_and_bf16(self):
+        """CI gate: every zoo model lints clean for the numerics codes
+        under the default fp32 policy AND --policy bf16 — no
+        suppressions needed."""
+        from deeplearning4j_tpu.models.zoo import ZOO_MODELS
+        numerics = ("DL4J-E3", "DL4J-W30")
+        for name, cls in ZOO_MODELS.items():
+            conf = cls().conf_builder()
+            for pol in (None, "bf16"):
+                rep = analyze(conf, policy=pol)
+                bad = [d for d in rep if d.code.startswith(numerics)]
+                assert not bad, (name, pol,
+                                 [d.format() for d in bad])
+
+    def test_samediff_rejects_numerics_kwargs(self):
+        from deeplearning4j_tpu.autodiff.samediff import SameDiff
+        sd = SameDiff.create()
+        with pytest.raises(ValueError, match="numerics"):
+            analyze(sd, policy="bf16")
+
+
+class TestNumericsCli:
+    def test_cli_policy_flag_zoo_model(self, capsys):
+        from deeplearning4j_tpu.analysis.__main__ import main
+        assert main(["LeNet", "--policy", "bf16"]) == 0
+        assert main(["LeNet", "--policy",
+                     "compute=fp16,params=fp32,loss_scale=32768"]) == 0
+
+    def test_cli_fp16_without_scale_fails(self, capsys):
+        from deeplearning4j_tpu.analysis.__main__ import main
+        assert main(["LeNet", "--policy", "fp16"]) == 1
+        assert "DL4J-E303" in capsys.readouterr().out
+
+    def test_cli_bad_policy_and_range_are_usage_errors(self, capsys):
+        from deeplearning4j_tpu.analysis.__main__ import main
+        with pytest.raises(SystemExit) as ei:
+            main(["LeNet", "--policy", "float8"])
+        assert ei.value.code == 2
+        with pytest.raises(SystemExit) as ei:
+            main(["LeNet", "--data-range", "255"])
+        assert ei.value.code == 2
+
+    def test_cli_data_range_flags_w303(self, capsys):
+        from deeplearning4j_tpu.analysis.__main__ import main
+        import deeplearning4j_tpu.models.zoo as zoo_mod
+        # TinyYOLO declares raw pixel input in its docstring; any conv
+        # net without a leading BN works for the pin
+        rc = main(["TinyYOLO", "--data-range", "0..255"])
+        out = capsys.readouterr().out
+        assert rc == 1 and "DL4J-W303" in out
+
+
+class TestPureStaticNumerics:
+    def test_numerics_pass_runs_with_jax_blocked(self):
+        """analysis/numerics.py imports (and lints duck-typed configs)
+        with jax unimportable — the pure-static pin for this pass."""
+        code = (
+            "import sys, types\n"
+            "sys.modules['jax'] = None\n"
+            "sys.modules['jax.numpy'] = None\n"
+            "from deeplearning4j_tpu.analysis.numerics import (\n"
+            "    DataRangeSpec, lint_numerics)\n"
+            "from deeplearning4j_tpu.nn.precision import PrecisionPolicy\n"
+            "class DenseLayer:\n"
+            "    name = 'd'; nIn = 8; nOut = 16; activation = 'relu'\n"
+            "    dtype_override = None\n"
+            "class LossLayer:\n"
+            "    name = 'l'; nIn = 16; nOut = 16; activation = 'identity'\n"
+            "    loss_fn = 'mse'; dtype_override = None\n"
+            "    def compute_loss(self): pass\n"
+            "conf = types.SimpleNamespace(\n"
+            "    base=types.SimpleNamespace(updater=None, dtype='float32'),\n"
+            "    layers=[DenseLayer(), LossLayer()], input_type=None,\n"
+            "    preprocessors={})\n"
+            "pol = PrecisionPolicy('float16')\n"
+            "diags = lint_numerics(conf, policy=pol,\n"
+            "                      data_range=DataRangeSpec(0, 255))\n"
+            "codes = [d.code for d in diags]\n"
+            "assert 'DL4J-E303' in codes, codes\n"
+            "assert 'DL4J-W303' in codes, codes\n"
+            "print('PURE-STATIC-NUMERICS-OK')\n")
+        proc = subprocess.run([sys.executable, "-c", code], cwd=str(REPO),
+                              capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stderr
+        assert "PURE-STATIC-NUMERICS-OK" in proc.stdout
+
+
+# ------------------------------------ module-level concurrency (ISSUE 11)
+_MODULE_E201_BAD = """
+import threading
+
+RESULTS = []
+_counter = 0
+
+def worker():
+    global _counter
+    for _ in range(100):
+        _counter += 1
+        RESULTS.append(_counter)
+
+def run():
+    ts = [threading.Thread(target=worker) for _ in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    return _counter
+"""
+
+_MODULE_E201_CLEAN = """
+import threading
+
+RESULTS = []
+_counter = 0
+_LOCK = threading.Lock()
+
+def worker():
+    global _counter
+    for _ in range(100):
+        with _LOCK:
+            _counter += 1
+            RESULTS.append(_counter)
+
+def run():
+    ts = [threading.Thread(target=worker) for _ in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    with _LOCK:
+        return _counter
+"""
+
+_MODULE_CLOSURE_EXEMPT = """
+import threading
+
+def run():
+    results = []
+    def work():
+        results.append(1)
+    t = threading.Thread(target=work)
+    t.start()
+    t.join()
+    return results
+"""
+
+_MODULE_QUEUE_EXEMPT = """
+import threading
+import queue
+
+TASKS = queue.Queue()
+
+def worker():
+    while True:
+        item = TASKS.get()
+        if item is None:
+            return
+        TASKS.task_done()
+
+def run():
+    t = threading.Thread(target=worker)
+    t.start()
+    TASKS.put(1)
+    TASKS.put(None)
+    t.join()
+"""
+
+
+class TestModuleLevelConcurrency:
+    """E201/E202 inference over module-level functions sharing globals
+    via threading.Thread(target=fn) — the PR-8 carried follow-up."""
+
+    def test_bad_fixture_fires_e201_and_e202(self, tmp_path):
+        r = _lint_src(tmp_path, _MODULE_E201_BAD)
+        assert "DL4J-E202" in r.codes()       # _counter += 1
+        assert "DL4J-E201" in r.codes()       # RESULTS.append(...)
+        rmw = [d for d in r if d.code == "DL4J-E202"]
+        assert "module global" in rmw[0].message
+
+    def test_clean_bill_when_locked(self, tmp_path):
+        r = _lint_src(tmp_path, _MODULE_E201_CLEAN)
+        assert not [c for c in r.codes() if c.startswith("DL4J-E20")], \
+            r.format()
+
+    def test_local_closure_target_is_exempt(self, tmp_path):
+        r = _lint_src(tmp_path, _MODULE_CLOSURE_EXEMPT)
+        assert not [c for c in r.codes() if c.startswith("DL4J-E20")], \
+            r.format()
+
+    def test_threadsafe_module_primitive_is_exempt(self, tmp_path):
+        r = _lint_src(tmp_path, _MODULE_QUEUE_EXEMPT)
+        assert not [c for c in r.codes() if c.startswith("DL4J-E20")], \
+            r.format()
+
+    def test_reachability_via_plain_calls(self, tmp_path):
+        src = _MODULE_E201_BAD.replace(
+            "def run():",
+            "def entry():\n    worker()\n\ndef run():").replace(
+            "Thread(target=worker)", "Thread(target=entry)")
+        r = _lint_src(tmp_path, src)
+        assert "DL4J-E202" in r.codes()       # worker reached via entry()
+
+
+    def test_local_shadow_of_module_global_is_exempt(self, tmp_path):
+        """Review regression: a function-local that shadows a module
+        name (plain assignment makes it local for the whole function)
+        is not module state."""
+        src = (
+            "import threading\n"
+            "REGISTRY = {}\n"
+            "def worker():\n"
+            "    REGISTRY = {}\n"
+            "    REGISTRY['k'] = 1\n"
+            "    REGISTRY.update(a=2)\n"
+            "def run():\n"
+            "    t = threading.Thread(target=worker)\n"
+            "    t.start(); t.join()\n")
+        r = _lint_src(tmp_path, src)
+        assert not [c for c in r.codes() if c.startswith("DL4J-E20")], \
+            r.format()
+
+    def test_annotated_module_global_is_tracked(self, tmp_path):
+        """Review regression: `COUNTS: dict = {}` (AnnAssign) is module
+        state like a plain assignment."""
+        src = (
+            "import threading\n"
+            "COUNTS: dict = {}\n"
+            "def worker():\n"
+            "    COUNTS['k'] = 1\n"
+            "def run():\n"
+            "    t = threading.Thread(target=worker)\n"
+            "    t.start(); t.join()\n"
+            "    return COUNTS\n")
+        r = _lint_src(tmp_path, src)
+        assert "DL4J-E201" in r.codes(), r.format()
+
+    def test_noqa_suppresses_module_findings(self, tmp_path):
+        src = _MODULE_E201_BAD.replace(
+            "        _counter += 1",
+            "        _counter += 1  # dl4j: noqa=E202")
+        r = _lint_src(tmp_path, src)
+        assert "DL4J-E202" not in r.codes()
+
+
+# --------------------------------------------- W105 FLOP model (ISSUE 11)
+class TestFlopModelExtensions:
+    """Attention + conv-LSTM FLOP estimates (the PR-4 carried W105
+    follow-up), pinned against a BERT-shaped config analytically."""
+
+    def test_attention_flops_match_analytic_bert_block(self):
+        from deeplearning4j_tpu.analysis.distribution import (
+            _approx_flops, _propagate_types)
+        from deeplearning4j_tpu.nn.layers import (RnnOutputLayer,
+                                                  SelfAttentionLayer)
+        T, H, HEADS, HS = 128, 768, 12, 64
+        conf = (_builder().list()
+                .layer(SelfAttentionLayer(nOut=H, nHeads=HEADS,
+                                          headSize=HS))
+                .layer(RnnOutputLayer(nOut=2, lossFunction="mcxent"))
+                .setInputType(InputType.recurrent(H, T)).build())
+        types = _propagate_types(conf)
+        got = _approx_flops(conf.layers[0], types[0][0], types[0][1])
+        E = HEADS * HS
+        proj = 2 * (3 * H * E + E * H) * T     # Wq/Wk/Wv + Wo, per step
+        attn = 2 * 2 * T * T * E               # QK^T + attn@V
+        assert got == proj + attn, (got, proj + attn)
+        # the attention term is the part the old model undercounted
+        assert attn / (proj + attn) > 0.05
+
+    def test_conv_lstm_param_shapes_match_initialize(self):
+        from deeplearning4j_tpu.nn.layers import ConvLSTM2D
+        layer = ConvLSTM2D(nOut=32, kernelSize=(3, 3))
+        layer.nIn = 16
+        shapes = layer.param_shapes()
+        assert shapes == {"W": (128, 16, 3, 3), "RW": (128, 32, 3, 3),
+                          "b": (128,)}
+
+    def test_unknown_timesteps_degrade_to_zero_attention_term(self):
+        from deeplearning4j_tpu.analysis.distribution import _attention_flops
+        from deeplearning4j_tpu.nn.layers import SelfAttentionLayer
+        layer = SelfAttentionLayer(nOut=64, nHeads=4, headSize=16)
+        layer.nIn = 64
+        assert _attention_flops(layer, InputType.recurrent(64, -1)) == 0
+        assert _attention_flops(layer, None) == 0
+
+    def test_w105_counts_attention_stage(self):
+        """A transformer stage opposite a tiny dense stage now trips the
+        imbalance lint — before the attention term it read as nearly
+        empty."""
+        from deeplearning4j_tpu.nn.layers import (RnnOutputLayer,
+                                                  SelfAttentionLayer)
+        conf = (_builder().list()
+                .layer(SelfAttentionLayer(nOut=512, nHeads=8, headSize=64))
+                .layer(SelfAttentionLayer(nOut=512, nHeads=8, headSize=64))
+                .layer(SelfAttentionLayer(nOut=512, nHeads=8, headSize=64))
+                .layer(RnnOutputLayer(nOut=2, lossFunction="mcxent"))
+                .setInputType(InputType.recurrent(512, 256)).build())
+        report = analyze(conf, mesh={"data": 2, "pipe": 2},
+                         pipeline=2)
+        assert "DL4J-W105" in report.codes(), report.format()
+
+    def test_e303_scaled_gradient_overflow(self):
+        """Review regression: the compute-overflow clause tests the
+        LOSS-SCALED gradient estimate — a scale big enough to push raw
+        [0,255] gradients past fp16-max is flagged even with Sgd (no
+        squaring state), and a modest scale on normalized input is
+        not."""
+        from deeplearning4j_tpu.nn.layers import LossLayer
+        from deeplearning4j_tpu.nn.precision import PrecisionPolicy
+        conf = (_builder().list()
+                .layer(DenseLayer(nOut=32, activation="relu"))
+                .layer(LossLayer(lossFunction="mse"))
+                .setInputType(InputType.feedForward(16)).build())
+        pol = PrecisionPolicy("float16", loss_scale=2 ** 15)
+        assert "DL4J-E303" in analyze(conf, policy=pol,
+                                      data_range="0..255").codes()
+        assert "DL4J-E303" not in analyze(conf, policy=pol,
+                                          data_range="0..1").codes()
+
+    def test_parameter_shadow_is_exempt(self, tmp_path):
+        """Review regression: a parameter shadowing a module name binds
+        locally — mutating the argument is not a module-global write."""
+        src = (
+            "import threading\n"
+            "RESULTS = []\n"
+            "def worker(RESULTS):\n"
+            "    RESULTS.append(1)\n"
+            "def run():\n"
+            "    t = threading.Thread(target=worker, args=([],))\n"
+            "    t.start(); t.join()\n")
+        r = _lint_src(tmp_path, src)
+        assert not [c for c in r.codes() if c.startswith("DL4J-E20")], \
+            r.format()

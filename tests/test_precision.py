@@ -1,0 +1,359 @@
+"""PrecisionPolicy seam + nonfinite-provenance sanitizer (ISSUE 11):
+bf16/fp16 policy fits (loss parity, zero steady-state recompiles, loss
+scaling), per-layer dtype overrides, and first-nonfinite attribution
+(layer/op/step) through batches, FaultPlan layer poisons, megasteps,
+and graphs."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import profiler
+from deeplearning4j_tpu.analysis.churn import get_churn_detector
+from deeplearning4j_tpu.data.dataset import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.faults import FaultPlan
+from deeplearning4j_tpu.nn.config import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.precision import (PrecisionPolicy, normalize_dtype,
+                                             runtime_check)
+from deeplearning4j_tpu.profiler.modes import ProfilingMode
+from deeplearning4j_tpu.profiler.sanitizer import (NonfiniteAttributionError,
+                                                   track_value_ranges)
+from deeplearning4j_tpu.train.updaters import Adam
+
+
+def _mlp_conf(seed=7, hidden=16):
+    return (NeuralNetConfiguration.Builder().seed(seed).updater(Adam(0.01))
+            .weightInit("xavier").list()
+            .layer(DenseLayer(nOut=hidden, activation="relu"))
+            .layer(DenseLayer(nOut=hidden, activation="relu"))
+            .layer(OutputLayer(nOut=3, lossFunction="mcxent",
+                               activation="softmax"))
+            .setInputType(InputType.feedForward(8))
+            .build())
+
+
+def _graph_conf(seed=7):
+    return (NeuralNetConfiguration.Builder().seed(seed).updater(Adam(0.01))
+            .graphBuilder()
+            .addInputs("in")
+            .setInputTypes(InputType.feedForward(8))
+            .addLayer("fc", DenseLayer(nOut=16, activation="relu"), "in")
+            .addLayer("out", OutputLayer(nOut=3, lossFunction="mcxent",
+                                         activation="softmax"), "fc")
+            .setOutputs("out")
+            .build())
+
+
+def _data(n=32, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 8).astype(np.float32)
+    y = np.zeros((n, 3), np.float32)
+    y[np.arange(n), rng.randint(0, 3, n)] = 1.0
+    return x, y
+
+
+@pytest.fixture(autouse=True)
+def _mode_reset():
+    yield
+    profiler.set_profiling_mode(None)
+    track_value_ranges(False)
+
+
+# ----------------------------------------------------------- the policy
+class TestPrecisionPolicy:
+    def test_coerce_and_aliases(self):
+        p = PrecisionPolicy.coerce("bf16")
+        assert p.compute == "bfloat16" and p.params == "float32"
+        assert PrecisionPolicy.coerce(None) is None
+        assert PrecisionPolicy.coerce(p) is p
+        assert normalize_dtype("FP16") == "float16"
+        with pytest.raises(ValueError):
+            normalize_dtype("float8")
+        with pytest.raises(TypeError):
+            PrecisionPolicy.coerce(42)
+
+    def test_signature_and_eq(self):
+        a = PrecisionPolicy("bfloat16")
+        b = PrecisionPolicy("bf16")
+        assert a == b and a.signature() == b.signature()
+        assert a != PrecisionPolicy("bfloat16", loss_scale=8.0)
+
+    def test_config_roundtrip(self):
+        p = PrecisionPolicy("float16", loss_scale=2 ** 15)
+        assert PrecisionPolicy.from_config(p.to_config()) == p
+
+    def test_runtime_rejects_low_precision_masters(self):
+        with pytest.raises(ValueError, match="E301"):
+            runtime_check(PrecisionPolicy("bfloat16", params="bfloat16"))
+        net = MultiLayerNetwork(_mlp_conf()).init()
+        with pytest.raises(ValueError, match="master params"):
+            net.setPrecisionPolicy(PrecisionPolicy("float16",
+                                                   params="float16"))
+
+    def test_invalid_loss_scale(self):
+        with pytest.raises(ValueError, match="positive"):
+            PrecisionPolicy("float16", loss_scale=0)
+
+
+class TestPolicyFit:
+    def test_bf16_loss_parity_vs_fp32(self):
+        x, y = _data()
+        net32 = MultiLayerNetwork(_mlp_conf()).init()
+        net32.fit(x, y, epochs=5)
+        netbf = MultiLayerNetwork(_mlp_conf()).init()
+        netbf.fit(x, y, epochs=5, precision="bf16")
+        l32, lbf = net32.score(), netbf.score()
+        assert np.isfinite(lbf)
+        assert abs(l32 - lbf) / abs(l32) < 0.05, (l32, lbf)
+        # master params stay fp32 under the policy
+        assert str(netbf._params[0]["W"].dtype) == "float32"
+
+    def test_fp16_with_loss_scale_tracks_fp32(self):
+        x, y = _data()
+        net32 = MultiLayerNetwork(_mlp_conf()).init()
+        net32.fit(x, y, epochs=5)
+        net16 = MultiLayerNetwork(_mlp_conf()).init()
+        net16.fit(x, y, epochs=5,
+                  precision=PrecisionPolicy("float16", loss_scale=1024.0))
+        # the reported loss is UNSCALED (listeners see the true loss)
+        assert abs(net32.score() - net16.score()) / abs(net32.score()) < 0.1
+
+    def test_loss_scale_is_numerically_neutral_in_fp32(self):
+        """Scale-then-unscale must be exact in fp32: same updates."""
+        x, y = _data()
+        a = MultiLayerNetwork(_mlp_conf()).init()
+        a.fit(x, y, epochs=3)
+        b = MultiLayerNetwork(_mlp_conf()).init()
+        b.fit(x, y, epochs=3,
+              precision=PrecisionPolicy("float32", loss_scale=4.0))
+        np.testing.assert_allclose(np.asarray(a.params()),
+                                   np.asarray(b.params()), rtol=1e-5)
+
+    def test_zero_steady_state_recompiles(self):
+        det = get_churn_detector()
+        x, y = _data()
+        it = ListDataSetIterator(DataSet(x, y), batch_size=8)
+        net = MultiLayerNetwork(_mlp_conf()).init()
+        net.setPrecisionPolicy("bf16")
+        net.fit(it, epochs=3)
+        # ONE jit signature at the fit site across 4 batches x 3 epochs:
+        # the policy keys the cache, it does not churn it
+        assert det.signature_count("MultiLayerNetwork.fit", owner=net) == 1
+        assert not det.diagnostics_for(net)
+        # re-attaching an EQUAL policy keeps the compiled cache
+        cache = dict(net._train_step_cache)
+        net.setPrecisionPolicy(PrecisionPolicy("bfloat16"))
+        assert net._train_step_cache == cache
+        # a DIFFERENT policy busts it (one clean recompile)
+        net.setPrecisionPolicy(None)
+        assert net._train_step_cache == {}
+
+    def test_per_layer_fp32_island_runs(self):
+        x, y = _data()
+        conf = (NeuralNetConfiguration.Builder().seed(7).updater(Adam(0.01))
+                .list()
+                .layer(DenseLayer(nOut=16, activation="relu"))
+                .layer(DenseLayer(nOut=16, activation="relu",
+                                  dataType="float32"))
+                .layer(OutputLayer(nOut=3, lossFunction="mcxent",
+                                   activation="softmax"))
+                .setInputType(InputType.feedForward(8)).build())
+        net = MultiLayerNetwork(conf).init()
+        net.fit(x, y, epochs=2, precision="bf16")
+        assert np.isfinite(net.score())
+
+    def test_graph_bf16_parity(self):
+        x, y = _data()
+        g32 = ComputationGraph(_graph_conf()).init()
+        g32.fit(x, y, epochs=5)
+        gbf = ComputationGraph(_graph_conf()).init()
+        gbf.fit(x, y, epochs=5, precision="bf16")
+        assert abs(g32.score() - gbf.score()) / abs(g32.score()) < 0.05
+
+    def test_megastep_policy_matches_single_step(self):
+        x, y = _data()
+        a = MultiLayerNetwork(_mlp_conf()).init()
+        a.fit(ListDataSetIterator(DataSet(x, y), batch_size=8), epochs=2,
+              precision="bf16")
+        b = MultiLayerNetwork(_mlp_conf()).init()
+        b.fit(ListDataSetIterator(DataSet(x, y), batch_size=8), epochs=2,
+              steps_per_dispatch=2, prefetch=0, precision="bf16")
+        np.testing.assert_allclose(np.asarray(a.params(), np.float32),
+                                   np.asarray(b.params(), np.float32),
+                                   rtol=2e-2, atol=1e-3)
+
+    def test_layer_datatype_config_roundtrip(self):
+        from deeplearning4j_tpu.nn.config import MultiLayerConfiguration
+        conf = (NeuralNetConfiguration.Builder().list()
+                .layer(DenseLayer(nOut=16, dataType="fp32"))
+                .layer(OutputLayer(nOut=2))
+                .setInputType(InputType.feedForward(4)).build())
+        assert conf.layers[0].dtype_override == "float32"
+        back = MultiLayerConfiguration.from_json(conf.to_json())
+        assert back.layers[0].dtype_override == "float32"
+
+
+# ----------------------------------------------------- provenance pins
+class TestNonfiniteProvenance:
+    def test_nan_batch_attributed_to_input(self):
+        x, y = _data()
+        x[3, 1] = np.nan
+        net = MultiLayerNetwork(_mlp_conf()).init()
+        profiler.set_profiling_mode(ProfilingMode.NAN_PANIC)
+        with pytest.raises(NonfiniteAttributionError,
+                           match="NAN_PANIC") as ei:
+            net.fit(x, y, epochs=1)
+        assert ei.value.layer == "<input>" and ei.value.op == "batch"
+        assert ei.value.step == 1
+
+    def test_faultplan_layer_poison_attributed_to_exact_layer(self):
+        """THE pin: NaN injected at layer k via FaultPlan is attributed
+        to layer k / op params / the planned step — not to the loss."""
+        x, y = _data()
+        net = MultiLayerNetwork(_mlp_conf()).init()
+        profiler.set_profiling_mode(ProfilingMode.NAN_PANIC)
+        with pytest.raises(NonfiniteAttributionError) as ei:
+            net.fit(x, y, epochs=3,
+                    faults=FaultPlan(nan_layer_params_at={2: 1}))
+        assert ei.value.layer == "1:DenseLayer", ei.value.layer
+        assert ei.value.op == "params"
+        assert ei.value.step == 2
+        # and the info metric names the same site
+        g = profiler.get_registry().get("dl4j_nonfinite_first_site")
+        children = g.children()
+        assert ("MultiLayerNetwork", "1:DenseLayer", "params") in children
+        assert children[("MultiLayerNetwork", "1:DenseLayer",
+                         "params")].value == 2
+
+    def test_megastep_attribution_names_mid_dispatch_step(self):
+        x, y = _data()
+        x[17, 2] = np.nan                      # 3rd batch of 8 -> step 3
+        net = MultiLayerNetwork(_mlp_conf()).init()
+        it = ListDataSetIterator(DataSet(x, y), batch_size=8)
+        profiler.set_profiling_mode(ProfilingMode.NAN_PANIC)
+        with pytest.raises(NonfiniteAttributionError) as ei:
+            net.fit(it, epochs=1, steps_per_dispatch=2, prefetch=0)
+        assert ei.value.step == 3
+        assert ei.value.layer == "<input>"
+
+    def test_graph_poison_attributed_to_named_layer(self):
+        x, y = _data()
+        g = ComputationGraph(_graph_conf()).init()
+        profiler.set_profiling_mode(ProfilingMode.NAN_PANIC)
+        with pytest.raises(NonfiniteAttributionError) as ei:
+            g.fit(x, y, epochs=3,
+                  faults=FaultPlan(nan_layer_params_at={2: "fc"}))
+        assert ei.value.layer == "fc" and ei.value.op == "params"
+        assert ei.value.step == 2
+
+    def test_attribution_exact_beyond_snapshot_interval(self):
+        """The amortized snapshot window (default: copy every 8
+        dispatches) still attributes exactly: a poisoned batch at step
+        12 replays through the rolled-forward snapshot."""
+        x, y = _data(n=8)
+        net = MultiLayerNetwork(_mlp_conf()).init()
+        profiler.set_profiling_mode(ProfilingMode.NAN_PANIC)
+        batches = [DataSet(x, y) for _ in range(11)]
+        xb = x.copy()
+        xb[0, 0] = np.nan
+        batches.append(DataSet(xb, y))
+        with pytest.raises(NonfiniteAttributionError) as ei:
+            net.fit(batches, epochs=1)
+        assert ei.value.step == 12
+        assert ei.value.layer == "<input>" and ei.value.op == "batch"
+
+    def test_off_mode_pays_nothing_and_raises_nothing(self):
+        x, y = _data()
+        x[0, 0] = np.nan
+        net = MultiLayerNetwork(_mlp_conf()).init()
+        before = profiler.get_registry().get(
+            "dl4j_nonfinite_panics_total").value
+        net.fit(x, y, epochs=1)                # no panic mode: no raise
+        assert profiler.get_registry().get(
+            "dl4j_nonfinite_panics_total").value == before
+
+    def test_inf_panic_mode_attributes_inf(self):
+        """INF_PANIC keeps its legacy inf-only loss gate — an overflowed
+        MSE loss (1e30^2 -> inf in fp32) is caught and attributed."""
+        from deeplearning4j_tpu.nn.layers import LossLayer
+        conf = (NeuralNetConfiguration.Builder().seed(7).updater(Adam(0.01))
+                .list()
+                .layer(DenseLayer(nOut=8, activation="identity"))
+                .layer(LossLayer(lossFunction="mse"))
+                .setInputType(InputType.feedForward(8)).build())
+        x = np.full((4, 8), 1e30, np.float32)
+        y = np.zeros((4, 8), np.float32)
+        net = MultiLayerNetwork(conf).init()
+        profiler.set_profiling_mode(ProfilingMode.INF_PANIC)
+        with pytest.raises(NonfiniteAttributionError, match="INF_PANIC"):
+            net.fit(x, y, epochs=1)
+
+    def test_absmax_tracking_records_ranges_and_proximity(self):
+        x, y = _data()
+        net = MultiLayerNetwork(_mlp_conf()).init()
+        net.setPrecisionPolicy("bf16")
+        profiler.set_profiling_mode(ProfilingMode.NAN_PANIC)
+        track_value_ranges(True, every=1)
+        net.fit(x, y, epochs=2)
+        hist = profiler.get_registry().get("dl4j_tensor_absmax")
+        layers = {k[1] for k in hist.children()}
+        assert any(l.startswith("0:") for l in layers), layers
+        prox = profiler.get_registry().get("dl4j_overflow_proximity")
+        assert 0.0 < prox.value < 1.0           # bf16 run, sane activations
+    def test_nan_panic_keeps_nan_only_loss_gate(self):
+        """Review regression: NAN_PANIC's loss gate stays NaN-only
+        (legacy panic_check semantics) — an inf loss passes under
+        NAN_PANIC and raises under INF_PANIC."""
+        from deeplearning4j_tpu.nn.layers import LossLayer
+        conf = (NeuralNetConfiguration.Builder().seed(7).updater(Adam(0.01))
+                .list()
+                .layer(DenseLayer(nOut=8, activation="identity"))
+                .layer(LossLayer(lossFunction="mse"))
+                .setInputType(InputType.feedForward(8)).build())
+        x = np.full((4, 8), 1e30, np.float32)   # mse -> inf, not NaN
+        y = np.zeros((4, 8), np.float32)
+        profiler.set_profiling_mode(ProfilingMode.NAN_PANIC)
+        MultiLayerNetwork(conf).init().fit(x, y, epochs=1)   # no raise
+
+    def test_nn_package_lazy_attributes(self):
+        """Review regression: the PEP-562 nn/__init__ still exposes the
+        submodule attributes the eager imports used to set."""
+        import deeplearning4j_tpu.nn as nn_pkg
+        assert nn_pkg.multilayer.MultiLayerNetwork is MultiLayerNetwork
+        assert hasattr(nn_pkg.graph, "ComputationGraph")
+        assert hasattr(nn_pkg.layers, "DenseLayer")
+        assert nn_pkg.PrecisionPolicy is PrecisionPolicy
+
+    def test_tbptt_fit_warns_policy_ignored(self):
+        from deeplearning4j_tpu.nn.layers import LSTM, RnnOutputLayer
+        conf = (NeuralNetConfiguration.Builder().seed(7).updater(Adam(0.01))
+                .list()
+                .layer(LSTM(nOut=8))
+                .layer(RnnOutputLayer(nOut=2, lossFunction="mcxent"))
+                .setInputType(InputType.recurrent(4, 8))
+                .backpropType("tbptt", tbpttLength=4).build())
+        rng = np.random.RandomState(0)
+        x = rng.randn(4, 4, 8).astype(np.float32)
+        y = np.zeros((4, 2, 8), np.float32)
+        y[:, 0, :] = 1.0
+        net = MultiLayerNetwork(conf).init()
+        with pytest.warns(UserWarning, match="TBPTT.*PrecisionPolicy"):
+            net.fit(x, y, epochs=1, precision="bf16")
+
+    def test_mid_dispatch_poison_fires_at_next_boundary(self):
+        """Review regression: a poison planned for a mid-megastep step
+        lands at the first dispatch boundary at or after it instead of
+        silently never firing."""
+        x, y = _data()
+        net = MultiLayerNetwork(_mlp_conf()).init()
+        it = ListDataSetIterator(DataSet(x, y), batch_size=8)
+        profiler.set_profiling_mode(ProfilingMode.NAN_PANIC)
+        with pytest.raises(NonfiniteAttributionError) as ei:
+            # K=2: boundaries at steps 1, 3, 5... — a step-2 plan fires
+            # at the step-3 boundary
+            net.fit(it, epochs=2, steps_per_dispatch=2, prefetch=0,
+                    faults=FaultPlan(nan_layer_params_at={2: 1}))
+        assert ei.value.layer == "1:DenseLayer" and ei.value.op == "params"
+        assert ei.value.step == 3
