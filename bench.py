@@ -29,7 +29,10 @@ Run: ``python bench.py`` (``--quick`` = small configs for CI;
 ``--skip-resnet`` / ``--skip-gemm`` / ``--skip-extra-cnn`` /
 ``--skip-scaling`` to bisect; ``--reps N`` to change the draw count;
 ``--serving`` folds the ``benchmarks/probe_serving.py`` traffic-mix
-probe — throughput vs p99 + shed rates — into ``detail.serving``).
+probe — throughput vs p99 + shed rates, plus the ISSUE-12 ingress
+section: wire-path p50/p99 + shed rate vs in-process submit at the
+same load, per-batch D2H bytes full-logits vs results-only (asserted),
+and the W111 registry-roll lint check — into ``detail.serving``).
 """
 
 import json

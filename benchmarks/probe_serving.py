@@ -17,6 +17,21 @@ test):
 Also reports ``recompiles_after_warmup`` (the zero-steady-state-compile
 pin, measured through the W201 churn detector) and the AOT warmup cost.
 
+ISSUE 12 ingress probe (``--skip-ingress`` to disable):
+
+- **Wire path vs in-process** — the steady mix replayed over REAL
+  sockets through :class:`HttpIngress` at the same offered load:
+  wire-side p50/p99 (the ingress latency histogram: body received to
+  response written) and shed rate next to the in-process numbers, so
+  the HTTP front door's overhead is a measured quantity.
+- **Results-only D2H** — per-dispatch ``dl4j_serving_d2h_bytes_total``
+  deltas for full-logits vs ``head="argmax"`` serving; the probe FAILS
+  unless the results-only copy is measurably smaller (the acceptance
+  assert).
+- **W111 lint** — a registry roll planned without warmed buckets for
+  the new version must produce ``DL4J-W111``; the probe FAILS if the
+  lint stays silent.
+
 Prints ONE JSON line::
 
   {"probe": "serving", "n_devices": ..., "batch_limit": ...,
@@ -27,6 +42,12 @@ Prints ONE JSON line::
                         "p50_ms": ..., "p99_ms": ...,
                         "shed_rate": ..., "shed_overload": ...,
                         "shed_deadline": ..., "completed": ...}, ...},
+   "ingress": {"wire_p50_ms": ..., "wire_p99_ms": ...,
+               "wire_shed_rate": ..., "inproc_p50_ms": ...,
+               "inproc_p99_ms": ...},
+   "d2h": {"full_logits_bytes_per_batch": ...,
+           "results_only_bytes_per_batch": ..., "cut_ratio": ...},
+   "w111_lint": "fires",
    "recompiles_after_warmup": 0}
 
 Run: python benchmarks/probe_serving.py [--n N] [--batch-limit B]
@@ -107,12 +128,86 @@ def run_mix(server, load, mix_name):
     }
 
 
+def probe_ingress(server, req_capacity, n):
+    """The steady mix over REAL sockets: wire p50/p99 (ingress-side
+    histogram) + shed rate at the same offered load as the in-process
+    steady mix."""
+    from deeplearning4j_tpu import profiler as prof
+    from deeplearning4j_tpu.faults import ServingLoad
+    from deeplearning4j_tpu.serving import HttpIngress
+    hist = prof.get_registry().get("dl4j_ingress_latency_seconds")
+    load = ServingLoad.seeded(4, mix="steady", n=n,
+                              rps=0.6 * req_capacity, max_rows=2)
+    with HttpIngress(server, port=0) as ing:
+        results = load.replay_http(ing.url, "default", (NIN,))
+    codes = [o[0] for _, o in results if isinstance(o, tuple)]
+    transport_errors = sum(1 for _, o in results if isinstance(o, Exception))
+    ok = codes.count(200)
+    # server-stamped latencies from the response payloads: the same
+    # admission->resolution stamp the in-process mixes report, so the
+    # two columns compare apples to apples; the ingress histogram adds
+    # the wire-side (recv -> response written) view on top
+    stamped = sorted(o[1]["latency_ms"] for _, o in results
+                     if isinstance(o, tuple) and o[0] == 200)
+    return {
+        "n": len(results),
+        "completed": ok,
+        "wire_shed_rate": round(
+            (len(results) - ok) / max(len(results), 1), 4),
+        "transport_errors": transport_errors,
+        "wire_p50_ms": round(pct(stamped, 0.5), 3) if stamped else None,
+        "wire_p99_ms": round(pct(stamped, 0.99), 3) if stamped else None,
+        "http_p50_ms": round(hist.quantile(0.5) * 1e3, 3)
+        if hist.count else None,
+        "http_p99_ms": round(hist.quantile(0.99) * 1e3, 3)
+        if hist.count else None,
+    }
+
+
+def probe_d2h(net, batch_limit, n_batches=10):
+    """Per-dispatch D2H bytes, full logits vs results-only argmax —
+    returns (stats, ok)."""
+    from deeplearning4j_tpu import profiler as prof
+    from deeplearning4j_tpu.serving import ModelServer
+    counter = prof.get_registry().get("dl4j_serving_d2h_bytes_total")
+    per_batch = {}
+    for label, head in (("full_logits", None), ("results_only", "argmax")):
+        sv = ModelServer(net, batch_limit=batch_limit, coalesce_ms=0.5,
+                         head=head)
+        sv.warmup([(NIN,)])
+        before = counter.value
+        for i in range(n_batches):
+            sv.output(np.random.RandomState(i).randn(
+                batch_limit, NIN).astype(np.float32), timeout=60)
+        per_batch[label] = (counter.value - before) / n_batches
+        sv.close()
+    full, results = per_batch["full_logits"], per_batch["results_only"]
+    return ({"full_logits_bytes_per_batch": full,
+             "results_only_bytes_per_batch": results,
+             "cut_ratio": round(results / full, 4) if full else None},
+            0 < results < full)
+
+
+def probe_w111(net):
+    """A roll planned onto an unwarmed version must lint DL4J-W111."""
+    import warnings
+    from deeplearning4j_tpu.serving import ModelRegistry
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with ModelRegistry(batch_limit=8, coalesce_ms=0.5) as reg:
+            reg.load("probe", net, shapes=[(NIN,)])
+            reg.load("probe", build(), warm=False)
+            codes = reg.validate_roll("probe").codes()
+    return "fires" if "DL4J-W111" in codes else f"SILENT ({codes})"
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=400,
                     help="requests per traffic mix")
     ap.add_argument("--batch-limit", type=int, default=32)
     ap.add_argument("--max-queue", type=int, default=64)
+    ap.add_argument("--skip-ingress", action="store_true")
     args = ap.parse_args()
 
     import jax
@@ -165,10 +260,7 @@ def main():
         tight_deadline=service_ms / 4e3, loose_deadline=10.0,
         deadline_frac=0.5), "deadline")
 
-    recompiles = server.recompiles_after_warmup()
-    server.close()
-
-    print(json.dumps({
+    out = {
         "probe": "serving",
         "n_devices": len(jax.devices()),
         "batch_limit": args.batch_limit,
@@ -179,11 +271,37 @@ def main():
                         "p99_ms": round(pct(unc, 0.99) * 1e3, 3)},
         "capacity_rps": round(capacity_rps, 1),
         "mixes": mixes,
-        "recompiles_after_warmup": recompiles,
-    }))
+    }
+    d2h_ok = True
+    if not args.skip_ingress:
+        ingress = probe_ingress(server, req_capacity, max(args.n // 2, 50))
+        ingress["inproc_p50_ms"] = mixes["steady"]["p50_ms"]
+        ingress["inproc_p99_ms"] = mixes["steady"]["p99_ms"]
+        ingress["inproc_shed_rate"] = mixes["steady"]["shed_rate"]
+        out["ingress"] = ingress
+        out["d2h"], d2h_ok = probe_d2h(net, args.batch_limit)
+        out["w111_lint"] = probe_w111(net)
+
+    recompiles = server.recompiles_after_warmup()
+    out["recompiles_after_warmup"] = recompiles
+    server.close()
+
+    print(json.dumps(out))
+    failed = False
     if recompiles != 0:
         print(f"# FAIL: {recompiles} steady-state recompile(s) after "
               "warmup", file=sys.stderr)
+        failed = True
+    if not args.skip_ingress:
+        if not d2h_ok:
+            print(f"# FAIL: results-only D2H did not shrink the "
+                  f"per-batch copy: {out['d2h']}", file=sys.stderr)
+            failed = True
+        if out["w111_lint"] != "fires":
+            print(f"# FAIL: W111 registry-roll lint stayed silent: "
+                  f"{out['w111_lint']}", file=sys.stderr)
+            failed = True
+    if failed:
         sys.exit(1)
 
 

@@ -103,6 +103,10 @@ DIAGNOSTIC_CODES = {
     "DL4J-W110": "serving bucket ladder: duplicate buckets or more buckets "
                  "than the threshold — each bucket x input shape is one "
                  "compiled program (warmup time, executable-cache HBM)",
+    "DL4J-W111": "registry roll without warmed buckets: the hot-swap "
+                 "target version was never warmed (or misses shapes the "
+                 "active version serves warm), so post-roll traffic "
+                 "XLA-compiles under live load",
     # E2xx/W21x concurrency lints (analysis/concurrency.py): AST-level
     # thread-safety analysis of the framework's own (or user) source.
     "DL4J-E201": "unguarded cross-thread mutation: an attribute (or a "

@@ -17,11 +17,17 @@ same contract as the rest of ``analysis``):
   buckets than :data:`BUCKET_COUNT_THRESHOLD`) — every bucket x shape
   is one compiled program held in the executable cache, and warmup
   time scales with the product.
+- ``DL4J-W111``: a registry roll planned onto a version without warmed
+  buckets — the first post-roll request at an unwarmed (bucket, shape)
+  XLA-compiles under live traffic, exactly the cold-start the zero-drop
+  hot-swap exists to avoid.
 
 Entry points: :func:`lint_serving` (what ``ModelServer.validate()`` /
 ``warmup(strict=True)`` call) — accepts a network, or a bare
 configuration, plus the bucket ladder and an optional mesh / HBM
-budget.
+budget — and :func:`lint_registry_roll` (what
+``ModelRegistry.validate_roll()`` / ``roll(strict=True)`` call),
+duck-typed over server objects so it stays jax-free.
 """
 
 from __future__ import annotations
@@ -148,3 +154,43 @@ def lint_serving(model_or_conf, buckets: Sequence[int], mesh=None,
                          "the model over a model axis, or raise hbm_gb"))
 
     return ValidationReport(diags, subject="serving config")
+
+
+def lint_registry_roll(model_name: str, target, active=None
+                       ) -> ValidationReport:
+    """Pre-roll lint for a multi-model registry version swap: ``target``
+    (and optionally the currently ``active`` version) are server-like
+    objects exposing ``_warmed`` / ``_warm_shapes`` / ``buckets()`` —
+    duck-typed, so the check needs no jax and runs before any traffic
+    moves.
+
+    - ``DL4J-W111`` when the target was never warmed at all, or when
+      shapes the active version serves warm are missing from the
+      target's warmed set (those requests compile under live load right
+      after the roll).
+    """
+    diags: List[Diagnostic] = []
+    loc = f"registry roll -> {model_name}"
+    warmed = bool(getattr(target, "_warmed", False))
+    t_shapes = [tuple(s) for s in getattr(target, "_warm_shapes", [])]
+    if not warmed:
+        diags.append(Diagnostic(
+            "DL4J-W111", Severity.WARNING, loc,
+            "roll planned onto a version with NO warmed buckets — every "
+            "post-roll request XLA-compiles under live traffic (the "
+            "cold-start a zero-drop hot-swap must not pay)",
+            fix_hint="warmup([...]) the new version on the serving mesh "
+                     "BEFORE roll() (ModelRegistry.load does this when "
+                     "shapes are known)"))
+    elif active is not None:
+        a_shapes = [tuple(s) for s in getattr(active, "_warm_shapes", [])]
+        missing = [s for s in a_shapes if s not in t_shapes]
+        if missing:
+            diags.append(Diagnostic(
+                "DL4J-W111", Severity.WARNING, loc,
+                f"active version serves warmed shapes {missing} the roll "
+                "target never compiled — those requests hit cold XLA "
+                "compiles (or shape rejection) right after the swap",
+                fix_hint="warm the target with the active version's full "
+                         "shape set before rolling"))
+    return ValidationReport(diags, subject="registry roll")

@@ -651,6 +651,14 @@ class StagedImageIterator(DataSetIterator):
             else:
                 self._emit_next = start_batch // k
                 self._start_j = start_batch - self._emit_next * k
+            # exact slot-resume: a mid-group seek() decodes ONLY the
+            # sub-batches at or after the resume offset — the already-
+            # consumed head of the group is never re-decoded (its slot
+            # rows stay stale and are never emitted: _cur_j starts at
+            # _start_j)
+            self._skip_j = ({self._emit_next: self._start_j}
+                            if self._start_j else {})
+            self._task_counts = {}      # mega_id -> tasks actually queued
             self._next_assign = self._emit_next
             self._cur = None                # current copied megabatch
             self._cur_labels = None
@@ -673,7 +681,9 @@ class StagedImageIterator(DataSetIterator):
             while self._free_slots and self._next_assign < self._n_megas:
                 mega_id = self._next_assign
                 slot = self._free_slots.pop()
-                for j in range(self._mega_batches(mega_id)):
+                n_tasks = 0
+                for j in range(self._skip_j.get(mega_id, 0),
+                               self._mega_batches(mega_id)):
                     batch = mega_id * k + j
                     idxs = self._order[batch * b:(batch + 1) * b]
                     task_seed = (self.seed + 104729 * self._epoch + batch) \
@@ -681,6 +691,8 @@ class StagedImageIterator(DataSetIterator):
                     self._task_q.put((mega_id, j, slot, idxs.tolist(),
                                       task_seed))
                     self._pending += 1
+                    n_tasks += 1
+                self._task_counts[mega_id] = n_tasks
                 self._next_assign += 1
         if _prof.instrumentation_active():
             try:
@@ -702,9 +714,10 @@ class StagedImageIterator(DataSetIterator):
         with self._lifecycle:
             if self._failed is not None:
                 raise self._failed      # see _get_ready_msg's error latch
+            expected = self._task_counts.get(mega_id,
+                                             self._mega_batches(mega_id))
             while mega_id not in self._completed \
-                    or self._done_counts.get(mega_id, 0) \
-                    < self._mega_batches(mega_id):
+                    or self._done_counts.get(mega_id, 0) < expected:
                 _, mid, k, slot, decode_s, idle_s = self._get_ready_msg()
                 self._pending -= 1
                 self._completed[mid] = slot
@@ -714,6 +727,7 @@ class StagedImageIterator(DataSetIterator):
                     if idle_s > 0:
                         _STALL_SECONDS.labels(stage="decode_idle").inc(idle_s)
             self._done_counts.pop(mega_id)
+            self._task_counts.pop(mega_id, None)
             return self._completed.pop(mega_id)
 
     # -------------------------------------------------------------- consume
@@ -801,7 +815,10 @@ class StagedImageIterator(DataSetIterator):
                     and self._emitted < self._n_full
                     and self._emit_next < self._n_megas
                     and self._mega_batches(self._emit_next) == k
-                    and self._start_j == 0):
+                    and self._start_j == 0
+                    # a seek-resumed group decoded only its tail: rows
+                    # below the skip offset are stale — per-batch path
+                    and self._skip_j.get(self._emit_next, 0) == 0):
                 return None
             self._load_group()
             # the preconditions above guarantee a full, unoffset group
@@ -846,7 +863,10 @@ class StagedImageIterator(DataSetIterator):
         """Restore a :meth:`cursor` position: drain in-flight decode,
         rebuild the epoch order for the stored epoch (``reset()`` drew it
         from ``seed + epoch`` THEN incremented, so epoch e's order came
-        from ``seed + e - 1``), and resume task assignment mid-epoch."""
+        from ``seed + e - 1``), and resume task assignment mid-epoch.
+        A mid-group position is an EXACT slot resume: only the group's
+        remaining sub-batches (j >= the resume offset) are decoded —
+        the already-consumed head is never re-decoded."""
         epoch = int(cursor["epoch"])
         with self._lifecycle:
             if self._started and self._dead_workers():

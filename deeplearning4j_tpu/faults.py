@@ -54,6 +54,22 @@ Serving fault kinds (ISSUE 7 — the model server's degradation paths):
   (steady / burst / deadline-storm mixes) shared by the chaos tests and
   ``benchmarks/probe_serving.py``.
 
+Wire-level chaos (ISSUE 12 — the HTTP ingress front door):
+
+- **Slow clients** (``slow_frac``) — a seeded fraction of
+  :meth:`ServingLoad.replay_http` requests dribble their body over
+  ``slow_client_seconds`` instead of one send: a stalled upload must
+  hold one handler thread, never the accept loop or another client's
+  request.
+- **Mid-flight disconnects** (``disconnect_frac``) — a seeded fraction
+  send the request then close the socket without reading the response:
+  the server still serves the work, bills
+  ``dl4j_ingress_disconnects_total``, and later clients are unaffected.
+- **Swap under load** — :class:`SwapSchedule` triggers seeded
+  ``ModelRegistry.roll()``/``rollback()`` calls at planned offsets
+  while a replay is in flight: the zero-drop hot-swap pin (every
+  request resolves exactly once against exactly one version).
+
 Race kinds (ISSUE 8 — the concurrency analyzer's dynamic layer,
 ``pytest -m races``):
 
@@ -468,18 +484,29 @@ class _FaultInjectionIterator(DataSetIterator):
 # ------------------------------------------------------------ serving load
 class RequestSpec:
     """One planned serving request: ``at`` seconds after replay start,
-    ``rows`` feature rows, optional ``deadline`` seconds."""
+    ``rows`` feature rows, optional ``deadline`` seconds. Wire-side
+    behaviors (``replay_http`` only): ``slow_s`` dribbles the body over
+    that many seconds, ``disconnect`` closes the socket without reading
+    the response."""
 
-    __slots__ = ("at", "rows", "deadline")
+    __slots__ = ("at", "rows", "deadline", "slow_s", "disconnect")
 
-    def __init__(self, at: float, rows: int, deadline: Optional[float]):
+    def __init__(self, at: float, rows: int, deadline: Optional[float],
+                 slow_s: float = 0.0, disconnect: bool = False):
         self.at = float(at)
         self.rows = int(rows)
         self.deadline = deadline
+        self.slow_s = float(slow_s)
+        self.disconnect = bool(disconnect)
 
     def __repr__(self):
+        extra = ""
+        if self.slow_s:
+            extra += f", slow_s={self.slow_s:g}"
+        if self.disconnect:
+            extra += ", disconnect=True"
         return (f"RequestSpec(at={self.at:.4f}, rows={self.rows}, "
-                f"deadline={self.deadline})")
+                f"deadline={self.deadline}{extra})")
 
 
 class ServingLoad:
@@ -521,7 +548,13 @@ class ServingLoad:
                rps: float = 500.0, max_rows: int = 4,
                n_bursts: int = 4, burst_size: int = 32,
                tight_deadline: float = 0.005, loose_deadline: float = 2.0,
-               deadline_frac: float = 0.5) -> "ServingLoad":
+               deadline_frac: float = 0.5, slow_frac: float = 0.0,
+               slow_client_seconds: float = 0.05,
+               disconnect_frac: float = 0.0) -> "ServingLoad":
+        """``slow_frac``/``disconnect_frac`` mark a seeded fraction of
+        the schedule with the wire-level client behaviors
+        :meth:`replay_http` executes (the in-process :meth:`replay`
+        ignores them — there is no wire to misbehave on)."""
         if mix not in cls.MIXES:
             raise ValueError(f"unknown mix {mix!r} (expected one of "
                              f"{cls.MIXES})")
@@ -554,6 +587,14 @@ class ServingLoad:
                         if rng.uniform() < deadline_frac else loose_deadline
                 specs.append(RequestSpec(t, 1 + rng.randint(max_rows),
                                          deadline))
+        # wire-side behaviors drawn AFTER the arrival schedule, so a
+        # given (seed, mix, n) keeps the same arrivals with or without
+        # client chaos enabled
+        for spec in specs:
+            if slow_frac and rng.uniform() < slow_frac:
+                spec.slow_s = slow_client_seconds
+            if disconnect_frac and rng.uniform() < disconnect_frac:
+                spec.disconnect = True
         return cls(specs)
 
     def replay(self, submit, feature_shape, dtype=np.float32,
@@ -576,6 +617,138 @@ class ServingLoad:
             except Exception as e:  # admission errors are outcomes here
                 out.append((spec, e))
         return out
+
+    def replay_http(self, url: str, model: str, feature_shape,
+                    dtype=np.float32, time_scale: float = 1.0,
+                    rng_seed: int = 0, timeout: float = 60.0):
+        """Replay the schedule over REAL sockets against an
+        :class:`~deeplearning4j_tpu.serving.ingress.HttpIngress`:
+        ``POST {url}/v1/models/{model}:predict`` per spec, honoring
+        arrival offsets, with the wire-level client chaos the specs
+        carry — ``slow_s`` dribbles the JSON body in chunks, and
+        ``disconnect`` closes the socket after sending without reading
+        the response (the server must absorb both).
+
+        Each request runs on its own thread (queueing belongs on the
+        server, not in the generator). Returns ``[(spec, outcome)]`` in
+        schedule order: ``(status_code, payload_dict)`` for answered
+        requests, the string ``"disconnected"`` for planned
+        disconnects, or the raised exception for transport failures.
+        Feature values are seeded identically to :meth:`replay`.
+        """
+        import http.client
+        import json
+        from urllib.parse import urlparse
+        parsed = urlparse(url)
+        host, port = parsed.hostname, parsed.port
+        rng = np.random.RandomState(rng_seed)
+        bodies = []
+        for spec in self.specs:
+            x = rng.randn(spec.rows, *feature_shape).astype(dtype)
+            bodies.append(json.dumps({"instances": x.tolist()}).encode())
+        out: list = [None] * len(self.specs)
+
+        def one(i: int, spec: RequestSpec, body: bytes):
+            conn = http.client.HTTPConnection(host, port, timeout=timeout)
+            try:
+                conn.putrequest("POST", f"/v1/models/{model}:predict")
+                conn.putheader("Content-Type", "application/json")
+                conn.putheader("Content-Length", str(len(body)))
+                if spec.deadline is not None:
+                    conn.putheader("deadline_ms",
+                                   f"{spec.deadline * 1e3:g}")
+                conn.endheaders()
+                if spec.slow_s > 0:
+                    # dribble: 4 chunks with stalls between them — the
+                    # handler blocks on ONE thread reading this body
+                    step = max(len(body) // 4, 1)
+                    for pos in range(0, len(body), step):
+                        conn.send(body[pos:pos + step])
+                        time.sleep(spec.slow_s / 4.0)
+                else:
+                    conn.send(body)
+                if spec.disconnect:
+                    out[i] = "disconnected"
+                    return          # finally closes the socket unread
+                resp = conn.getresponse()
+                out[i] = (resp.status, json.loads(resp.read()))
+            except Exception as e:
+                out[i] = e
+            finally:
+                conn.close()
+
+        t0 = time.monotonic()
+        threads = []
+        for i, spec in enumerate(self.specs):
+            delay = spec.at * time_scale - (time.monotonic() - t0)
+            if delay > 0:
+                time.sleep(delay)
+            th = threading.Thread(target=one, args=(i, spec, bodies[i]),
+                                  daemon=True)
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join(timeout)
+        return list(zip(self.specs, out))
+
+
+class SwapSchedule:
+    """Seeded hot-swap-under-load schedule: planned
+    ``ModelRegistry.roll()``/``rollback()`` calls fired from a
+    background thread while a :class:`ServingLoad` replay is in flight
+    — the workload half of the zero-drop hot-swap chaos pin.
+
+    ``swaps`` is a list of ``(at_seconds, name, version_or_None)``;
+    ``version=None`` means "roll to the newest staged version" and the
+    literal string ``"rollback"`` rolls back instead.
+    """
+
+    def __init__(self, swaps):
+        self.swaps = sorted(swaps, key=lambda s: s[0])
+        self.performed: list = []
+        self._thread: Optional[threading.Thread] = None
+
+    @classmethod
+    def seeded(cls, seed: int, name: str, duration: float,
+               n_swaps: int = 2) -> "SwapSchedule":
+        """``n_swaps`` swap points drawn uniformly from the middle 70%
+        of ``duration`` (the edges prove nothing — traffic must be in
+        flight), alternating roll -> rollback -> roll ..."""
+        rng = np.random.RandomState(seed)
+        at = np.sort(rng.uniform(0.15 * duration, 0.85 * duration,
+                                 size=n_swaps))
+        return cls([(float(t), name, None if i % 2 == 0 else "rollback")
+                    for i, t in enumerate(at)])
+
+    def start(self, registry, time_scale: float = 1.0) -> "SwapSchedule":
+        """Fire the schedule against ``registry`` on a daemon thread;
+        :meth:`join` collects ``performed`` — ``(at, name, action,
+        result_or_exception)`` per swap."""
+        def run():
+            t0 = time.monotonic()
+            for at, name, version in self.swaps:
+                delay = at * time_scale - (time.monotonic() - t0)
+                if delay > 0:
+                    time.sleep(delay)
+                try:
+                    if version == "rollback":
+                        result = registry.rollback(name)
+                        action = "rollback"
+                    else:
+                        result = registry.roll(name, version)
+                        action = "roll"
+                except Exception as e:      # surfaced via performed
+                    result, action = e, "error"
+                self.performed.append((at, name, action, result))
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="dl4j-swap-schedule")
+        self._thread.start()
+        return self
+
+    def join(self, timeout: float = 30.0) -> list:
+        if self._thread is not None:
+            self._thread.join(timeout)
+        return self.performed
 
 
 # ------------------------------------------------- deterministic interleaving

@@ -1,16 +1,32 @@
 """Inference serving: continuous batching with deadlines, admission
-control, graceful degradation, and drain (see ``serving.server``).
+control, graceful degradation, drain (``serving.server``), a
+multi-model registry with zero-drop hot-swap (``serving.registry``),
+and an HTTP ingress with deadline propagation and a documented wire
+error taxonomy (``serving.ingress``).
 
-Quickstart::
+Quickstart (single server)::
 
     from deeplearning4j_tpu.serving import ModelServer
 
     server = ModelServer(net, batch_limit=32, max_queue=256,
-                         default_deadline=0.2, preemption=True)
+                         default_deadline=0.2, preemption=True,
+                         head="argmax")      # results-only D2H
     server.warmup([(4,)])                    # AOT: every bucket compiled
     UIServer.getInstance().attach_serving(server)   # /healthz, /readyz
     y = server.output(x)                     # or submit(x).get()
     server.close()                           # drain + release handlers
+
+Quickstart (network front door)::
+
+    from deeplearning4j_tpu.serving import HttpIngress, ModelRegistry
+
+    reg = ModelRegistry(batch_limit=32)
+    reg.load("mnist", net_v1, shapes=[(784,)])       # v1, warmed, active
+    ingress = HttpIngress(reg, port=8500).start()
+    # ... POST /v1/models/mnist:predict  (deadline_ms header honored)
+    reg.load("mnist", net_v2)          # v2 warms while v1 keeps serving
+    reg.roll("mnist")                  # atomic, zero requests dropped
+    reg.rollback("mnist")              # bit-identical v1, nothing recompiles
 """
 
 from deeplearning4j_tpu.serving.errors import (DeadlineExceededError,
@@ -20,16 +36,25 @@ from deeplearning4j_tpu.serving.errors import (DeadlineExceededError,
                                                ServerUnhealthyError,
                                                ServingError)
 
-# serving.server pulls in jax; the error taxonomy above is part of the
-# wire contract and must stay importable from thin clients, so the
-# server symbols resolve lazily on first attribute access.
-_SERVER_SYMBOLS = ("ModelServer", "ServingRequest", "CircuitBreaker")
+# serving.server/registry/ingress pull in jax (and numpy); the error
+# taxonomy above is part of the wire contract and must stay importable
+# from thin clients, so the heavy symbols resolve lazily on first
+# attribute access.
+_LAZY_SYMBOLS = {
+    "ModelServer": "server", "ServingRequest": "server",
+    "CircuitBreaker": "server", "samediff_forward": "server",
+    "resolve_forward": "server",
+    "ModelRegistry": "registry", "ModelNotFoundError": "registry",
+    "HttpIngress": "ingress", "DecodePreset": "ingress",
+}
 
 
 def __getattr__(name):
-    if name in _SERVER_SYMBOLS:
-        from deeplearning4j_tpu.serving import server
-        return getattr(server, name)
+    mod = _LAZY_SYMBOLS.get(name)
+    if mod is not None:
+        import importlib
+        return getattr(importlib.import_module(
+            f"deeplearning4j_tpu.serving.{mod}"), name)
     raise AttributeError(
         f"module {__name__!r} has no attribute {name!r}")
 
@@ -38,4 +63,6 @@ __all__ = [
     "ModelServer", "ServingRequest", "CircuitBreaker", "ServingError",
     "ServerOverloadedError", "DeadlineExceededError", "ServerDrainingError",
     "ServerClosedError", "ServerUnhealthyError",
+    "ModelRegistry", "ModelNotFoundError", "HttpIngress", "DecodePreset",
+    "samediff_forward", "resolve_forward",
 ]

@@ -11,6 +11,24 @@ dropped.
 
 No jax / heavy imports here: the error taxonomy is part of the wire
 contract and must be importable from thin clients.
+
+Wire mapping (what ``serving.ingress`` returns — the documented
+error-code <-> exception taxonomy):
+
+======================  ======  =========  =======================
+exception               status  retriable  Retry-After
+======================  ======  =========  =======================
+ServerOverloadedError    429    yes        backoff hint (default)
+ServerDrainingError      503    yes        backoff hint (default)
+ServerUnhealthyError     503    yes        breaker cooldown
+ServerClosedError        503    yes        backoff hint (default)
+DeadlineExceededError    504    no         — (set a new deadline)
+======================  ======  =========  =======================
+
+Each class carries ``status_code`` so the mapping lives WITH the
+taxonomy (thin clients and the ingress read the same table); anything
+else (dispatch failure after retries, unexpected errors) is a plain
+500 without a retriable hint.
 """
 
 from __future__ import annotations
@@ -23,9 +41,11 @@ class ServingError(RuntimeError):
 
     ``retriable`` tells the caller whether retrying — against this
     replica after backoff, or against another replica — can succeed.
+    ``status_code`` is the wire status the ingress maps this error to.
     """
 
     retriable = False
+    status_code = 500
 
 
 class ServerOverloadedError(ServingError):
@@ -34,6 +54,7 @@ class ServerOverloadedError(ServingError):
     it would only have grown latency for every queued request."""
 
     retriable = True
+    status_code = 429
 
     def __init__(self, queue_depth: int, max_queue: int):
         self.queue_depth = int(queue_depth)
@@ -50,6 +71,7 @@ class DeadlineExceededError(ServingError):
     the deadline the client set has passed — a retry needs a new one."""
 
     retriable = False
+    status_code = 504
 
     def __init__(self, waited: float, deadline: float):
         self.waited = float(waited)
@@ -66,6 +88,7 @@ class ServerDrainingError(ServingError):
     failed. Retriable — another replica can serve it."""
 
     retriable = True
+    status_code = 503
 
     def __init__(self, msg: str = "server draining: request not "
                  "dispatched — retry against another replica"):
@@ -77,6 +100,7 @@ class ServerClosedError(ServingError):
     against another replica."""
 
     retriable = True
+    status_code = 503
 
     def __init__(self):
         super().__init__("model server is closed")
@@ -88,6 +112,7 @@ class ServerUnhealthyError(ServingError):
     ``retry_after`` is the seconds until the half-open recovery probe."""
 
     retriable = True
+    status_code = 503
 
     def __init__(self, failures: int, retry_after: Optional[float] = None):
         self.failures = int(failures)

@@ -1,0 +1,477 @@
+"""Multi-model registry with zero-drop hot-swap on one mesh.
+
+One process serves many named models, each with versioned
+:class:`~deeplearning4j_tpu.serving.server.ModelServer` instances
+sharing a single serving :class:`~deeplearning4j_tpu.parallel.mesh.
+DeviceMesh`. The design point is the TensorFlow serving architecture
+(PAPERS.md): model *rolls* are routine operations a live fleet performs
+under traffic, so they must never drop a request — and TVM's
+ahead-of-time compilation is what makes them cheap: the new version's
+bucket ladder is AOT-warmed (the zero-recompile pin) BEFORE the route
+moves.
+
+The swap protocol:
+
+1. ``load("m", model_v2, version=2, shapes=[(4,)])`` builds v2's server
+   on the same mesh and ``warmup()``s every bucket x shape — v1 keeps
+   taking 100% of the traffic while v2 compiles.
+2. ``roll("m")`` lints the plan (``DL4J-W111`` when v2's warmed shapes
+   do not cover what v1 serves), then atomically moves the route
+   pointer under the registry lock. Requests admitted before the swap
+   sit in v1's queue and complete there; requests admitted after land
+   in v2's queue — every request resolves exactly once against exactly
+   one version, because a request is owned by whichever server admitted
+   it (``ServingRequest.server`` records which).
+3. v1 stays loaded (warmed programs and all): ``rollback("m")`` swaps
+   the pointer straight back — bit-identical, nothing recompiles.
+   ``retire("m", 1)`` waits for v1's queue to empty and in-flight work
+   to finish, then closes it (zero-drop by construction: retire refuses
+   the active version).
+
+Routing is one locked pointer read per submit; the submit itself runs
+outside the registry lock, so a slow admission on one model never
+blocks routing for another.
+
+Metrics: ``dl4j_registry_rolls_total{model=}``,
+``dl4j_registry_active_version{model=}``,
+``dl4j_registry_models`` (loaded names),
+``dl4j_registry_versions{model=}`` (loaded versions per name).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, Optional
+
+from deeplearning4j_tpu import profiler as _prof
+from deeplearning4j_tpu.parallel.mesh import DeviceMesh
+from deeplearning4j_tpu.serving.server import ModelServer
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+_REG = _prof.get_registry()
+ROLLS = _REG.counter(
+    "dl4j_registry_rolls_total",
+    "Route swaps per model name (rolls + rollbacks)",
+    labelnames=("model",))
+ACTIVE_VERSION = _REG.gauge(
+    "dl4j_registry_active_version",
+    "The version number currently routed for each model name",
+    labelnames=("model",))
+MODELS_GAUGE = _REG.gauge(
+    "dl4j_registry_models",
+    "Model names currently loaded in the registry")
+VERSIONS_GAUGE = _REG.gauge(
+    "dl4j_registry_versions",
+    "Loaded (not retired) versions per model name",
+    labelnames=("model",))
+
+
+class ModelNotFoundError(KeyError):
+    """No such model name (or version) in the registry — the ingress
+    maps this to HTTP 404."""
+
+    def __init__(self, name: str, version: Optional[int] = None):
+        self.model = name
+        self.version = version
+        at = f" version {version}" if version is not None else ""
+        super().__init__(f"model {name!r}{at} is not loaded")
+
+
+class _Version:
+    __slots__ = ("version", "server", "shapes", "retired")
+
+    def __init__(self, version: int, server: ModelServer, shapes):
+        self.version = int(version)
+        self.server = server
+        self.shapes = [tuple(int(d) for d in s) for s in (shapes or [])]
+        self.retired = False
+
+
+class _Route:
+    __slots__ = ("name", "versions", "active", "previous", "decode",
+                 "reserved")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.versions: Dict[int, _Version] = {}
+        self.active: Optional[int] = None
+        self.previous: Optional[int] = None
+        self.decode = None      # ingress decode preset (raw-image bodies)
+        self.reserved: set = set()  # versions being built/warmed: picked
+        # under the lock, registered later — a concurrent load must not
+        # hand out the same number while warmup runs unlocked
+
+
+class ModelRegistry:
+    """Named, versioned model servers behind one routing table (module
+    doc for the swap protocol).
+
+    Parameters
+    ----------
+    mesh : the shared serving mesh every version's server dispatches on
+        (default: data-parallel over all devices).
+    **server_defaults : forwarded to every :class:`ModelServer` built by
+        :meth:`load` (``batch_limit``, ``max_queue``, ``coalesce_ms``,
+        ``default_deadline``, ``head``, ...); per-load kwargs override.
+    """
+
+    def __init__(self, mesh: DeviceMesh = None, **server_defaults):
+        self.mesh = mesh or DeviceMesh.data_parallel()
+        self._defaults = dict(server_defaults)
+        self._lock = _prof.InstrumentedRLock("serving:registry")
+        self._routes: Dict[str, _Route] = {}
+        self._closed = False
+
+    # ------------------------------------------------------------- loading
+    def load(self, name: str, model, version: Optional[int] = None,
+             shapes=None, decode=None, warm: bool = True,
+             roll: Optional[bool] = None, **server_kw) -> int:
+        """Load ``model`` as a new version of ``name`` and AOT-warm its
+        bucket ladder while any active version keeps taking traffic.
+
+        ``version`` defaults to ``max(existing) + 1`` (1 for a fresh
+        name); ``shapes`` are the per-request feature shapes to warm
+        (default: whatever the active version warmed); ``decode`` sets
+        the route's raw-image decode preset (ingress); ``warm=False``
+        skips warmup (``roll`` will then lint DL4J-W111). ``roll``
+        defaults to "only when this is the first version" — an upgrade
+        stays staged until an explicit :meth:`roll`. Returns the
+        version number."""
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("registry is closed")
+            route = self._routes.get(name)
+            if route is None:
+                route = self._routes[name] = _Route(name)
+            if version is None:
+                version = max(max(route.versions, default=0),
+                              max(route.reserved, default=0)) + 1
+            version = int(version)
+            if version in route.versions or version in route.reserved:
+                raise ValueError(
+                    f"model {name!r} version {version} is already loaded "
+                    "(or loading) — unload it first, or pick a new version")
+            route.reserved.add(version)
+            if shapes is None and route.active is not None:
+                shapes = list(
+                    route.versions[route.active].server._warm_shapes)
+            if decode is not None:
+                route.decode = decode
+            first = route.active is None
+        server = None
+        try:
+            kw = dict(self._defaults)
+            kw.update(server_kw)
+            kw.setdefault("mesh", self.mesh)
+            server = ModelServer(model, name=f"{name}:v{version}", **kw)
+            if warm and shapes:
+                # the expensive step, deliberately OUTSIDE the registry
+                # lock: v1 keeps routing and serving while v2 compiles
+                server.warmup(shapes)
+        except BaseException:
+            # a bad config/shape must not leak an unrouted serve thread
+            # (the version was never registered) or a dead reservation
+            if server is not None:
+                server.close()
+            with self._lock:
+                route.reserved.discard(version)
+            raise
+        ver = _Version(version, server, shapes)
+        with self._lock:
+            route.reserved.discard(version)
+            route.versions[version] = ver
+            self._gauges(route)
+        if roll if roll is not None else first:
+            self.roll(name, version)
+        logger.info("registry: loaded %s v%d (%swarmed)%s", name, version,
+                    "" if server._warmed else "NOT ",
+                    " [active]" if self.active_version(name) == version
+                    else "")
+        return version
+
+    # ------------------------------------------------------------- routing
+    def _route(self, name: str) -> _Route:
+        route = self._routes.get(name)
+        if route is None:
+            raise ModelNotFoundError(name)
+        return route
+
+    def _version(self, name: str, version: Optional[int] = None) -> _Version:
+        with self._lock:
+            route = self._route(name)
+            v = route.active if version is None else int(version)
+            if v is None:
+                raise ModelNotFoundError(name)
+            ver = route.versions.get(v)
+            if ver is None or ver.retired:
+                raise ModelNotFoundError(name, v)
+            return ver
+
+    def server(self, name: str, version: Optional[int] = None) -> ModelServer:
+        """The routed (or explicitly versioned) server for ``name``."""
+        return self._version(name, version).server
+
+    def active_version(self, name: str) -> Optional[int]:
+        with self._lock:
+            return self._route(name).active
+
+    def decode_preset(self, name: str):
+        with self._lock:
+            return self._route(name).decode
+
+    def submit(self, name: str, x, deadline: Optional[float] = None,
+               version: Optional[int] = None):
+        """Route one request: a locked pointer read picks the server,
+        the admission itself runs outside the registry lock. The
+        returned :class:`ServingRequest` is owned by exactly that
+        server (``req.server`` says which ``name:vN``), so a roll
+        racing this submit can never double-resolve or drop it."""
+        server = self._version(name, version).server
+        return server.submit(x, deadline=deadline)
+
+    def output(self, name: str, x, timeout: float = 30.0,
+               deadline: Optional[float] = None,
+               version: Optional[int] = None):
+        return self.submit(name, x, deadline=deadline,
+                           version=version).get(timeout)
+
+    # ------------------------------------------------------------- rolling
+    def validate_roll(self, name: str, version: Optional[int] = None):
+        """Static pre-roll lint (``DL4J-W111``): is the target warmed,
+        and does its warmed shape set cover what the active version
+        serves?"""
+        from deeplearning4j_tpu.analysis.serving import lint_registry_roll
+        with self._lock:
+            route = self._route(name)
+            version = self._pick_roll_target(route, version)
+            target = route.versions[version].server
+            active = (route.versions[route.active].server
+                      if route.active is not None
+                      and route.active != version else None)
+        return lint_registry_roll(f"{name} v{route.active}->v{version}",
+                                  target, active=active)
+
+    def _pick_roll_target(self, route: _Route, version) -> int:
+        # lock held by caller
+        if version is None:
+            staged = [v for v, ver in route.versions.items()
+                      if v != route.active and not ver.retired]
+            if not staged:
+                raise ValueError(
+                    f"model {route.name!r} has no staged version to roll "
+                    "to (load one first)")
+            version = max(staged)
+        version = int(version)
+        ver = route.versions.get(version)
+        if ver is None or ver.retired:
+            raise ModelNotFoundError(route.name, version)
+        return version
+
+    def roll(self, name: str, version: Optional[int] = None,
+             strict: bool = False) -> Optional[int]:
+        """Atomically move ``name``'s route to ``version`` (default: the
+        newest staged one). Runs :meth:`validate_roll` first —
+        ``strict=True`` refuses a W111-flagged roll, otherwise findings
+        surface as warnings. Returns the previously active version.
+        In-flight and already-queued requests complete on the version
+        that admitted them; nothing is drained or dropped."""
+        with self._lock:
+            # pin the target BEFORE linting: a concurrent load() staging
+            # a newer (possibly unwarmed) version between the lint and
+            # the swap must not silently become the rolled-to version
+            version = self._pick_roll_target(self._route(name), version)
+        report = self.validate_roll(name, version)
+        if strict and report.diagnostics:
+            from deeplearning4j_tpu.analysis.diagnostics import \
+                ModelValidationError
+            raise ModelValidationError(report)
+        import warnings as _warnings
+        for d in report.diagnostics:
+            _warnings.warn(f"registry roll: {d.code}: {d.message}",
+                           stacklevel=2)
+        with self._lock:
+            route = self._route(name)
+            version = self._pick_roll_target(route, version)
+            prev = route.active
+            route.previous = prev
+            route.active = version
+            self._gauges(route)
+        ROLLS.labels(model=name).inc()
+        logger.info("registry: rolled %s v%s -> v%d", name, prev, version)
+        return prev
+
+    def rollback(self, name: str) -> int:
+        """Swap the route back to the version active before the last
+        :meth:`roll` — the old server is still loaded and warmed, so the
+        restored traffic is bit-identical to pre-roll."""
+        with self._lock:
+            route = self._route(name)
+            prev = route.previous
+            if prev is None:
+                raise ValueError(f"model {name!r} has no previous version "
+                                 "to roll back to")
+            ver = route.versions.get(prev)
+            if ver is None or ver.retired:
+                raise ModelNotFoundError(name, prev)
+            route.previous = route.active
+            route.active = prev
+            self._gauges(route)
+        ROLLS.labels(model=name).inc()
+        logger.info("registry: rolled back %s -> v%d", name, prev)
+        return prev
+
+    # ----------------------------------------------------------- retirement
+    def retire(self, name: str, version: int, timeout: float = 30.0) -> None:
+        """Close a non-active version AFTER its remaining work finishes:
+        wait (bounded) for its queue to empty and in-flight batches to
+        complete, then drain+close. Refuses the active version — that
+        would drop routed traffic — and raises TimeoutError (leaving
+        the version serving) if the queue has not emptied within
+        ``timeout``: retire never fails a request."""
+        with self._lock:
+            route = self._route(name)
+            if route.active == int(version):
+                raise ValueError(
+                    f"refusing to retire {name!r} v{version}: it is the "
+                    "active route (roll first)")
+            ver = route.versions.get(int(version))
+            if ver is None:
+                raise ModelNotFoundError(name, version)
+            if ver.retired:
+                return
+        deadline = time.monotonic() + timeout
+        server = ver.server
+        while time.monotonic() < deadline and server.queue_depth() > 0:
+            time.sleep(0.01)
+        if server.queue_depth() > 0:
+            # closing now would fail the queued requests — leave the
+            # version serving instead; zero-drop beats fast retirement
+            raise TimeoutError(
+                f"retire {name!r} v{version}: {server.queue_depth()} "
+                f"request(s) still queued after {timeout:g}s — retrying "
+                "later keeps retire zero-drop")
+        # drain() completes the in-flight batch; the queue is empty, so
+        # nothing is failed — retire stays zero-drop
+        server.close()
+        with self._lock:
+            ver.retired = True
+            if route.previous == ver.version:
+                route.previous = None
+            self._gauges(route)
+
+    def unload(self, name: str) -> None:
+        """Remove a model name entirely: close every version (draining
+        each; queued requests fail with the retriable draining error)."""
+        with self._lock:
+            route = self._routes.pop(name, None)
+            if route is None:
+                raise ModelNotFoundError(name)
+            MODELS_GAUGE.set(len(self._routes))
+        for ver in route.versions.values():
+            if not ver.retired:
+                ver.server.close()
+
+    # ---------------------------------------------------------- introspection
+    def _gauges(self, route: _Route) -> None:
+        # lock held by caller
+        MODELS_GAUGE.set(len(self._routes))
+        VERSIONS_GAUGE.labels(model=route.name).set(
+            sum(1 for v in route.versions.values() if not v.retired))
+        if route.active is not None:
+            ACTIVE_VERSION.labels(model=route.name).set(route.active)
+
+    def models(self) -> dict:
+        """Snapshot for ``GET /v1/models``: per name — active version,
+        loaded versions with state/readiness, decode preset presence."""
+        with self._lock:
+            routes = list(self._routes.values())
+        out = {}
+        for route in routes:
+            with self._lock:
+                vers = dict(route.versions)
+                active, previous = route.active, route.previous
+                has_decode = route.decode is not None
+            out[route.name] = {
+                "active": active,
+                "previous": previous,
+                "accepts_images": has_decode,
+                "versions": {
+                    v: {"state": ver.server.state,
+                        "ready": ver.server.ready,
+                        "retired": ver.retired,
+                        "warmed_shapes": [list(s) for s in
+                                          ver.server._warm_shapes]}
+                    for v, ver in sorted(vers.items())},
+            }
+        return out
+
+    def load_hints(self) -> dict:
+        """Aggregated autoscaling hints for ``GET /v1/load``: the active
+        server's :meth:`~ModelServer.load_hints` per model plus fleet
+        totals a load balancer can threshold on."""
+        with self._lock:
+            actives = [(r.name, r.versions[r.active])
+                       for r in self._routes.values()
+                       if r.active is not None]
+        per_model = {}
+        for name, ver in actives:
+            hints = ver.server.load_hints()
+            hints["version"] = ver.version
+            per_model[name] = hints
+        n = len(per_model)
+        return {
+            "models": per_model,
+            "totals": {
+                "queue_depth": sum(h["queue_depth"]
+                                   for h in per_model.values()),
+                "max_queue": sum(h["max_queue"]
+                                 for h in per_model.values()),
+                "shed_rate": (sum(h["shed_rate"]
+                                  for h in per_model.values()) / n
+                              if n else 0.0),
+                "ready": all(h["ready"] for h in per_model.values())
+                if n else False,
+                "breakers_open": sum(1 for h in per_model.values()
+                                     if h["breaker"] == "open"),
+            },
+        }
+
+    @property
+    def ready(self) -> bool:
+        """Every routed model warmed and admitting (what /readyz
+        aggregates)."""
+        with self._lock:
+            actives = [r.versions[r.active].server
+                       for r in self._routes.values()
+                       if r.active is not None]
+        return bool(actives) and all(s.ready for s in actives)
+
+    @property
+    def healthy(self) -> bool:
+        with self._lock:
+            actives = [r.versions[r.active].server
+                       for r in self._routes.values()
+                       if r.active is not None]
+        return all(s.healthy for s in actives)
+
+    # -------------------------------------------------------------- teardown
+    def close(self) -> None:
+        """Close every loaded server (each drains; queued requests fail
+        with the retriable draining error). Idempotent."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            routes = list(self._routes.values())
+        for route in routes:
+            for ver in route.versions.values():
+                if not ver.retired:
+                    ver.server.close()
+
+    def __enter__(self) -> "ModelRegistry":
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

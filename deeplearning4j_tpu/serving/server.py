@@ -38,6 +38,26 @@ PAPERS.md; TVM's ahead-of-time compilation for the bucketed shapes):
   :class:`~.errors.ServerDrainingError`, and exits the serve loop
   cleanly.
 
+ISSUE 12 additions (the network front door's server-side half):
+
+- **Any callable forward.** The server no longer assumes
+  ``model.output``: pass a network (MultiLayerNetwork OR a multi-output
+  ComputationGraph — tuple results split per request), a plain callable
+  ``x -> predictions``, or a SameDiff graph wrapped with
+  :func:`samediff_forward` (the ``.exec`` adapter) — imported models
+  serve through the same bucketed/warmed path.
+- **Results-only D2H.** ``head="argmax" | "softmax" | "top_k[:k]"`` (or
+  any callable) compiles an on-device post-processing head into the
+  serve dispatch: the per-batch device->host copy moves *results*
+  (argmax labels, top-k values+indices) instead of full logits.
+  ``dl4j_serving_d2h_bytes_total`` bills exactly the bytes pulled, so
+  the cut is measurable (asserted by ``benchmarks/probe_serving.py``).
+- **Autoscaling hints.** :meth:`ModelServer.load_hints` snapshots queue
+  depth/fill, shed rate, breaker state, and mean bucket occupancy as
+  structured load-balancer hints (what ``GET /v1/load`` on the ingress
+  serves) and mirrors them to the ``dl4j_serving_shed_ratio`` /
+  ``dl4j_serving_batch_occupancy_mean`` gauges.
+
 Health surface: ``UIServer.attach_serving(server)`` exposes
 ``/healthz`` (breaker state) and ``/readyz`` (warmed and not draining)
 next to the existing ``/metrics`` registry. Serving metrics:
@@ -46,7 +66,8 @@ next to the existing ``/metrics`` registry. Serving metrics:
 ``dl4j_serving_queue_depth``, ``dl4j_serving_batch_occupancy``,
 ``dl4j_serving_batches_total``, ``dl4j_serving_breaker_state``,
 ``dl4j_serving_replica_failures_total``,
-``dl4j_serving_warmup_seconds``.
+``dl4j_serving_warmup_seconds``, ``dl4j_serving_d2h_bytes_total``,
+``dl4j_serving_shed_ratio``, ``dl4j_serving_batch_occupancy_mean``.
 """
 
 from __future__ import annotations
@@ -118,6 +139,128 @@ WARMUP_SECONDS = _REG.gauge(
     "dl4j_serving_warmup_seconds",
     "Wall time of the last warmup(): AOT compile of every bucket x "
     "shape on the serving mesh")
+D2H_BYTES = _REG.counter(
+    "dl4j_serving_d2h_bytes_total",
+    "Bytes actually copied device->host per serving dispatch (the "
+    "post-head result payload — with head=argmax/top_k this is the "
+    "results-only bill, without a head it is the full logits)")
+SHED_RATIO = _REG.gauge(
+    "dl4j_serving_shed_ratio",
+    "Fraction of this server's terminal requests that were shed or "
+    "rejected (overload + deadline + draining + breaker) — the "
+    "load-balancer back-off hint load_hints() exports",
+    labelnames=("server",))
+OCCUPANCY_MEAN = _REG.gauge(
+    "dl4j_serving_batch_occupancy_mean",
+    "Mean live-rows/bucket ratio of this server's dispatched batches "
+    "(1.0 = no padding waste) — the batch-headroom autoscaling hint",
+    labelnames=("server",))
+
+
+# ------------------------------------------------------- forward adapters
+def samediff_forward(sd, outputs, input_name=None):
+    """Adapt a SameDiff graph to the callable-forward contract (ref:
+    ``sd.batchOutput().input(...).output(...).exec()``): returns
+    ``x -> array`` (one output) or ``x -> tuple`` (several). ``outputs``
+    are SDVariables or names; ``input_name`` defaults to the graph's
+    single placeholder (ambiguous graphs must name it)."""
+    names = [o.name if hasattr(o, "name") else str(o) for o in outputs]
+    if not names:
+        raise ValueError("samediff_forward needs at least one output name")
+    if input_name is None:
+        phs = list(getattr(sd, "_placeholders", {}))
+        if len(phs) != 1:
+            raise ValueError(
+                f"SameDiff graph has {len(phs)} placeholders ({phs}) — "
+                "pass input_name= to pick the request-features one")
+        input_name = phs[0]
+
+    def forward(x):
+        out = sd.output({input_name: x}, names)
+        if len(names) == 1:
+            return out[names[0]]
+        return tuple(out[n] for n in names)
+    return forward
+
+
+def resolve_forward(model):
+    """The server's model contract: anything with ``.output(x)`` (both
+    network classes — a multi-output ComputationGraph returns a tuple),
+    or any plain callable ``x -> predictions``. SameDiff graphs need
+    :func:`samediff_forward` because their ``output`` wants
+    ``(placeholders, output_names)``, not features."""
+    if hasattr(model, "batchOutput") and hasattr(model, "_placeholders"):
+        raise TypeError(
+            "a SameDiff graph's output() takes (placeholders, outputs) — "
+            "wrap it: ModelServer(samediff_forward(sd, ['out']), ...)")
+    out = getattr(model, "output", None)
+    if callable(out):
+        return out
+    if callable(model):
+        return model
+    raise TypeError(
+        f"cannot serve {type(model).__name__}: pass a network exposing "
+        "output(x), samediff_forward(sd, outputs), or any callable "
+        "x -> predictions")
+
+
+def _make_head(head):
+    """Compile a results-only post-processing head: the device->host
+    copy then moves the head's (small) output instead of full logits."""
+    if head is None:
+        return None
+    import jax.numpy as jnp
+    if isinstance(head, str) and head.startswith("top_k"):
+        k = int(head.split(":", 1)[1]) if ":" in head else 5
+        head = ("top_k", k)
+    if isinstance(head, (tuple, list)) and tuple(head)[0] == "top_k":
+        k = int(tuple(head)[1])
+        return jax.jit(lambda y: jax.lax.top_k(y, k))
+    if head == "argmax":
+        return jax.jit(lambda y: jnp.argmax(y, axis=-1))
+    if head == "softmax":
+        return jax.jit(lambda y: jax.nn.softmax(y, axis=-1))
+    if callable(head):
+        return jax.jit(head)
+    raise ValueError(
+        f"unknown head {head!r} (expected 'argmax', 'softmax', "
+        "'top_k[:k]', or a callable)")
+
+
+def _normalize_out(out):
+    """Multi-output graphs return lists; tuples are the canonical
+    nested-result shape everywhere downstream."""
+    if isinstance(out, (list, tuple)):
+        return tuple(_normalize_out(o) for o in out)
+    return out
+
+
+def _map_arrays(fn, out):
+    # jax.jit returns LISTS for tuple pytrees, so nested results may
+    # arrive as either — every helper below normalizes back to tuples
+    if isinstance(out, (tuple, list)):
+        return tuple(_map_arrays(fn, o) for o in out)
+    return fn(out)
+
+
+def _to_host(out):
+    if isinstance(out, (tuple, list)):
+        return tuple(_to_host(o) for o in out)
+    return np.asarray(out)
+
+
+def _nbytes(out) -> int:
+    if isinstance(out, (tuple, list)):
+        return sum(_nbytes(o) for o in out)
+    return int(out.nbytes)
+
+
+def _slice_rows(out, lo: int, hi: int):
+    """Row-slice a (possibly nested-tuple) result along the batch axis —
+    how one coalesced dispatch splits back into per-request results."""
+    if isinstance(out, (tuple, list)):
+        return tuple(_slice_rows(o, lo, hi) for o in out)
+    return out[lo:hi]
 
 
 class ServingRequest:
@@ -130,13 +273,15 @@ class ServingRequest:
     """
 
     __slots__ = ("features", "n", "deadline", "enqueued_at", "resolved_at",
-                 "resolutions", "_event", "_lock", "_resolved", "_result",
-                 "_error")
+                 "resolutions", "server", "_event", "_lock", "_resolved",
+                 "_result", "_error")
 
     def __init__(self, features: np.ndarray, deadline: Optional[float],
                  enqueued_at: float):
         self.features = features
         self.n = int(features.shape[0])
+        self.server: Optional[str] = None  # stamped at admission: which
+        # server (and so which registry version) owns this request
         self.deadline = deadline          # absolute time.monotonic() or None
         self.enqueued_at = enqueued_at
         self.resolved_at: Optional[float] = None   # monotonic, set once
@@ -305,6 +450,13 @@ class ModelServer:
     name : stable label for this server's metrics (the
         ``dl4j_serving_breaker_state{server=}`` gauge); defaults to a
         process-unique ``serverN``.
+    forward : explicit forward callable ``x -> predictions`` overriding
+        the model contract (default: :func:`resolve_forward` — the
+        model's ``output`` method, or the model itself when callable).
+    head : results-only post-processing compiled into the serve
+        dispatch: ``"argmax"``, ``"softmax"``, ``"top_k"``/``"top_k:k"``
+        (-> ``(values, indices)``), or any callable on the logits —
+        D2H then moves the head's output, not the logits.
     """
 
     def __init__(self, model, mesh: DeviceMesh = None, batch_limit: int = 32,
@@ -315,8 +467,12 @@ class ModelServer:
                  breaker_threshold: int = 5, breaker_cooldown: float = 5.0,
                  drain_timeout: float = 30.0, input_dtype=np.float32,
                  preemption=None, faults=None, rewarm_on_shrink: bool = True,
-                 name: Optional[str] = None, _breaker_clock=time.monotonic):
+                 name: Optional[str] = None, forward=None, head=None,
+                 _breaker_clock=time.monotonic):
         self.model = model
+        self._fwd = forward if forward is not None else resolve_forward(model)
+        self.head = head
+        self._head_fn = _make_head(head)
         # stable metrics label: distinguishes this server's breaker state
         # from other servers' in the same process/registry
         self.name = name if name is not None else f"server{next(_SERVER_SEQ)}"
@@ -353,6 +509,8 @@ class ModelServer:
         self._warm_sig_count = 0
         self._died = False
         self._batches = 0
+        self._occ_sum = 0.0         # live-rows/bucket ratios, for the
+        self._occ_n = 0             # load_hints() occupancy mean
         self.counts: "collections.Counter[str]" = collections.Counter()
         self._preemption = None
         self._preemption_installed = False
@@ -417,6 +575,7 @@ class ModelServer:
         now = time.monotonic()
         dl = self.default_deadline if deadline is None else deadline
         req = ServingRequest(x, now + dl if dl is not None else None, now)
+        req.server = self.name
         with self._cond:
             if self._closed:
                 self._count("rejected_closed")
@@ -568,6 +727,44 @@ class ModelServer:
             "latency_p99": LATENCY.quantile(0.99),
         }
 
+    _SHED_OUTCOMES = ("shed_overload", "shed_deadline", "shed_draining",
+                      "rejected_unhealthy")
+
+    def load_hints(self) -> dict:
+        """Structured autoscaling / load-balancer hints (what the
+        ingress serves at ``GET /v1/load``): queue depth + fill, shed
+        rate over this server's terminal outcomes, breaker state, and
+        mean bucket occupancy. Mirrored to the
+        ``dl4j_serving_shed_ratio`` and
+        ``dl4j_serving_batch_occupancy_mean`` gauges on every call."""
+        with self._cond:
+            qd = len(self._dq)
+            counts = dict(self.counts)
+            batches = self._batches
+            occ = self._occ_sum / self._occ_n if self._occ_n else None
+        total = sum(counts.values())
+        shed = sum(counts.get(k, 0) for k in self._SHED_OUTCOMES)
+        shed_rate = shed / total if total else 0.0
+        SHED_RATIO.labels(server=self.name).set(shed_rate)
+        OCCUPANCY_MEAN.labels(server=self.name).set(occ or 0.0)
+        return {
+            "server": self.name,
+            "state": self.state,
+            "ready": self.ready,
+            "queue_depth": qd,
+            "max_queue": self.max_queue,
+            "queue_fill": round(qd / self.max_queue, 6)
+            if self.max_queue else 0.0,
+            "requests": total,
+            "shed": shed,
+            "shed_rate": round(shed_rate, 6),
+            "breaker": self.breaker.state,
+            "batches": batches,
+            "buckets": self.buckets(),
+            "batch_occupancy_mean": None if occ is None else round(occ, 6),
+            "recompiles_after_warmup": self.recompiles_after_warmup(),
+        }
+
     # ------------------------------------------------------------ serve loop
     def _serve(self):
         try:
@@ -689,13 +886,15 @@ class ModelServer:
             now = time.monotonic()
             pos = 0
             for req in batch:
-                if req._resolve(result=out[pos:pos + req.n]):
+                if req._resolve(result=_slice_rows(out, pos, pos + req.n)):
                     LATENCY.observe(now - req.enqueued_at)
                     self._count("completed")
                 pos += req.n
         OCCUPANCY.observe(total / float(bucket))
         with self._cond:    # stats() readers race this increment (E202)
             self._batches += 1
+            self._occ_sum += total / float(bucket)
+            self._occ_n += 1
         BATCHES.inc()
 
     # ------------------------------------------------------------- forward
@@ -728,7 +927,7 @@ class ModelServer:
                 out = self._watchdog.run(
                     lambda p=padded: self._forward_once(p),
                     self._batches + 1)
-                return out[:total]
+                return _slice_rows(out, 0, total)
             except (Exception, DispatchTimeoutError) as e:
                 last = e
                 REPLICA_FAILURES.inc()
@@ -745,7 +944,7 @@ class ModelServer:
                 self._batches + 1, [d.id for d in self.mesh.devices])
         return self._forward_raw(feats)
 
-    def _forward_raw(self, feats: np.ndarray) -> np.ndarray:
+    def _forward_raw(self, feats: np.ndarray):
         # signature includes the device set: a mesh rebuild recompiles
         # even at identical shapes, and the churn accounting must see it
         fp = (tuple(d.id for d in self.mesh.devices),
@@ -753,7 +952,14 @@ class ModelServer:
         self._churn.record("serving:forward", fp, owner=self)
         with self.mesh:
             x = jax.device_put(feats, self.mesh.batch_sharding(feats.ndim))
-            return np.asarray(self.model.output(x))
+            out = _normalize_out(self._fwd(x))
+            if self._head_fn is not None:
+                # on-device post-processing: the host pull below moves
+                # the head's results, never the full logits
+                out = _map_arrays(self._head_fn, out)
+            host = _to_host(out)            # THE per-batch D2H copy
+        D2H_BYTES.inc(_nbytes(host))
+        return host
 
     def _drop_dead_replicas(self):
         """Probe the serving mesh; rebuild on the survivors when devices

@@ -402,6 +402,62 @@ class TestCursorSeek:
         finally:
             it.close()
 
+    def test_mid_group_seek_decodes_only_the_tail(self, image_root):
+        """ISSUE 12 satellite (PR-10 carried follow-up): a mid-group
+        seek() is an EXACT slot resume — sub-batches before the resume
+        offset are never re-decoded (37 imgs / batch 8 / K=2: seek to
+        batch 3 = megabatch 1 offset 1 -> only (1, 1) is tasked)."""
+        it = MultiWorkerImageIterator(image_root, 16, 16, batch_size=8,
+                                      workers=2, shuffle=True,
+                                      drop_last=True, seed=7,
+                                      steps_per_dispatch=2)
+        try:
+            want = [int(it.next().features.astype(np.int64).sum())
+                    for _ in range(4)]
+            tasks = []
+            orig_put = it._task_q.put
+
+            def spying_put(task, *a, **kw):
+                tasks.append(task)
+                return orig_put(task, *a, **kw)
+
+            it._task_q.put = spying_put
+            it.seek({"batch": 3, "epoch": 1})
+            it._task_q.put = orig_put
+            subs = [(t[0], t[1]) for t in tasks if t is not None]
+            assert (1, 0) not in subs, subs   # consumed head: NOT re-decoded
+            assert (1, 1) in subs, subs       # the resumed tail: decoded
+            assert int(it.next().features.astype(np.int64).sum()) == want[3]
+            assert not it.hasNext()
+        finally:
+            it.close()
+
+    def test_mid_group_seek_dispatch_stream_falls_back_per_batch(
+            self, image_root):
+        """The group a mid-group seek resumed into holds stale rows
+        below the offset — dispatch_stream must emit it per batch, then
+        return to whole MegaBatches for the next group."""
+        from deeplearning4j_tpu.train.stepping import MegaBatch
+        it = MultiWorkerImageIterator(image_root, 16, 16, batch_size=8,
+                                      workers=2, shuffle=True,
+                                      drop_last=True, seed=7,
+                                      steps_per_dispatch=2)
+        try:
+            want = [int(it.next().features.astype(np.int64).sum())
+                    for _ in range(4)]
+            it.seek({"batch": 1, "epoch": 1})
+            items = list(it.dispatch_stream())
+            # batch 1 (offset 1 of group 0) arrives as a plain DataSet;
+            # group 1 arrives whole
+            assert not isinstance(items[0], MegaBatch)
+            assert isinstance(items[1], MegaBatch)
+            got = [int(items[0].features.astype(np.int64).sum())]
+            got += [int(items[1].features[j].astype(np.int64).sum())
+                    for j in range(2)]
+            assert got == want[1:]
+        finally:
+            it.close()
+
     def test_shuffle_epochs_differ_deterministically(self, image_root):
         def two_epochs(workers):
             it = MultiWorkerImageIterator(image_root, 16, 16, batch_size=8,

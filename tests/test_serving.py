@@ -1295,3 +1295,239 @@ class TestServingChaos:
             assert len(sv.mesh.devices) == 4
         finally:
             sv.close()
+
+
+# ===================================================== forward adapters (I12)
+class TestForwardAdapters:
+    """ISSUE 12 satellite: the server stops assuming ``model.output`` —
+    any callable forward serves, including multi-output graphs and
+    SameDiff (imported-model) graphs."""
+
+    def test_plain_callable_forward(self):
+        import jax.numpy as jnp
+        sv = ModelServer(lambda x: jnp.tanh(x) * 2.0, batch_limit=8,
+                         coalesce_ms=0.5)
+        try:
+            sv.warmup([(NIN,)])
+            x = feats(3)
+            np.testing.assert_allclose(sv.output(x), np.tanh(x) * 2.0,
+                                       rtol=1e-6)
+            assert sv.recompiles_after_warmup() == 0
+        finally:
+            sv.close()
+
+    def _two_headed_graph(self):
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        g = (NeuralNetConfiguration.Builder().seed(2)
+             .updater(updaters.Sgd(0.1))
+             .graphBuilder()
+             .addInputs("x")
+             .setInputTypes(InputType.feedForward(NIN)))
+        g.addLayer("trunk", DenseLayer(nOut=8, activation="relu"), "x")
+        g.addLayer("out1", OutputLayer(nOut=2, lossFunction="mcxent",
+                                       activation="softmax"), "trunk")
+        g.addLayer("out2", OutputLayer(nOut=1, lossFunction="mse",
+                                       activation="identity"), "trunk")
+        g.setOutputs("out1", "out2")
+        return ComputationGraph(g.build()).init()
+
+    def test_graph_multi_output_serves_as_tuple(self):
+        gnet = self._two_headed_graph()
+        sv = ModelServer(gnet, batch_limit=8, coalesce_ms=0.5)
+        try:
+            sv.warmup([(NIN,)])
+            x = feats(3)
+            o1, o2 = sv.output(x)
+            assert o1.shape == (3, 2) and o2.shape == (3, 1)
+            w1, w2 = gnet.output(x)
+            # padded-bucket dispatch (8 rows) vs the direct 3-row call
+            # may differ by float tiling — value equality, not bitwise
+            np.testing.assert_allclose(o1, np.asarray(w1), rtol=1e-5)
+            np.testing.assert_allclose(o2, np.asarray(w2), rtol=1e-5)
+        finally:
+            sv.close()
+
+    def test_graph_multi_output_rows_split_exactly(self):
+        # two concurrent requests coalesce into ONE dispatch; each gets
+        # ITS rows of BOTH heads back
+        gnet = self._two_headed_graph()
+        sv = ModelServer(gnet, batch_limit=8, coalesce_ms=20.0)
+        try:
+            sv.warmup([(NIN,)])
+            ra = sv.submit(feats(2, seed=1))
+            rb = sv.submit(feats(3, seed=2))
+            a1, a2 = ra.get(30.0)
+            b1, b2 = rb.get(30.0)
+            assert a1.shape == (2, 2) and a2.shape == (2, 1)
+            assert b1.shape == (3, 2) and b2.shape == (3, 1)
+            w1, w2 = gnet.output(feats(2, seed=1))
+            np.testing.assert_allclose(a1, np.asarray(w1), rtol=1e-5)
+        finally:
+            sv.close()
+
+    def _samediff(self):
+        from deeplearning4j_tpu.autodiff import SameDiff
+        sd = SameDiff.create()
+        x = sd.placeHolder("x", shape=(None, NIN))
+        rng = np.random.RandomState(7)
+        w = sd.var("w", rng.randn(NIN, NOUT).astype(np.float32))
+        b = sd.var("b", np.zeros(NOUT, np.float32))
+        sd.nn.softmax(x.mmul(w).add(b), name="probs")
+        return sd
+
+    def test_samediff_exec_adapter_serves(self):
+        from deeplearning4j_tpu.serving import samediff_forward
+        sd = self._samediff()
+        sv = ModelServer(samediff_forward(sd, ["probs"]), batch_limit=8,
+                         coalesce_ms=0.5)
+        try:
+            sv.warmup([(NIN,)])
+            x = feats(3)
+            np.testing.assert_allclose(
+                sv.output(x), np.asarray(sd.output({"x": x}, ["probs"])
+                                         ["probs"]), rtol=1e-6)
+            assert sv.recompiles_after_warmup() == 0
+        finally:
+            sv.close()
+
+    def test_samediff_without_adapter_raises(self):
+        with pytest.raises(TypeError, match="samediff_forward"):
+            ModelServer(self._samediff(), batch_limit=8)
+
+    def test_samediff_adapter_needs_unambiguous_input(self):
+        from deeplearning4j_tpu.serving import samediff_forward
+        sd = self._samediff()
+        sd.placeHolder("extra", shape=(None, 2))
+        with pytest.raises(ValueError, match="placeholders"):
+            samediff_forward(sd, ["probs"])
+        fwd = samediff_forward(sd, ["probs"], input_name="x")
+        x = feats(2)
+        assert np.asarray(fwd(x)).shape == (2, NOUT)
+
+    def test_unservable_object_raises(self):
+        with pytest.raises(TypeError, match="cannot serve"):
+            ModelServer(object(), batch_limit=8)
+
+
+# ==================================================== results-only D2H (I12)
+class TestResultsOnlyD2H:
+    """ISSUE 12 tentpole (c): on-device post-processing heads so D2H
+    moves results, not logits — billed by
+    ``dl4j_serving_d2h_bytes_total``."""
+
+    @staticmethod
+    def _d2h():
+        from deeplearning4j_tpu import profiler as prof
+        return prof.get_registry().get("dl4j_serving_d2h_bytes_total").value
+
+    def _delta_for_one_dispatch(self, sv, rows=4, seed=9):
+        before = self._d2h()
+        out = sv.output(feats(rows, seed=seed))
+        return out, self._d2h() - before
+
+    def test_argmax_head_matches_and_shrinks_d2h(self, net):
+        full = make_server(net, coalesce_ms=0.5)
+        full.warmup([(NIN,)])
+        _, full_bytes = self._delta_for_one_dispatch(full)
+        full.close()
+
+        sv = make_server(net, coalesce_ms=0.5, head="argmax")
+        try:
+            sv.warmup([(NIN,)])
+            labels, head_bytes = self._delta_for_one_dispatch(sv)
+            np.testing.assert_array_equal(
+                labels, np.argmax(np.asarray(net.output(feats(4, seed=9))),
+                                  axis=-1))
+            # THE acceptance assert: the per-batch copy measurably
+            # shrank (argmax of the padded bucket vs bucket x NOUT
+            # floats)
+            assert 0 < head_bytes < full_bytes, (head_bytes, full_bytes)
+        finally:
+            sv.close()
+
+    def test_top_k_head_values_and_indices(self, net):
+        sv = make_server(net, coalesce_ms=0.5, head="top_k:2")
+        try:
+            sv.warmup([(NIN,)])
+            x = feats(3, seed=3)
+            vals, idx = sv.output(x)
+            logits = np.asarray(net.output(x))
+            want_idx = np.argsort(-logits, axis=-1)[:, :2]
+            np.testing.assert_array_equal(idx, want_idx)
+            np.testing.assert_allclose(
+                vals, np.take_along_axis(logits, want_idx, axis=-1),
+                rtol=1e-6)
+        finally:
+            sv.close()
+
+    def test_softmax_head(self, net):
+        sv = make_server(net, coalesce_ms=0.5, head="softmax")
+        try:
+            sv.warmup([(NIN,)])
+            probs = sv.output(feats(2))
+            np.testing.assert_allclose(probs.sum(axis=-1), [1.0, 1.0],
+                                       rtol=1e-5)
+        finally:
+            sv.close()
+
+    def test_callable_head(self, net):
+        import jax.numpy as jnp
+        sv = make_server(net, coalesce_ms=0.5,
+                         head=lambda y: jnp.max(y, axis=-1))
+        try:
+            sv.warmup([(NIN,)])
+            x = feats(2)
+            np.testing.assert_allclose(
+                sv.output(x), np.asarray(net.output(x)).max(axis=-1),
+                rtol=1e-6)
+        finally:
+            sv.close()
+
+    def test_unknown_head_rejected(self, net):
+        with pytest.raises(ValueError, match="unknown head"):
+            make_server(net, head="argmin")
+
+    def test_zero_recompiles_with_head(self, net):
+        sv = make_server(net, coalesce_ms=0.5, head="argmax")
+        try:
+            sv.warmup([(NIN,)])
+            for rows in (1, 3, 8, 5, 2):
+                sv.output(feats(rows, seed=rows))
+            assert sv.recompiles_after_warmup() == 0
+        finally:
+            sv.close()
+
+
+# ===================================================== autoscaling hints (I12)
+class TestLoadHints:
+    def test_hints_shape_and_shed_accounting(self, net):
+        sv = ModelServer(_SlowModel(net, 0.1), batch_limit=1, max_queue=2,
+                         coalesce_ms=0.0, name="hints-test")
+        try:
+            sv.warmup([(NIN,)])
+            reqs = [sv.submit(feats(1, seed=i)) for i in range(2)]
+            shed = 0
+            for i in range(4):      # queue bound 2 -> overload sheds
+                try:
+                    reqs.append(sv.submit(feats(1, seed=10 + i)))
+                except ServerOverloadedError:
+                    shed += 1
+            assert shed >= 1
+            for r in reqs:
+                r.get(30.0)
+            hints = sv.load_hints()
+            assert hints["server"] == "hints-test"
+            assert hints["queue_depth"] == 0
+            assert hints["max_queue"] == 2
+            assert hints["shed"] == shed
+            assert 0 < hints["shed_rate"] < 1
+            assert hints["breaker"] == "closed"
+            assert hints["buckets"] == sv.buckets()
+            assert hints["batch_occupancy_mean"] is not None
+            assert hints["recompiles_after_warmup"] == 0
+            from deeplearning4j_tpu import profiler as prof
+            g = prof.get_registry().get("dl4j_serving_shed_ratio")
+            assert g.labels(server="hints-test").value == \
+                pytest.approx(hints["shed_rate"])
+        finally:
+            sv.close()
