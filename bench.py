@@ -32,7 +32,11 @@ Run: ``python bench.py`` (``--quick`` = small configs for CI;
 probe — throughput vs p99 + shed rates, plus the ISSUE-12 ingress
 section: wire-path p50/p99 + shed rate vs in-process submit at the
 same load, per-batch D2H bytes full-logits vs results-only (asserted),
-and the W111 registry-roll lint check — into ``detail.serving``).
+and the W111 registry-roll lint check — into ``detail.serving``;
+``--cold-start`` folds ``benchmarks/probe_cold_start.py`` — fresh-
+process first-dispatch seconds with the persistent compile cache off
+vs. populated for fit / resume / serving warmup, with the
+zero-disk-miss warm pin asserted — into ``detail.cold_start``).
 """
 
 import json
@@ -466,27 +470,42 @@ class DataPipelineBench:
                     if key.startswith("stall:") and v > 0}}
 
 
-def bench_serving(quick: bool = False):
-    """Serving traffic-mix probe (benchmarks/probe_serving.py) in a
-    subprocess — it owns its device flags and sheds load on purpose, so
-    its jax state must not contaminate the training benchmarks."""
+def _run_probe(script: str, extra_args, timeout: float):
+    """Run one benchmarks/ probe in a subprocess (probes own their
+    device flags / shed load / fork further children, so their jax
+    state must not contaminate the training benchmarks) and parse its
+    one-line JSON. A hung probe / empty output / bad JSON degrades to
+    an error entry — it must not abort the benches that already ran."""
     import os
     import subprocess
     here = os.path.dirname(os.path.abspath(__file__))
-    cmd = [sys.executable, os.path.join(here, "benchmarks",
-                                        "probe_serving.py")]
-    if quick:
-        cmd += ["--n", "100", "--batch-limit", "16"]
-    # a hung probe / empty output / bad JSON degrades to an error entry —
-    # it must not abort the training benches that already ran
+    cmd = [sys.executable, os.path.join(here, "benchmarks", script)]
+    cmd += list(extra_args)
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True,
-                              timeout=900, cwd=here)
+                              timeout=timeout, cwd=here)
         if proc.returncode != 0:
             return {"error": (proc.stderr or proc.stdout).strip()[-500:]}
         return json.loads(proc.stdout.strip().splitlines()[-1])
     except Exception as e:
         return {"error": f"{type(e).__name__}: {e}"}
+
+
+def bench_serving(quick: bool = False):
+    """Serving traffic-mix probe (benchmarks/probe_serving.py)."""
+    return _run_probe(
+        "probe_serving.py",
+        ["--n", "100", "--batch-limit", "16"] if quick else [],
+        timeout=900)
+
+
+def bench_cold_start(quick: bool = False):
+    """Cold-start probe (benchmarks/probe_cold_start.py): fresh-process
+    first-dispatch latency with the persistent compile cache off vs.
+    populated, across fit, resume, and serving warmup. The probe itself
+    asserts zero disk-miss compiles for the warm fit/serving runs."""
+    return _run_probe("probe_cold_start.py",
+                      ["--quick"] if quick else [], timeout=1800)
 
 
 def bench_dp_scaling(bert_1chip_samples_per_sec, quick: bool = False):
@@ -608,6 +627,8 @@ def main(argv):
         detail["dp_scaling"] = bench_dp_scaling(bert["samples_per_sec"], quick)
     if "--serving" in argv:
         detail["serving"] = bench_serving(quick)
+    if "--cold-start" in argv:
+        detail["cold_start"] = bench_cold_start(quick)
 
     print(json.dumps({
         "metric": "bert_base_seq128_train_samples_per_sec_per_chip",

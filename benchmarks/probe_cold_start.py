@@ -1,0 +1,174 @@
+"""Cold-start probe: first-dispatch latency of a FRESH process with the
+persistent compile cache off vs. populated — across fit, resume, and
+serving warmup (the ISSUE-13 headline number).
+
+Protocol (all measurements in subprocesses so every run really is a
+fresh process with an empty jit cache):
+
+1. ``prime``: run the scenario once with the cache configured at a temp
+   dir — populates the on-disk store.
+2. ``cold``: run it again in a fresh process with the cache OFF — the
+   first dispatch pays full XLA compile. This is today's default.
+3. ``warm``: fresh process, cache pointed at the primed dir — the first
+   dispatch deserializes from disk.
+
+Reported per scenario: cold vs warm first-dispatch wall seconds, the
+speedup, and the warm run's cache stats (the probe FAILS if the warm
+run recorded any disk miss for fit/serving — a miss means the content
+key regressed). One JSON line on stdout for ``bench.py --cold-start``.
+
+Run: ``python benchmarks/probe_cold_start.py [--quick]``.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_CHILD = r"""
+import json, os, sys, time, warnings
+warnings.simplefilter("ignore")
+import numpy as np
+from deeplearning4j_tpu.nn.config import NeuralNetConfiguration, InputType
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn import compilecache as cc
+from deeplearning4j_tpu.train.updaters import Adam
+from deeplearning4j_tpu.data.dataset import DataSet
+
+scenario, cache_dir, ckpt_dir, hidden = sys.argv[1:5]
+hidden = int(hidden)
+if cache_dir != "none":
+    cc.configure(cache_dir)
+
+def build():
+    b = (NeuralNetConfiguration.Builder().seed(7).updater(Adam(1e-3))
+         .weightInit("xavier").list())
+    for _ in range(4):
+        b = b.layer(DenseLayer(nOut=hidden, activation="relu"))
+    conf = (b.layer(OutputLayer(nOut=16, lossFunction="mcxent",
+                                activation="softmax"))
+            .setInputType(InputType.feedForward(64)).build())
+    return MultiLayerNetwork(conf).init()
+
+rng = np.random.RandomState(0)
+ds = DataSet(rng.randn(32, 64).astype(np.float32),
+             np.eye(16, dtype=np.float32)[rng.randint(0, 16, 32)])
+net = build()
+
+if scenario == "fit":
+    t0 = time.perf_counter()
+    net.fit(ds, epochs=1)                 # ONE batch: first-dispatch bill
+    first = time.perf_counter() - t0
+elif scenario == "resume-prep":
+    from deeplearning4j_tpu.train.resilience import CheckpointConfig
+    net.fit([ds, ds], epochs=1,
+            checkpoint=CheckpointConfig(ckpt_dir, every_steps=1))
+    first = 0.0
+elif scenario == "resume":
+    from deeplearning4j_tpu.train.resilience import CheckpointConfig
+    t0 = time.perf_counter()
+    net.fit([ds, ds], epochs=2,           # restores + first dispatch
+            checkpoint=CheckpointConfig(ckpt_dir, resume=True))
+    first = time.perf_counter() - t0
+elif scenario == "serving":
+    from deeplearning4j_tpu.serving.server import ModelServer
+    sv = ModelServer(net, batch_limit=8, name="coldstart")
+    t0 = time.perf_counter()
+    sv.warmup([(64,)])                    # the whole bucket ladder
+    first = time.perf_counter() - t0
+    sv.close()
+else:
+    raise SystemExit(f"unknown scenario {scenario}")
+print(json.dumps({"first_dispatch_s": first, "cache": cc.cache_stats()}))
+"""
+
+
+def _run_child(scenario, cache_dir, ckpt_dir, hidden):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("XLA_FLAGS", "")
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop(  # the child's cache is OUR argument, never ambient state
+        "DL4J_TPU_COMPILE_CACHE_DIR", None)
+    proc = subprocess.run(
+        [sys.executable, "-c", _CHILD, scenario, cache_dir, ckpt_dir,
+         str(hidden)],
+        capture_output=True, text=True, timeout=600, env=env, cwd=_REPO)
+    if proc.returncode != 0:
+        raise RuntimeError(f"{scenario} child failed:\n"
+                           f"{proc.stderr.strip()[-2000:]}")
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def probe(quick: bool = False) -> dict:
+    hidden = 64 if quick else 256
+    work = tempfile.mkdtemp(prefix="dl4j_coldstart_")
+    cache = os.path.join(work, "cache")
+    out = {"hidden": hidden}
+    try:
+        for scenario in ("fit", "resume", "serving"):
+            ckpt_cold = os.path.join(work, f"ckpt_{scenario}_cold")
+            ckpt_warm = os.path.join(work, f"ckpt_{scenario}_warm")
+            if scenario == "resume":
+                # separate checkpoint dirs so the cold and warm children
+                # restore identical-but-independent state; the resumed
+                # fit itself writes nothing (no periodic saves), so the
+                # prime below leaves the checkpoint untouched
+                _run_child("resume-prep", "none", ckpt_cold, hidden)
+                _run_child("resume-prep", "none", ckpt_warm, hidden)
+            # 1. prime the persistent store (its own timing is irrelevant)
+            _run_child(scenario, cache, ckpt_warm, hidden)
+            # 2. cold: fresh process, no cache
+            t0 = time.perf_counter()
+            cold = _run_child(scenario, "none", ckpt_cold, hidden)
+            cold_wall = time.perf_counter() - t0
+            # 3. warm: fresh process, populated cache
+            t0 = time.perf_counter()
+            warm = _run_child(scenario, cache, ckpt_warm, hidden)
+            warm_wall = time.perf_counter() - t0
+            cold_s = cold["first_dispatch_s"]
+            warm_s = warm["first_dispatch_s"]
+            stats = warm["cache"]
+            row = {
+                "cold_first_dispatch_s": round(cold_s, 4),
+                "warm_first_dispatch_s": round(warm_s, 4),
+                "speedup": round(cold_s / warm_s, 2) if warm_s > 0 else None,
+                "cold_process_wall_s": round(cold_wall, 2),
+                "warm_process_wall_s": round(warm_wall, 2),
+                "warm_disk_hits": stats["disk"]["hits"],
+                "warm_disk_misses": stats["disk"]["misses"],
+                "warm_cold_compile_s": round(
+                    stats["compile_seconds"]["cold"], 4),
+            }
+            # THE pin: a warm fresh process performs ZERO disk-miss
+            # compiles for previously-seen keys (fit + serving; resume's
+            # restore epoch may legitimately see a tail signature)
+            if scenario in ("fit", "serving"):
+                assert stats["disk"]["misses"] == 0, \
+                    f"{scenario}: warm process recorded disk misses " \
+                    f"({stats['disk']['misses']}) — content key regressed"
+                assert stats["disk"]["hits"] > 0, \
+                    f"{scenario}: warm process never touched the cache"
+            assert warm_s < cold_s, \
+                f"{scenario}: warm first dispatch ({warm_s:.3f}s) not " \
+                f"faster than cold ({cold_s:.3f}s)"
+            out[scenario] = row
+        return out
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
+def main(argv):
+    quick = "--quick" in argv
+    result = probe(quick)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

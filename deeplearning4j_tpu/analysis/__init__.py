@@ -78,7 +78,8 @@ from deeplearning4j_tpu.analysis.numerics import DataRangeSpec, lint_numerics
 from deeplearning4j_tpu.analysis.pipeline import (InputPipelineSpec,
                                                   lint_input_pipeline)
 from deeplearning4j_tpu.analysis.samediff import analyze_samediff
-from deeplearning4j_tpu.analysis.serving import (lint_registry_roll,
+from deeplearning4j_tpu.analysis.serving import (lint_compile_cache,
+                                                 lint_registry_roll,
                                                  lint_serving)
 
 __all__ = [
@@ -89,5 +90,5 @@ __all__ = [
     "DataRangeSpec", "lint_numerics",
     "normalize_code", "RecompileChurnDetector",
     "get_churn_detector", "array_fingerprint", "lint_serving",
-    "lint_registry_roll",
+    "lint_registry_roll", "lint_compile_cache",
 ]

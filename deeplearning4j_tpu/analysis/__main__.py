@@ -113,8 +113,10 @@ def main(argv=None) -> int:
                     help="precision policy for the E3xx/W30x numerics "
                          "lints: a compute dtype ('bf16', 'fp16', "
                          "'fp32') or 'compute=fp16,params=fp32,"
-                         "loss_scale=32768' — without it the pass runs "
-                         "under each config's own dataType")
+                         "loss_scale=32768' (loss_scale=dynamic + "
+                         "loss_scale_init=/growth_interval=/... for the "
+                         "grow/backoff automaton) — without it the pass "
+                         "runs under each config's own dataType")
     ap.add_argument("--data-range", default=None, metavar="LO..HI",
                     help="declared input value range for the range-"
                          "dependent numerics lints (E303/W303), e.g. "
@@ -168,7 +170,16 @@ def main(argv=None) -> int:
                         raise ValueError(f"expected key=value, got {part!r}")
                     k = k.strip()
                     if k == "loss_scale":
+                        # 'dynamic' = the grow/backoff automaton; any
+                        # other spelling must be a static float
+                        v = v.strip()
+                        kv[k] = v if v.lower() == "dynamic" else float(v)
+                    elif k in ("loss_scale_init", "growth_factor",
+                               "backoff_factor", "min_loss_scale",
+                               "max_loss_scale"):
                         kv[k] = float(v)
+                    elif k == "growth_interval":
+                        kv[k] = int(v)
                     elif k in ("compute", "params"):
                         kv[k] = v.strip()
                     else:

@@ -107,6 +107,11 @@ DIAGNOSTIC_CODES = {
                  "target version was never warmed (or misses shapes the "
                  "active version serves warm), so post-roll traffic "
                  "XLA-compiles under live load",
+    "DL4J-W112": "serving warmup without a persistent compile cache: no "
+                 "DL4J_TPU_COMPILE_CACHE_DIR / compilecache.configure() "
+                 "directory is set (or the directory is unwritable), so "
+                 "every fresh process, rollout, and hot-swap staging pays "
+                 "full XLA compile instead of a disk hit",
     # E2xx/W21x concurrency lints (analysis/concurrency.py): AST-level
     # thread-safety analysis of the framework's own (or user) source.
     "DL4J-E201": "unguarded cross-thread mutation: an attribute (or a "

@@ -434,18 +434,29 @@ def _lint_dynamic_range(conf, pol: PrecisionPolicy,
     compute_max = pol.compute_max()
     # the backward pass flows SCALED activation gradients in the compute
     # dtype (the step scales the loss before value_and_grad and unscales
-    # after) — the overflow test must apply the scale
-    scaled = grad * (pol.loss_scale or 1.0)
+    # after) — the overflow test must apply the scale. A dynamic policy
+    # is judged at its INITIAL scale: that is its worst-case exposure,
+    # and an automaton that starts every run by overflowing (dropping
+    # updates until backoff converges) is misconfigured even though it
+    # eventually recovers
+    scaled = grad * (pol.numeric_loss_scale() or 1.0)
     if scaled > compute_max:
+        what = ("dynamic loss scaling starts at" if pol.is_dynamic
+                else "the backward pass sees")
+        consequence = (
+            "every run begins by overflowing and dropping updates until "
+            "the automaton backs off — lower loss_scale_init"
+            if pol.is_dynamic else
+            "the backward pass overflows before the updater ever sees it")
         diags.append(Diagnostic(
             "DL4J-E303", Severity.ERROR, "policy",
-            f"declared input range [{rng.lo:g}, {rng.hi:g}]: the "
+            f"declared input range [{rng.lo:g}, {rng.hi:g}]: {what} a "
             f"(loss-scaled) gradient-magnitude estimate ~{scaled:.2g} "
-            f"exceeds the {pol.compute} compute dtype's max "
-            f"({compute_max:.3g}) — the backward pass overflows before "
-            f"the updater ever sees it",
+            f"exceeding the {pol.compute} compute dtype's max "
+            f"({compute_max:.3g}) — {consequence}",
             fix_hint="normalize the input below the overflow range, "
-                     "lower loss_scale, or raise the compute dtype"))
+                     "lower loss_scale (or loss_scale_init), or raise "
+                     "the compute dtype"))
     return diags
 
 
@@ -476,28 +487,33 @@ def _lint_cast_churn(pol: PrecisionPolicy, entries) -> List[Diagnostic]:
 # W302 ------------------------------------------------------------------
 def _lint_loss_scaling(pol: PrecisionPolicy) -> List[Diagnostic]:
     diags: List[Diagnostic] = []
-    if pol.loss_scale is None:
+    scale = pol.numeric_loss_scale()
+    if scale is None:
         return diags
+    # a dynamic policy's numeric view is its init value; name it so the
+    # message matches what the user wrote
+    label = (f"loss_scale='dynamic' (init {scale:g})" if pol.is_dynamic
+             else f"loss_scale={scale:g}")
     if pol.compute in ("float32", "bfloat16"):
         diags.append(Diagnostic(
             "DL4J-W302", Severity.WARNING, "policy",
-            f"loss_scale={pol.loss_scale:g} with {pol.compute} compute "
+            f"{label} with {pol.compute} compute "
             f"is a no-op numerically: {pol.compute} shares fp32's "
             f"exponent range, so there is no small-gradient underflow "
             f"to rescue — the scale just adds two multiplies",
             fix_hint="drop loss_scale (it exists for float16)"))
-    if pol.loss_scale < 1.0:
+    if scale < 1.0:
         diags.append(Diagnostic(
             "DL4J-W302", Severity.WARNING, "policy",
-            f"loss_scale={pol.loss_scale:g} < 1 SHRINKS gradients — "
+            f"{label} < 1 SHRINKS gradients — "
             f"the opposite of what loss scaling is for (rescuing the "
             f"small-gradient tail from fp16 underflow)",
             fix_hint="use a power of two >= 2**8 (2**15 is the usual "
                      "static choice)"))
-    if pol.loss_scale > LOSS_SCALE_CEILING:
+    if scale > LOSS_SCALE_CEILING and not pol.is_dynamic:
         diags.append(Diagnostic(
             "DL4J-W302", Severity.WARNING, "policy",
-            f"loss_scale={pol.loss_scale:g} is past 2**24 — the SCALED "
+            f"{label} is past 2**24 — the SCALED "
             f"loss/gradients themselves overflow fp16 long before "
             f"underflow is a concern",
             fix_hint="use a scale in the 2**8..2**16 band"))

@@ -21,6 +21,14 @@ same contract as the rest of ``analysis``):
   buckets — the first post-roll request at an unwarmed (bucket, shape)
   XLA-compiles under live traffic, exactly the cold-start the zero-drop
   hot-swap exists to avoid.
+- ``DL4J-W112``: a serving/registry warmup running WITHOUT a persistent
+  compile cache (no ``DL4J_TPU_COMPILE_CACHE_DIR`` /
+  ``nn.compilecache.configure()`` directory, or an unwritable one) —
+  every fresh process, rollout, and hot-swap staging pays full XLA
+  compile where a populated cache would deserialize from disk. Checked
+  only when the lint runs on behalf of an actual ``warmup()``
+  (``check_cache=True``): a pure-static ``validate()`` stays silent so
+  config linting is environment-independent.
 
 Entry points: :func:`lint_serving` (what ``ModelServer.validate()`` /
 ``warmup(strict=True)`` call) — accepts a network, or a bare
@@ -82,9 +90,38 @@ def _activation_bytes_per_example(conf, shapes, itemsize: int) -> float:
     return float(total) * itemsize
 
 
+def lint_compile_cache(context: str = "serving warmup") -> List[Diagnostic]:
+    """The DL4J-W112 check: is a persistent compile cache configured and
+    writable? jax-free (``nn.compilecache``'s config half imports no
+    accelerator stack)."""
+    from deeplearning4j_tpu.nn.compilecache import ENV_DIR, cache_dir_status
+    directory, writable = cache_dir_status()
+    if directory is None:
+        return [Diagnostic(
+            "DL4J-W112", Severity.WARNING, context,
+            "no persistent compile cache is configured — every fresh "
+            "process, rollout, and hot-swap staging pays full XLA "
+            "compile for programs an earlier run already compiled",
+            fix_hint=f"set {ENV_DIR}=/path/shared/by/your/fleet (or call "
+                     "nn.compilecache.configure(dir)) so warmup "
+                     "deserializes previously-seen (model, bucket, mesh, "
+                     "policy) programs from disk")]
+    if not writable:
+        return [Diagnostic(
+            "DL4J-W112", Severity.WARNING, context,
+            f"persistent compile cache directory {directory!r} is not "
+            "writable — warmup can neither populate nor refresh it, so "
+            "rollouts on new (model, bucket, mesh, policy) tuples still "
+            "pay full compile",
+            fix_hint="fix the directory permissions (or point "
+                     f"{ENV_DIR} at a writable path)")]
+    return []
+
+
 def lint_serving(model_or_conf, buckets: Sequence[int], mesh=None,
                  shapes: Optional[Iterable[Sequence[int]]] = None,
                  hbm_gb: Optional[float] = None, input_dtype=None,
+                 check_cache: bool = False,
                  extra: Iterable[Diagnostic] = ()) -> ValidationReport:
     """Static serving-config report for ``buckets`` on ``mesh``.
 
@@ -93,10 +130,13 @@ def lint_serving(model_or_conf, buckets: Sequence[int], mesh=None,
     ``warmup()`` argument) for the activation estimate; ``hbm_gb``
     enables E111 (None skips it — CPU tests have no HBM to budget);
     ``extra`` folds pre-existing diagnostics (the server's W201 churn
-    findings) into the report."""
+    findings) into the report; ``check_cache=True`` (the warmup path)
+    adds the DL4J-W112 persistent-compile-cache check."""
     spec = MeshSpec.coerce(mesh) if mesh is not None else None
     buckets = [int(b) for b in buckets]
     diags: List[Diagnostic] = list(extra)
+    if check_cache:
+        diags.extend(lint_compile_cache())
 
     data_width = spec.size(spec.data_axis) if spec is not None else 1
     if data_width > 1:

@@ -23,6 +23,8 @@ _LAZY = {
     "layers": ("deeplearning4j_tpu.nn.layers", None),
     "augment": ("deeplearning4j_tpu.nn.augment", None),
     "precision": ("deeplearning4j_tpu.nn.precision", None),
+    "compilecache": ("deeplearning4j_tpu.nn.compilecache", None),
+    "warmup": ("deeplearning4j_tpu.nn.compilecache", "warmup"),
     "multilayer": ("deeplearning4j_tpu.nn.multilayer", None),
     "graph": ("deeplearning4j_tpu.nn.graph", None),
     "preprocessors": ("deeplearning4j_tpu.nn.preprocessors", None),

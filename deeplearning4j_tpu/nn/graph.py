@@ -26,12 +26,16 @@ from deeplearning4j_tpu.analysis import churn as _churn
 from deeplearning4j_tpu.data.dataset import DataSet, DataSetIterator, MultiDataSet
 from deeplearning4j_tpu.evaluation.evaluation import Evaluation
 from deeplearning4j_tpu.nn import augment as _augment_mod
+from deeplearning4j_tpu.nn import compilecache as _cc
 from deeplearning4j_tpu.nn import layers as L
 from deeplearning4j_tpu.nn import preprocessors as pp
 from deeplearning4j_tpu.nn.config import InputType, NeuralNetConfiguration
-from deeplearning4j_tpu.nn.multilayer import (_maybe_attach_env_profiler,
+from deeplearning4j_tpu.nn.multilayer import (_dynamic_scale_next,
+                                              _grads_all_finite,
+                                              _maybe_attach_env_profiler,
                                               _predict_batches,
-                                              _process_and_apply_grads)
+                                              _process_and_apply_grads,
+                                              _select_update)
 from deeplearning4j_tpu.profiler import sanitizer as _sanitizer
 from deeplearning4j_tpu.train import stepping as _stepping
 
@@ -407,6 +411,7 @@ class ComputationGraph:
         self._fwd_cache = None
         self._augment = None    # DeviceAugmentation (see setDeviceAugmentation)
         self._precision = None  # PrecisionPolicy (see setPrecisionPolicy)
+        self._scale_state = None  # dynamic loss scale [scale, good_steps]
         self._initialized = False
 
     def validate(self, batch_size: int = None, data_devices: int = None,
@@ -433,6 +438,7 @@ class ComputationGraph:
         self._train_step_cache = {}
         self._megastep_cache = {}
         self._fwd_cache = None
+        self._scale_state = None
         self._initialized = True
         _sanitizer.invalidate(self)   # re-init = out-of-band state reset
         return self
@@ -496,14 +502,57 @@ class ComputationGraph:
         """ref: ComputationGraph.output — returns list of output arrays
         (single array if one output)."""
         ins = self._as_input_dict(inputs[0] if len(inputs) == 1 else list(inputs))
+        outs = self._jit_forward()(self._params, self._states, ins,
+                                   jax.random.PRNGKey(0))
+        return outs[0] if len(outs) == 1 else outs
+
+    def _jit_forward(self):
         if self._fwd_cache is None:
             def fwd(params, states, ins, key):
                 outs, _ = self._forward(params, states, ins, False, key)
                 return outs
-            self._fwd_cache = jax.jit(fwd)
-        outs = self._fwd_cache(self._params, self._states, ins,
-                               jax.random.PRNGKey(0))
-        return outs[0] if len(outs) == 1 else outs
+            # behind the compile-cache seam — see MultiLayerNetwork.
+            # _jit_forward (serving warmup / persistent disk tier)
+            self._fwd_cache = _cc.cached_dispatch(
+                fwd, "graph:forward", key_parts=self._compile_key_parts(0))
+        return self._fwd_cache
+
+    def _warm_forward(self, x) -> "ComputationGraph":
+        """AOT-compile the inference forward for this input signature
+        without executing it (the ``compilecache.warmup`` seam). ``x``:
+        one array, a list matching ``graph_inputs``, or a name->array
+        dict."""
+        ins = self._as_input_dict(x)
+        self._jit_forward().warm(self._params, self._states, ins,
+                                 jax.random.PRNGKey(0))
+        return self
+
+    def _warm_dispatch(self, x, y, fmask=None, lmask=None,
+                       steps: int = 1) -> "ComputationGraph":
+        """AOT-compile the train step (or K-step megastep) for this
+        batch signature without executing it — see
+        MultiLayerNetwork._warm_dispatch. ``x``/``y`` accept single
+        arrays or lists for multi-input/multi-output graphs (``fmask``
+        is unused — graph fits carry no feature mask)."""
+        self._ensure_opt_state()
+        xs = list(x) if isinstance(x, (list, tuple)) else [x]
+        ins = {name: jnp.asarray(a)
+               for name, a in zip(self.conf.graph_inputs, xs)}
+        ys = list(y) if isinstance(y, (list, tuple)) else [y]
+        labels = [jnp.asarray(a) for a in ys]
+        lmasks = None
+        if lmask is not None:
+            lms = list(lmask) if isinstance(lmask, (list, tuple)) else [lmask]
+            lmasks = [jnp.asarray(m) for m in lms]
+        sig = lmasks is not None
+        step, dummy = self._step_for(sig, steps, len(labels))
+        clock = jnp.asarray(self._iteration, jnp.int32)
+        args = [self._params, self._states, self._opt_state, clock]
+        if self._dynamic_scaling():
+            args.append(self._ensure_scale_state())
+        args += [ins, labels, lmasks if lmasks is not None else dummy]
+        step.warm(*args)
+        return self
 
     def feedForward(self, inputs, train: bool = False):
         ins = self._as_input_dict(inputs)
@@ -581,6 +630,9 @@ class ComputationGraph:
         # static loss scaling under the precision seam — see
         # MultiLayerNetwork._make_train_step
         pol = self._precision
+        if pol is not None and pol.is_dynamic:
+            return self._make_dynamic_train_step(steps=steps,
+                                                 with_lmasks=with_lmasks)
         loss_scale = pol.loss_scale if pol is not None else None
 
         def step(params, states, opt_state, t, ins, labels, lmasks):
@@ -612,11 +664,111 @@ class ComputationGraph:
             return new_params, new_states, new_opt, t + 1, loss
         # donate params/states/opt_state/t: the step consumes and replaces
         # them, halving peak HBM for the update and letting dependent
-        # dispatches pipeline on relayed TPU backends
+        # dispatches pipeline on relayed TPU backends. Behind the
+        # compile-cache seam (nn.compilecache) like the MLN steps.
         if steps > 1:
-            return jax.jit(_stepping.scan_megastep(step, 4),
-                           donate_argnums=(0, 1, 2, 3))
-        return jax.jit(step, donate_argnums=(0, 1, 2, 3))
+            return _cc.cached_dispatch(
+                _stepping.scan_megastep(step, 4), "graph:megastep",
+                key_parts=self._compile_key_parts(steps),
+                donate_argnums=(0, 1, 2, 3))
+        return _cc.cached_dispatch(
+            step, "graph:train_step", key_parts=self._compile_key_parts(1),
+            donate_argnums=(0, 1, 2, 3))
+
+    def _make_dynamic_train_step(self, steps: int, with_lmasks: bool):
+        """Train step under ``PrecisionPolicy(loss_scale="dynamic")`` —
+        the grow/backoff automaton traced into the compiled program; see
+        MultiLayerNetwork._make_dynamic_train_step (this is its graph
+        mirror: ins dict + labels list, no feature mask)."""
+        base = self.conf.base
+        updater = base.updater
+        seed = base.seed
+        augment = self._augment
+        pol = self._precision
+
+        def step(params, states, opt_state, t, scale_state, ins, labels,
+                 lmasks):
+            key = jax.random.fold_in(jax.random.PRNGKey(seed), t)
+            if augment is not None:
+                ins = {name: _augment_mod.maybe_augment(augment, v, t)
+                       for name, v in ins.items()}
+            scale = scale_state[0]
+
+            def loss_fn(p):
+                loss, ns = self._loss_and_reg(
+                    p, states, ins, labels, True, key,
+                    None, lmasks if with_lmasks else None)
+                return loss * scale, ns
+            (loss, new_states), grads = \
+                jax.value_and_grad(loss_fn, has_aux=True)(params)
+            inv = 1.0 / scale
+            loss = loss * inv           # listeners/score see true loss
+            grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
+            ok = _grads_all_finite(grads)
+            new_params, new_opt = _process_and_apply_grads(
+                base, updater, params, grads, opt_state,
+                t.astype(jnp.float32))
+            new_params = _select_update(ok, new_params, params)
+            new_opt = _select_update(ok, new_opt, opt_state)
+            new_states = _select_update(ok, new_states, states)
+            return (new_params, new_states, new_opt, t + 1,
+                    _dynamic_scale_next(pol, scale_state, ok), loss)
+        if steps > 1:
+            return _cc.cached_dispatch(
+                _stepping.scan_megastep(step, 5), "graph:megastep",
+                key_parts=self._compile_key_parts(steps),
+                donate_argnums=(0, 1, 2, 3, 4))
+        return _cc.cached_dispatch(
+            step, "graph:train_step", key_parts=self._compile_key_parts(1),
+            donate_argnums=(0, 1, 2, 3, 4))
+
+    def _step_for(self, sig, steps: int, n_labels: int):
+        """(compiled step, dummy mask list) for one mask signature ×
+        dispatch K — THE single lookup `_fit_one`, `_fit_mega`, and
+        `_warm_dispatch` share (see MultiLayerNetwork._step_for)."""
+        if steps > 1:
+            if (sig, steps) not in self._megastep_cache:
+                self._megastep_cache[(sig, steps)] = \
+                    self._make_train_step(sig, steps=steps)
+            return (self._megastep_cache[(sig, steps)],
+                    [jnp.zeros((steps, 1))] * n_labels)
+        if sig not in self._train_step_cache:
+            self._train_step_cache[sig] = self._make_train_step(sig)
+        return self._train_step_cache[sig], [jnp.zeros((1,))] * n_labels
+
+    def _compile_key_parts(self, steps: int = 1):
+        """Persistent-cache key parts — see MultiLayerNetwork."""
+        pol = self._precision
+        aug = self._augment
+        fp = getattr(self, "_conf_fingerprint", None)
+        if fp is None:
+            fp = self._conf_fingerprint = _cc.model_fingerprint(self)
+        return (fp,
+                pol.signature() if pol is not None else None,
+                aug.signature() if aug is not None else None,
+                steps)
+
+    def _dynamic_scaling(self) -> bool:
+        pol = self._precision
+        return pol is not None and pol.is_dynamic
+
+    def _ensure_scale_state(self):
+        """Device-resident ``[scale, good_steps]`` dynamic loss-scale
+        carry — see MultiLayerNetwork._ensure_scale_state."""
+        if self._scale_state is None:
+            self._scale_state = jnp.asarray(
+                [float(self._precision.loss_scale_init), 0.0], jnp.float32)
+        return self._scale_state
+
+    def current_loss_scale(self):
+        """Live dynamic loss scale / static scale / None — see
+        MultiLayerNetwork.current_loss_scale."""
+        if self._dynamic_scaling():
+            if self._scale_state is None:
+                return float(self._precision.loss_scale_init)
+            return float(np.asarray(jax.device_get(self._scale_state))[0])
+        pol = self._precision
+        return pol.loss_scale if pol is not None else None
 
     def _ensure_opt_state(self):
         if self._opt_state is None:
@@ -668,7 +820,8 @@ class ComputationGraph:
             self._train_step_cache.clear()
             self._megastep_cache.clear()
             self._fwd_cache = None
-        return self
+            self._scale_state = None    # dynamic loss scale restarts with
+        return self                     # its policy's init value
 
     def fit(self, data, labels=None, epochs: int = 1,
             steps_per_dispatch: int = 1, prefetch: int = 2,
@@ -700,6 +853,8 @@ class ComputationGraph:
             from deeplearning4j_tpu.train import resilience as _resilience
             session, data = _resilience.begin_session(
                 self, data, checkpoint, nan_policy, faults)
+            # resume cold-start killer — see MultiLayerNetwork.fit
+            session.warm_after_resume(steps_per_dispatch)
 
         def batches():
             if isinstance(data, DataSetIterator):
@@ -760,10 +915,7 @@ class ComputationGraph:
             _churn.array_fingerprint(
                 [ins[k] for k in sorted(ins)], labels, lmasks), owner=self)
         sig = lmasks is not None
-        if sig not in self._train_step_cache:
-            self._train_step_cache[sig] = self._make_train_step(sig)
-        step = self._train_step_cache[sig]
-        dummy = [jnp.zeros((1,))] * len(labels)
+        step, dummy = self._step_for(sig, 1, len(labels))
         # fence read at dispatch ENTRY: any elastic recovery landing after
         # this point voids the whole dispatch, hooks included
         gen = _stepping.fence_generation(self)
@@ -783,18 +935,26 @@ class ComputationGraph:
             # histogram samples this block records
             _stepping.STEPS_PER_DISPATCH.set(1)
             _stepping.TRAIN_ITERATIONS.inc()
+        dyn = self._dynamic_scaling()
         with _prof.timed_region(
                 "train:step", "dl4j_train_step_seconds",
                 "Compiled train-step dispatch time per iteration",
                 iteration=self._iteration + 1):
-            out = step(self._params, self._states, self._opt_state,
-                       self._ensure_clock(), ins, labels,
+            args = [self._params, self._states, self._opt_state,
+                    self._ensure_clock()]
+            if dyn:     # dynamic loss scale: an extra donated carry
+                args.append(self._ensure_scale_state())
+            out = step(*args, ins, labels,
                        lmasks if lmasks is not None else dummy)
         with _stepping.dispatch_commit(self, gen) as ok:
             if not ok:      # elastic recovery rolled this step back while
                 return      # the dispatch was hung: discard, no bookkeeping
-            self._params, self._states, self._opt_state, self._t_dev, loss \
-                = out
+            if dyn:
+                (self._params, self._states, self._opt_state, self._t_dev,
+                 self._scale_state, loss) = out
+            else:
+                self._params, self._states, self._opt_state, self._t_dev, \
+                    loss = out
         # on-device; score() converts lazily (per-step host sync is ~20x the
         # step cost through a high-latency device link)
         self._score = loss
@@ -831,30 +991,35 @@ class ComputationGraph:
             _churn.array_fingerprint(
                 [ins[k] for k in sorted(ins)], labels, lmasks), owner=self)
         sig = lmasks is not None
-        if (sig, k) not in self._megastep_cache:
-            self._megastep_cache[(sig, k)] = self._make_train_step(sig, steps=k)
-        step = self._megastep_cache[(sig, k)]
+        step, dummy = self._step_for(sig, k, len(labels))
         gen = _stepping.fence_generation(self)  # dispatch entry (see _fit_one)
         res = getattr(self, "_resilience", None)
         if res is not None:
             res.before_dispatch()
         tok = _sanitizer.snapshot(self, "graph_mega", ins=ins, labels=labels,
                                   lmasks=lmasks)   # see _fit_one
-        dummy = [jnp.zeros((k, 1))] * len(labels)
         if _prof.instrumentation_active():
             _stepping.STEPS_PER_DISPATCH.set(k)
+        dyn = self._dynamic_scaling()
         with _prof.timed_region(
                 "train:megastep", "dl4j_train_step_seconds",
                 "Compiled train-step dispatch time per iteration",
                 iteration=self._iteration + 1, steps=k):
-            out = step(self._params, self._states, self._opt_state,
-                       self._ensure_clock(), ins, labels,
+            args = [self._params, self._states, self._opt_state,
+                    self._ensure_clock()]
+            if dyn:     # dynamic loss scale: an extra scanned carry
+                args.append(self._ensure_scale_state())
+            out = step(*args, ins, labels,
                        lmasks if lmasks is not None else dummy)
         with _stepping.dispatch_commit(self, gen) as ok:
             if not ok:
                 return      # abandoned dispatch: see dispatch_commit
-            self._params, self._states, self._opt_state, self._t_dev, \
-                losses = out
+            if dyn:
+                (self._params, self._states, self._opt_state, self._t_dev,
+                 self._scale_state, losses) = out
+            else:
+                self._params, self._states, self._opt_state, self._t_dev, \
+                    losses = out
         _stepping.record_megastep(self, losses, k,
                                   int(next(iter(ins.values())).shape[1]),
                                   san_token=tok)

@@ -28,6 +28,7 @@ from deeplearning4j_tpu.data.dataset import (AsyncDataSetIterator, DataSet,
                                              IterableDataSetIterator)
 from deeplearning4j_tpu.evaluation.evaluation import Evaluation, RegressionEvaluation
 from deeplearning4j_tpu.nn import augment as _augment_mod
+from deeplearning4j_tpu.nn import compilecache as _cc
 from deeplearning4j_tpu.nn import layers as L
 from deeplearning4j_tpu.nn.config import MultiLayerConfiguration
 from deeplearning4j_tpu.train import stepping as _stepping
@@ -149,6 +150,47 @@ def _process_and_apply_grads(base, updater, params, grads, opt_state, t):
             jax.tree_util.tree_unflatten(treedef, new_s))
 
 
+def _grads_all_finite(grads):
+    """Scalar bool: no gradient leaf overflowed/NaN'd — the dynamic
+    loss-scaling overflow detector (shared by both network classes)."""
+    ok = jnp.asarray(True)
+    for g in jax.tree_util.tree_leaves(grads):
+        ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(g)))
+    return ok
+
+
+def _dynamic_scale_next(pol, scale_state, ok):
+    """One tick of the grow/backoff loss-scale automaton: clean step
+    advances the good-step counter (growing the scale by
+    ``growth_factor`` after ``growth_interval`` clean steps, capped at
+    ``max_loss_scale``); an overflow multiplies by ``backoff_factor``
+    (floored at ``min_loss_scale``) and zeroes the counter. Pure jnp —
+    traced inside the compiled step, shared by both network classes."""
+    scale = scale_state[0]
+    good = scale_state[1] + 1.0
+    grew = good >= float(pol.growth_interval)
+    grown = jnp.where(
+        grew,
+        jnp.minimum(scale * float(pol.growth_factor),
+                    float(pol.max_loss_scale)),
+        scale)
+    new_scale = jnp.where(
+        ok, grown,
+        jnp.maximum(scale * float(pol.backoff_factor),
+                    float(pol.min_loss_scale)))
+    new_good = jnp.where(jnp.logical_and(ok, jnp.logical_not(grew)),
+                         good, 0.0)
+    return jnp.stack([new_scale, new_good])
+
+
+def _select_update(ok, new, old):
+    """Per-leaf ``jnp.where(ok, new, old)`` over matching pytrees — how
+    an overflowed dynamic-scaling step drops its update without a
+    host round trip."""
+    return jax.tree_util.tree_map(lambda n, o: jnp.where(ok, n, o),
+                                  new, old)
+
+
 class MultiLayerNetwork:
     """Sequential network (ref: MultiLayerNetwork)."""
 
@@ -168,6 +210,7 @@ class MultiLayerNetwork:
         self._fwd_cache = None
         self._augment = None    # DeviceAugmentation (see setDeviceAugmentation)
         self._precision = None  # PrecisionPolicy (see setPrecisionPolicy)
+        self._scale_state = None  # dynamic loss scale [scale, good_steps]
         self._score = float("nan")
         self._initialized = False
 
@@ -206,6 +249,7 @@ class MultiLayerNetwork:
         self._megastep_cache = {}
         self._tbptt_step_cache = {}
         self._fwd_cache = None
+        self._scale_state = None
         self._initialized = True
         _sanitizer.invalidate(self)   # re-init = out-of-band state reset
         return self
@@ -267,8 +311,56 @@ class MultiLayerNetwork:
         if self._fwd_cache is None:
             def fwd(params, states, x, key):
                 return self._forward(params, states, x, False, key)
-            self._fwd_cache = jax.jit(fwd)
+            # behind the compile-cache seam: serving warmup (bucketed
+            # shapes, possibly under a mesh context) AOT-compiles this
+            # program and the persistent cache makes a later process's
+            # warmup a disk hit instead of an XLA compile
+            self._fwd_cache = _cc.cached_dispatch(
+                fwd, "mln:forward", key_parts=self._compile_key_parts(0))
         return self._fwd_cache
+
+    def _warm_forward(self, x) -> "MultiLayerNetwork":
+        """AOT-compile the inference forward for ``x``'s signature
+        without executing it (the ``compilecache.warmup`` seam)."""
+        self._jit_forward().warm(self._params, self._states, jnp.asarray(x),
+                                 jax.random.PRNGKey(0))
+        return self
+
+    def _step_for(self, sig, steps: int = 1):
+        """(compiled step, dummy mask) for one mask signature × dispatch
+        K — THE single lookup `_fit_one`, `_fit_mega`, and
+        `_warm_dispatch` share, so a warmed signature can never drift
+        from what the real dispatch path builds."""
+        if steps > 1:
+            if (sig, steps) not in self._megastep_cache:
+                self._megastep_cache[(sig, steps)] = \
+                    self._make_train_step(*sig, steps=steps)
+            return self._megastep_cache[(sig, steps)], jnp.zeros((steps, 1))
+        if sig not in self._train_step_cache:
+            self._train_step_cache[sig] = self._make_train_step(*sig)
+        return self._train_step_cache[sig], jnp.zeros((1,))
+
+    def _warm_dispatch(self, x, y, fmask=None, lmask=None,
+                       steps: int = 1) -> "MultiLayerNetwork":
+        """AOT-compile the train step (or K-step megastep) for this batch
+        signature without executing it — no params/opt/RNG state is
+        touched (``CachedDispatch.warm`` only lowers and compiles).
+        ``steps>1`` expects ``[K, B, ...]`` stacked arrays."""
+        self._ensure_opt_state()
+        x = jnp.asarray(x)
+        y = jnp.asarray(y)
+        fmask = jnp.asarray(fmask) if fmask is not None else None
+        lmask = jnp.asarray(lmask) if lmask is not None else None
+        sig = (fmask is not None, lmask is not None)
+        step, dummy = self._step_for(sig, steps)
+        clock = jnp.asarray(self._iteration, jnp.int32)
+        args = [self._params, self._states, self._opt_state, clock]
+        if self._dynamic_scaling():
+            args.append(self._ensure_scale_state())
+        args += [x, y, fmask if fmask is not None else dummy,
+                 lmask if lmask is not None else dummy]
+        step.warm(*args)
+        return self
 
     # ------------------------------------------------------------------ loss
     def _loss_and_reg(self, params, states, x, y, train, key, fmask, lmask):
@@ -314,6 +406,10 @@ class MultiLayerNetwork:
         # tiny fp16 gradient tail survives the backward pass while the
         # updater still sees true-magnitude fp32 gradients
         pol = self._precision
+        if pol is not None and pol.is_dynamic:
+            return self._make_dynamic_train_step(steps=steps,
+                                                 with_fmask=with_fmask,
+                                                 with_lmask=with_lmask)
         loss_scale = pol.loss_scale if pol is not None else None
 
         def step(params, states, opt_state, t, x, y, fmask, lmask):
@@ -352,11 +448,124 @@ class MultiLayerNetwork:
             return new_params, new_states, new_opt, t + 1, loss
         # donate params/states/opt_state/t: consumed and replaced each step;
         # donation also lets dependent dispatches pipeline instead of
-        # round-tripping per step on relayed TPU backends
+        # round-tripping per step on relayed TPU backends. The jit sits
+        # behind the compile-cache seam (nn.compilecache): plain jit
+        # dispatch until the persistent/AOT cache is engaged.
         if steps > 1:
-            return jax.jit(_stepping.scan_megastep(step, 4),
-                           donate_argnums=(0, 1, 2, 3))
-        return jax.jit(step, donate_argnums=(0, 1, 2, 3))
+            return _cc.cached_dispatch(
+                _stepping.scan_megastep(step, 4), "mln:megastep",
+                key_parts=self._compile_key_parts(steps),
+                donate_argnums=(0, 1, 2, 3))
+        return _cc.cached_dispatch(
+            step, "mln:train_step", key_parts=self._compile_key_parts(1),
+            donate_argnums=(0, 1, 2, 3))
+
+    def _make_dynamic_train_step(self, steps: int, with_fmask: bool,
+                                 with_lmask: bool):
+        """The train step under ``PrecisionPolicy(loss_scale="dynamic")``
+        — the fp16 survival kit upgraded from a fixed constant to the
+        standard grow/backoff automaton, entirely inside the compiled
+        program (no per-step host sync):
+
+        - grads come back through the scaled backward; a non-finite
+          gradient anywhere means the scale overflowed the fp16 range —
+          the update (params, opt state, layer states) is DROPPED via
+          ``jnp.where`` selects and the scale multiplies by
+          ``backoff_factor``.
+        - every clean step advances a good-step counter; after
+          ``growth_interval`` consecutive clean steps the scale grows by
+          ``growth_factor`` (probing the headroom back).
+
+        The scale state ``[scale, good_steps]`` is a donated carry like
+        the params — it threads through the lax.scan megastep and is
+        persisted/restored by resilience checkpoints. With no overflow
+        and a huge growth interval this is bit-exact with the static
+        scale of the same value (pinned)."""
+        base = self.conf.base
+        updater = base.updater
+        frozen = getattr(self, "_frozen_layers", None) or set()
+        seed = base.seed
+        augment = self._augment
+        pol = self._precision
+
+        def step(params, states, opt_state, t, scale_state, x, y, fmask,
+                 lmask):
+            key = jax.random.fold_in(jax.random.PRNGKey(seed), t)
+            x = _augment_mod.maybe_augment(augment, x, t)
+            tf = t.astype(jnp.float32)
+            scale = scale_state[0]
+
+            def loss_fn(p):
+                loss, ns = self._loss_and_reg(p, states, x, y, True, key,
+                                              fmask if with_fmask else None,
+                                              lmask if with_lmask else None)
+                return loss * scale, ns
+            (loss, new_states), grads = \
+                jax.value_and_grad(loss_fn, has_aux=True)(params)
+            inv = 1.0 / scale
+            loss = loss * inv           # listeners/score see true loss
+            grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
+            # overflow detection on the UNSCALED grads: any non-finite
+            # leaf anywhere = the scaled backward left fp16 range
+            ok = _grads_all_finite(grads)
+            new_params, new_opt = _process_and_apply_grads(
+                base, updater, params, grads, opt_state, tf)
+            new_params = _select_update(ok, new_params, params)
+            new_opt = _select_update(ok, new_opt, opt_state)
+            new_states = _select_update(ok, new_states, states)
+            if frozen:
+                new_params = [params[i] if i in frozen else new_params[i]
+                              for i in range(len(params))]
+                new_opt = [opt_state[i] if i in frozen else new_opt[i]
+                           for i in range(len(opt_state))]
+            return (new_params, new_states, new_opt, t + 1,
+                    _dynamic_scale_next(pol, scale_state, ok), loss)
+        if steps > 1:
+            return _cc.cached_dispatch(
+                _stepping.scan_megastep(step, 5), "mln:megastep",
+                key_parts=self._compile_key_parts(steps),
+                donate_argnums=(0, 1, 2, 3, 4))
+        return _cc.cached_dispatch(
+            step, "mln:train_step", key_parts=self._compile_key_parts(1),
+            donate_argnums=(0, 1, 2, 3, 4))
+
+    def _compile_key_parts(self, steps: int = 1):
+        """Explicit persistent-cache key parts next to the content hash:
+        model architecture fingerprint, precision-policy and augmentation
+        signatures, frozen set, and the dispatch's K."""
+        pol = self._precision
+        aug = self._augment
+        fp = getattr(self, "_conf_fingerprint", None)
+        if fp is None:
+            fp = self._conf_fingerprint = _cc.model_fingerprint(self)
+        return (fp,
+                pol.signature() if pol is not None else None,
+                aug.signature() if aug is not None else None,
+                tuple(sorted(getattr(self, "_frozen_layers", None) or ())),
+                steps)
+
+    def _dynamic_scaling(self) -> bool:
+        pol = self._precision
+        return pol is not None and pol.is_dynamic
+
+    def _ensure_scale_state(self):
+        """Device-resident ``[scale, good_steps]`` carry for dynamic loss
+        scaling (donated/replaced by the compiled step, persisted by
+        resilience checkpoints)."""
+        if self._scale_state is None:
+            self._scale_state = jnp.asarray(
+                [float(self._precision.loss_scale_init), 0.0], jnp.float32)
+        return self._scale_state
+
+    def current_loss_scale(self):
+        """The live dynamic loss scale (host float), or the static scale,
+        or None when the attached policy scales nothing."""
+        if self._dynamic_scaling():
+            if self._scale_state is None:
+                return float(self._precision.loss_scale_init)
+            return float(np.asarray(jax.device_get(self._scale_state))[0])
+        pol = self._precision
+        return pol.loss_scale if pol is not None else None
 
     def _ensure_opt_state(self):
         if self._opt_state is None:
@@ -416,7 +625,8 @@ class MultiLayerNetwork:
             self._megastep_cache.clear()
             self._tbptt_step_cache = {}
             self._fwd_cache = None
-        return self
+            self._scale_state = None    # dynamic loss scale restarts with
+        return self                     # its policy's init value
 
     def fit(self, data, labels=None, epochs: int = 1,
             steps_per_dispatch: int = 1, prefetch: int = 2,
@@ -493,6 +703,9 @@ class MultiLayerNetwork:
             from deeplearning4j_tpu.train import resilience as _resilience
             session, data = _resilience.begin_session(
                 self, data, checkpoint, nan_policy, faults)
+            # resume cold-start killer: AOT-warm the step the restored
+            # checkpoint recorded (persistent-cache-gated no-op otherwise)
+            session.warm_after_resume(steps_per_dispatch)
 
         def batches():
             if isinstance(data, DataSetIterator):
@@ -557,10 +770,7 @@ class MultiLayerNetwork:
             "MultiLayerNetwork.fit",
             _churn.array_fingerprint(x, y, fmask, lmask), owner=self)
         sig = (fmask is not None, lmask is not None)
-        if sig not in self._train_step_cache:
-            self._train_step_cache[sig] = self._make_train_step(*sig)
-        step = self._train_step_cache[sig]
-        dummy = jnp.zeros((1,))
+        step, dummy = self._step_for(sig)
         # fence read at dispatch ENTRY: any elastic recovery landing after
         # this point voids the whole dispatch, hooks included
         gen = _stepping.fence_generation(self)
@@ -589,19 +799,27 @@ class MultiLayerNetwork:
             # left it at K)
             _stepping.STEPS_PER_DISPATCH.set(1)
             _stepping.TRAIN_ITERATIONS.inc()
+        dyn = self._dynamic_scaling()
         with _prof.timed_region(
                 "train:step", "dl4j_train_step_seconds",
                 "Compiled train-step dispatch time per iteration",
                 iteration=self._iteration + 1):
-            out = step(self._params, self._states, self._opt_state,
-                       self._ensure_clock(), x, y,
+            args = [self._params, self._states, self._opt_state,
+                    self._ensure_clock()]
+            if dyn:     # dynamic loss scale: an extra donated carry
+                args.append(self._ensure_scale_state())
+            out = step(*args, x, y,
                        fmask if fmask is not None else dummy,
                        lmask if lmask is not None else dummy)
         with _stepping.dispatch_commit(self, gen) as ok:
             if not ok:      # elastic recovery rolled this step back while
                 return      # the dispatch was hung: discard, no bookkeeping
-            self._params, self._states, self._opt_state, self._t_dev, loss \
-                = out
+            if dyn:
+                (self._params, self._states, self._opt_state, self._t_dev,
+                 self._scale_state, loss) = out
+            else:
+                self._params, self._states, self._opt_state, self._t_dev, \
+                    loss = out
         # keep the loss on-device: a float() here would block on the whole
         # step through the (high-latency) host<->device link every iteration;
         # score() converts lazily when someone actually asks
@@ -634,31 +852,36 @@ class MultiLayerNetwork:
             "MultiLayerNetwork.megastep",
             _churn.array_fingerprint(x, y, fmask, lmask), owner=self)
         sig = (fmask is not None, lmask is not None)
-        if (sig, k) not in self._megastep_cache:
-            self._megastep_cache[(sig, k)] = self._make_train_step(*sig, steps=k)
-        step = self._megastep_cache[(sig, k)]
+        step, dummy = self._step_for(sig, k)
         gen = _stepping.fence_generation(self)  # dispatch entry (see _fit_one)
         res = getattr(self, "_resilience", None)
         if res is not None:
             res.before_dispatch()
         tok = _sanitizer.snapshot(self, "mega", x=x, y=y, fmask=fmask,
                                   lmask=lmask)   # see _fit_one
-        dummy = jnp.zeros((k, 1))
         if _prof.instrumentation_active():
             _stepping.STEPS_PER_DISPATCH.set(k)
+        dyn = self._dynamic_scaling()
         with _prof.timed_region(
                 "train:megastep", "dl4j_train_step_seconds",
                 "Compiled train-step dispatch time per iteration",
                 iteration=self._iteration + 1, steps=k):
-            out = step(self._params, self._states, self._opt_state,
-                       self._ensure_clock(), x, y,
+            args = [self._params, self._states, self._opt_state,
+                    self._ensure_clock()]
+            if dyn:     # dynamic loss scale: an extra scanned carry
+                args.append(self._ensure_scale_state())
+            out = step(*args, x, y,
                        fmask if fmask is not None else dummy,
                        lmask if lmask is not None else dummy)
         with _stepping.dispatch_commit(self, gen) as ok:
             if not ok:
                 return      # abandoned dispatch: see dispatch_commit
-            self._params, self._states, self._opt_state, self._t_dev, \
-                losses = out
+            if dyn:
+                (self._params, self._states, self._opt_state, self._t_dev,
+                 self._scale_state, losses) = out
+            else:
+                self._params, self._states, self._opt_state, self._t_dev, \
+                    losses = out
         _stepping.record_megastep(self, losses, k, int(x.shape[1]),
                                   san_token=tok)
 

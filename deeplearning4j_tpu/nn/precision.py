@@ -67,22 +67,56 @@ def normalize_dtype(name) -> str:
 class PrecisionPolicy:
     """Declarative mixed-precision policy: ``compute`` is the dtype
     matmul/conv layers run in on the MXU, ``params`` the master-weight
-    (and updater-state) dtype, ``loss_scale`` an optional static loss
-    scaling factor.  ``PrecisionPolicy("bfloat16")`` is the TPU-native
-    mixed policy: bf16 compute, fp32 masters, no scale."""
+    (and updater-state) dtype, ``loss_scale`` an optional loss scaling
+    factor — a static float, or the string ``"dynamic"`` for the
+    grow/backoff automaton (the fp16 default recipe: start at
+    ``loss_scale_init``, multiply by ``backoff_factor`` on a gradient
+    overflow — that step's update is dropped — and by ``growth_factor``
+    after ``growth_interval`` consecutive clean steps). The dynamic
+    scale lives on device inside the compiled step and is carried
+    through resilience checkpoints. ``PrecisionPolicy("bfloat16")`` is
+    the TPU-native mixed policy: bf16 compute, fp32 masters, no scale."""
 
-    __slots__ = ("compute", "params", "loss_scale")
+    __slots__ = ("compute", "params", "loss_scale", "loss_scale_init",
+                 "growth_interval", "growth_factor", "backoff_factor",
+                 "min_loss_scale", "max_loss_scale")
+
+    DYNAMIC = "dynamic"
 
     def __init__(self, compute: str = "float32", params: str = "float32",
-                 loss_scale: Optional[float] = None):
+                 loss_scale=None, loss_scale_init: float = 2.0 ** 15,
+                 growth_interval: int = 2000, growth_factor: float = 2.0,
+                 backoff_factor: float = 0.5,
+                 min_loss_scale: float = 2.0 ** -14,
+                 max_loss_scale: float = 2.0 ** 24):
         self.compute = normalize_dtype(compute)
         self.params = normalize_dtype(params)
-        if loss_scale is not None:
+        if isinstance(loss_scale, str):
+            if loss_scale.strip().lower() != self.DYNAMIC:
+                raise ValueError(
+                    f"loss_scale={loss_scale!r}: the only string value is "
+                    f"'{self.DYNAMIC}' (or pass a static float)")
+            loss_scale = self.DYNAMIC
+        elif loss_scale is not None:
             loss_scale = float(loss_scale)
             if loss_scale <= 0:
                 raise ValueError(
                     f"loss_scale must be positive, got {loss_scale}")
         self.loss_scale = loss_scale
+        self.loss_scale_init = float(loss_scale_init)
+        self.growth_interval = int(growth_interval)
+        self.growth_factor = float(growth_factor)
+        self.backoff_factor = float(backoff_factor)
+        self.min_loss_scale = float(min_loss_scale)
+        self.max_loss_scale = float(max_loss_scale)
+        if self.loss_scale == self.DYNAMIC:
+            if self.loss_scale_init <= 0 or self.growth_factor <= 1.0 \
+                    or not (0.0 < self.backoff_factor < 1.0) \
+                    or self.growth_interval < 1:
+                raise ValueError(
+                    "dynamic loss scaling needs loss_scale_init > 0, "
+                    "growth_factor > 1, 0 < backoff_factor < 1, and "
+                    "growth_interval >= 1")
 
     # ---------------------------------------------------------- coercion
     @staticmethod
@@ -119,6 +153,21 @@ class PrecisionPolicy:
     def is_low_precision(self) -> bool:
         return self.compute in LOW_PRECISION
 
+    @property
+    def is_dynamic(self) -> bool:
+        """True when ``loss_scale="dynamic"`` — the runtime threads a
+        device-resident scale automaton through the compiled step."""
+        return self.loss_scale == self.DYNAMIC
+
+    def numeric_loss_scale(self) -> Optional[float]:
+        """The scale value static analysis should reason with: the
+        static factor, the dynamic automaton's INITIAL value (its
+        worst-case overflow exposure — backoff only shrinks it), or
+        None when nothing scales."""
+        if self.is_dynamic:
+            return self.loss_scale_init
+        return self.loss_scale
+
     def compute_max(self) -> float:
         return DTYPE_MAX[self.compute]
 
@@ -127,7 +176,14 @@ class PrecisionPolicy:
 
     def signature(self):
         """Hashable identity for the networks' signature()-keyed step
-        caches: two equal policies share every compiled program."""
+        caches: two equal policies share every compiled program. The
+        dynamic-scaling knobs are traced constants, so they join the
+        signature exactly when the policy is dynamic."""
+        if self.is_dynamic:
+            return (self.compute, self.params, self.loss_scale,
+                    self.loss_scale_init, self.growth_interval,
+                    self.growth_factor, self.backoff_factor,
+                    self.min_loss_scale, self.max_loss_scale)
         return (self.compute, self.params, self.loss_scale)
 
     # ----------------------------------------------------------- runtime
@@ -141,8 +197,16 @@ class PrecisionPolicy:
         return {"bfloat16": jnp.bfloat16, "float16": jnp.float16}[self.compute]
 
     def to_config(self):
-        return {"compute": self.compute, "params": self.params,
-                "loss_scale": self.loss_scale}
+        out = {"compute": self.compute, "params": self.params,
+               "loss_scale": self.loss_scale}
+        if self.is_dynamic:
+            out.update(loss_scale_init=self.loss_scale_init,
+                       growth_interval=self.growth_interval,
+                       growth_factor=self.growth_factor,
+                       backoff_factor=self.backoff_factor,
+                       min_loss_scale=self.min_loss_scale,
+                       max_loss_scale=self.max_loss_scale)
+        return out
 
     @staticmethod
     def from_config(d):

@@ -474,7 +474,7 @@ def fit_elastic(wrapper, iterator, epochs: int = 1,
                         f"{shrinks} mesh shrinks exceed max_shrinks="
                         f"{cfg.max_shrinks} — giving up") from e
                 _shrink_and_resume(wrapper, model, session, stream_iter, e,
-                                   cfg, coordinator)
+                                   cfg, coordinator, steps_per_dispatch=k)
     finally:
         model._dispatch_fence = None
         session.close(raise_errors=sys.exc_info()[1] is None)
@@ -574,7 +574,8 @@ def _check_health(monitor, mesh: DeviceMesh, step: int, cause=None):
 
 def _shrink_and_resume(wrapper, model, session, iterator,
                        loss: DeviceLossError, cfg: ElasticConfig,
-                       coordinator: CoordinationService):
+                       coordinator: CoordinationService,
+                       steps_per_dispatch: int = 1):
     """The coordinated shrink: barrier -> checkpoint -> smaller mesh ->
     revalidate -> LR rescale -> restore + data-pipeline rebind."""
     t0 = time.perf_counter()
@@ -656,12 +657,55 @@ def _shrink_and_resume(wrapper, model, session, iterator,
                 "replaying the interrupted epoch from its start",
                 stacklevel=2)
     wrapper.mesh = new_mesh
+    # 6. survivor-mesh warmup through the unified compile-cache seam:
+    #    with the persistent cache configured, a survivor layout any
+    #    earlier run (or process) already compiled deserializes from
+    #    disk, so the post-shrink first dispatch is a read, not an XLA
+    #    compile. Best-effort — a warm miss just compiles as before.
+    _warm_survivor_mesh(wrapper, model, session, new_mesh,
+                        steps_per_dispatch)
     MESH_SHRINKS.inc()
     dt = time.perf_counter() - t0
     RECOVERY_SECONDS.observe(dt)
     logger.info("mesh shrink complete in %.3fs: data axis %d -> %d, "
                 "resuming from step %d", dt, old_data,
                 len(loss.surviving), model._iteration)
+
+
+def _warm_survivor_mesh(wrapper, model, session, new_mesh: DeviceMesh,
+                        k: int) -> None:
+    """AOT-warm the train step for the shrunk layout (module step 6):
+    rebuild a zero batch from the checkpoint-recorded batch signature,
+    pad + stage it exactly like the dispatch loop will (wrapper._pad +
+    _mesh_placement), and compile WITHOUT executing. Gated on the
+    persistent cache being configured — without it the first post-shrink
+    dispatch compiles under the watchdog's warmup leniency exactly as
+    before. Never raises: recovery must not die warming."""
+    from deeplearning4j_tpu.nn import compilecache as _cc
+    if _cc.cache_dir() is None:
+        return
+    sig = getattr(session, "_last_batch_sig", None)
+    if not sig:
+        return
+    try:
+        from deeplearning4j_tpu.data.dataset import DataSet, stage_item
+        from deeplearning4j_tpu.train.stepping import stack_megabatch
+        f, lab = sig["features"], sig["labels"]
+        ds = DataSet(np.zeros(tuple(f[0]), np.dtype(f[1])),
+                     np.zeros(tuple(lab[0]), np.dtype(lab[1])))
+        ds = wrapper._pad(ds)
+        item = stage_item(stack_megabatch([ds] * k) if k > 1 else ds,
+                          wrapper._mesh_placement)
+        with new_mesh:
+            model._warm_dispatch(item.features, item.labels,
+                                 fmask=getattr(item, "features_mask", None),
+                                 lmask=getattr(item, "labels_mask", None),
+                                 steps=k)
+        logger.info("elastic shrink: survivor-mesh train step warmed "
+                    "through the compile cache (k=%d)", k)
+    except Exception as e:
+        warnings.warn(f"elastic shrink: survivor-mesh warmup skipped "
+                      f"({type(e).__name__}: {e})", stacklevel=2)
 
 
 def _revalidate_shrink(model, session, new_mesh: DeviceMesh):

@@ -142,13 +142,15 @@ class _ModelSan:
     enough to roll the snapshot forward to ANY step in the window
     through the model's own compiled step programs."""
 
-    __slots__ = ("params", "states", "opt_state", "snap_step",
-                 "expected_next", "ring")
+    __slots__ = ("params", "states", "opt_state", "scale_state",
+                 "snap_step", "expected_next", "ring")
 
-    def __init__(self, params, states, opt_state, snap_step):
+    def __init__(self, params, states, opt_state, snap_step,
+                 scale_state=None):
         self.params = params
         self.states = states
         self.opt_state = opt_state
+        self.scale_state = scale_state   # dynamic loss-scale carry (or None)
         self.snap_step = snap_step
         self.expected_next = snap_step
         self.ring: list = []      # (kind, batch dict, start_iter, steps)
@@ -207,9 +209,16 @@ def snapshot(model, kind: str, **batch) -> Optional[_Token]:
     if st is None or st.expected_next != it \
             or it - st.snap_step >= _SNAPSHOT_EVERY:
         from deeplearning4j_tpu.train.resilience import _device_copy
-        params, states, opt = _device_copy(
-            (model._params, model._states, model._opt_state))
-        st = _ModelSan(params, states, opt, it)
+        if getattr(model, "_dynamic_scaling", lambda: False)():
+            # materialize the loss-scale carry BEFORE copying: the fit
+            # paths snapshot first and ensure the carry just before
+            # dispatch, so the first window would otherwise record None
+            # and the replay would roll from the wrong (live) scale
+            model._ensure_scale_state()
+        params, states, opt, scale = _device_copy(
+            (model._params, model._states, model._opt_state,
+             getattr(model, "_scale_state", None)))
+        st = _ModelSan(params, states, opt, it, scale_state=scale)
         _STATES[model] = st
     batch = dict(batch)
     st.ring.append((kind, batch, it, _steps_of(kind, batch)))
@@ -285,14 +294,31 @@ def _tree_bad(tree, bad) -> bool:
 
 
 def _roll_dispatch(model, kind: str, batch: dict, start_it: int,
-                   n_steps: int, params, states, opt):
-    """Advance (params, states, opt) ``n_steps`` update steps through
-    the model's OWN compiled single-step program.  For megastep
-    dispatches the scanned body is byte-identical to the single-step
-    body, so j single steps over the K slices == j scanned steps."""
+                   n_steps: int, params, states, opt, scale=None):
+    """Advance (params, states, opt[, dynamic loss-scale carry])
+    ``n_steps`` update steps through the model's OWN compiled
+    single-step program.  For megastep dispatches the scanned body is
+    byte-identical to the single-step body, so j single steps over the
+    K slices == j scanned steps."""
     import jax.numpy as jnp
+    dyn = getattr(model, "_dynamic_scaling", lambda: False)()
+    if dyn and scale is None:
+        # snapshot predates the automaton's first materialization: roll
+        # from a COPY of the live carry — the compiled step donates its
+        # scale argument, and donating the training loop's own buffer
+        # would delete it out from under the next real dispatch
+        from deeplearning4j_tpu.train.resilience import _device_copy
+        scale = _device_copy(model._ensure_scale_state())
+
+    def run(step, *args):
+        nonlocal params, states, opt, scale
+        if dyn:
+            params, states, opt, _, scale, _ = step(
+                params, states, opt, args[0], scale, *args[1:])
+        else:
+            params, states, opt, _, _ = step(params, states, opt, *args)
     if n_steps <= 0:
-        return params, states, opt
+        return params, states, opt, scale
     if kind in ("single", "mega"):
         mega = kind == "mega"
         b = batch
@@ -303,8 +329,7 @@ def _roll_dispatch(model, kind: str, batch: dict, start_it: int,
         dummy = jnp.zeros((1,))
         for i in range(n_steps):
             sel = (lambda a: a[i]) if mega else (lambda a: a)
-            params, states, opt, _, _ = step(
-                params, states, opt,
+            run(step,
                 jnp.asarray(start_it + i, jnp.int32),
                 sel(b["x"]), sel(b["y"]),
                 sel(b["fmask"]) if b.get("fmask") is not None else dummy,
@@ -323,11 +348,10 @@ def _roll_dispatch(model, kind: str, batch: dict, start_it: int,
             labels_i = [sel(a) for a in b["labels"]]
             lm_i = [sel(m) for m in b["lmasks"]] \
                 if b.get("lmasks") is not None else dummy
-            params, states, opt, _, _ = step(
-                params, states, opt,
+            run(step,
                 jnp.asarray(start_it + i, jnp.int32),
                 ins_i, labels_i, lm_i)
-    return params, states, opt
+    return params, states, opt, scale
 
 
 def _attribute(model, token: _Token, j: int) -> Tuple[str, str]:
@@ -341,11 +365,14 @@ def _attribute(model, token: _Token, j: int) -> Tuple[str, str]:
     if _STATES is not None:
         _STATES.pop(model, None)
     params, states, opt = st.params, st.states, st.opt_state
+    scale = st.scale_state
     for kind_i, batch_i, it_i, steps_i in st.ring[:token.ring_index]:
-        params, states, opt = _roll_dispatch(
-            model, kind_i, batch_i, it_i, steps_i, params, states, opt)
-    params, states, opt = _roll_dispatch(
-        model, token.kind, token.batch, token.step0, j, params, states, opt)
+        params, states, opt, scale = _roll_dispatch(
+            model, kind_i, batch_i, it_i, steps_i, params, states, opt,
+            scale)
+    params, states, opt, scale = _roll_dispatch(
+        model, token.kind, token.batch, token.step0, j, params, states, opt,
+        scale)
     t = token.step0 + j
     b = token.batch
     if token.kind in ("single", "mega"):
@@ -353,14 +380,15 @@ def _attribute(model, token: _Token, j: int) -> Tuple[str, str]:
         return _attribute_multilayer(
             model, params, states, opt, t, idx(b["x"]), idx(b["y"]),
             idx(b["fmask"]) if b.get("fmask") is not None else None,
-            idx(b["lmask"]) if b.get("lmask") is not None else None)
+            idx(b["lmask"]) if b.get("lmask") is not None else None,
+            scale_state=scale)
     idx = (lambda a: a[j]) if token.kind == "graph_mega" else (lambda a: a)
     return _attribute_graph(
         model, params, states, opt, t,
         {k: idx(v) for k, v in b["ins"].items()},
         [idx(a) for a in b["labels"]],
         [idx(m) for m in b["lmasks"]] if b.get("lmasks") is not None
-        else None)
+        else None, scale_state=scale)
 
 
 # ------------------------------------------------- shared eager walkers
@@ -436,7 +464,7 @@ def _walk_graph(model, params, states, env, t, train):
 
 
 def _attribute_multilayer(model, params, states, opt, t, x, y, fmask,
-                          lmask) -> Tuple[str, str]:
+                          lmask, scale_state=None) -> Tuple[str, str]:
     """First-nonfinite site over the shared multilayer walk."""
     import jax.numpy as jnp
     from deeplearning4j_tpu.nn import augment as _augment_mod
@@ -462,11 +490,11 @@ def _attribute_multilayer(model, params, states, opt, t, x, y, fmask,
     if bad(loss):
         return head_name, f"loss:{getattr(model.layers[-1], 'loss_fn', '?')}"
     return _grad_site_mln(model, params, states, opt, t, x_step, y, fmask,
-                          lmask)
+                          lmask, scale_state=scale_state)
 
 
 def _attribute_graph(model, params, states, opt, t, ins, labels,
-                     lmasks) -> Tuple[str, str]:
+                     lmasks, scale_state=None) -> Tuple[str, str]:
     """First-nonfinite site over the shared graph walk."""
     import jax.numpy as jnp
     from deeplearning4j_tpu.nn import augment as _augment_mod
@@ -498,7 +526,7 @@ def _attribute_graph(model, params, states, opt, t, ins, labels,
         if bad(loss):
             return name, f"loss:{getattr(node.obj, 'loss_fn', '?')}"
     return _grad_site_graph(model, params, states, opt, t, ins, labels,
-                            lmasks)
+                            lmasks, scale_state=scale_state)
 
 
 # ------------------------------------------------- backward/updater sites
@@ -512,17 +540,32 @@ def _first_bad_leaf(tree, names, bad) -> Optional[str]:
     return None
 
 
-def _loss_scale_of(model):
+def _loss_scale_of(model, scale_state=None):
+    """The scale the eager grad walk should apply: static policies use
+    their constant; dynamic policies use ``scale_state`` — the carry
+    the attribution replay rolled to, threaded explicitly from
+    ``_attribute`` — falling back to the model's live automaton (and
+    finally the policy's init value)."""
     pol = getattr(model, "_precision", None)
-    return pol.loss_scale if pol is not None else None
+    if pol is None:
+        return None
+    if pol.is_dynamic:
+        if scale_state is None:
+            scale_state = getattr(model, "_scale_state", None)
+        if scale_state is None:
+            return float(pol.loss_scale_init)
+        import jax
+        import numpy as np
+        return float(np.asarray(jax.device_get(scale_state))[0])
+    return pol.loss_scale
 
 
 def _grad_site_mln(model, params, states, opt, t, x, y, fmask,
-                   lmask) -> Tuple[str, str]:
+                   lmask, scale_state=None) -> Tuple[str, str]:
     import jax
     import jax.numpy as jnp
     bad = _bad_fn()
-    scale = _loss_scale_of(model)
+    scale = _loss_scale_of(model, scale_state)
     key = jax.random.fold_in(jax.random.PRNGKey(model.conf.base.seed),
                              jnp.asarray(t, jnp.int32))
 
@@ -545,7 +588,7 @@ def _grad_site_mln(model, params, states, opt, t, x, y, fmask,
 
 
 def _grad_site_graph(model, params, states, opt, t, ins, labels,
-                     lmasks) -> Tuple[str, str]:
+                     lmasks, scale_state=None) -> Tuple[str, str]:
     import jax
     import jax.numpy as jnp
     bad = _bad_fn()
@@ -553,7 +596,7 @@ def _grad_site_graph(model, params, states, opt, t, ins, labels,
                              jnp.asarray(t, jnp.int32))
     ins_j = {k: jnp.asarray(v) for k, v in ins.items()}
     labels_j = [jnp.asarray(a) for a in labels]
-    scale = _loss_scale_of(model)
+    scale = _loss_scale_of(model, scale_state)
 
     def loss_fn(p):
         loss = model._loss_and_reg(p, states, ins_j, labels_j, True, key,

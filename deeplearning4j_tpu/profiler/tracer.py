@@ -17,6 +17,11 @@ Design:
   checked before ANY allocation; a disabled span is one attribute read.
 - Completed spans go into a bounded ring buffer (oldest evicted first) so
   a long training run cannot grow host memory without bound.
+- ``stream_to(path)`` additionally appends every span to a Chrome-trace
+  JSON file AS IT COMPLETES — spans past the ring-buffer horizon live on
+  disk instead of silently dropping, so a multi-hour fit's first epoch
+  is still in the trace (``stop_stream()`` finalizes the file; a killed
+  process leaves a truncated array Perfetto still loads).
 - Export is Chrome Trace Event Format JSON ("X" complete events + "M"
   thread-name metadata), loadable in Perfetto (ui.perfetto.dev) and
   chrome://tracing.
@@ -43,6 +48,10 @@ _ENABLED = False
 # one monotonic epoch per process so spans from every thread share a
 # timebase (Chrome trace ts is in microseconds from an arbitrary origin)
 _EPOCH_NS = time.perf_counter_ns()
+
+# streamed-trace flush cadence: every N events (the file is also closed
+# cleanly by stop_stream; a killed process loses at most one buffer)
+_STREAM_FLUSH_EVERY = 256
 
 
 def enable_tracing() -> None:
@@ -77,6 +86,12 @@ class SpanTracer:
         self._events: deque = deque(maxlen=capacity)
         self._lock = threading.Lock()
         self._tls = threading.local()   # per-thread open-span stack
+        self._stream = None             # open file: see stream_to()
+        self._stream_path: Optional[str] = None
+        self._stream_count = 0
+        self._stream_tids: set = set()  # every (pid, tid) EVER streamed —
+        # the ring may have evicted a thread's spans by stop_stream time,
+        # but its thread_name metadata must still land in the file
 
     # ------------------------------------------------------------- recording
     def _stack(self) -> list:
@@ -110,6 +125,27 @@ class SpanTracer:
             ev.setdefault("args", {})["depth"] = depth
         with self._lock:
             self._events.append(ev)
+            if self._stream is not None:
+                # streamed BEFORE ring eviction can drop it: long fits
+                # keep every span on disk while host memory stays bounded
+                try:
+                    prefix = ",\n" if self._stream_count else ""
+                    self._stream.write(prefix + json.dumps(ev))
+                    self._stream_count += 1
+                    self._stream_tids.add((ev["pid"], ev["tid"]))
+                    if self._stream_count % _STREAM_FLUSH_EVERY == 0:
+                        self._stream.flush()
+                except OSError as e:
+                    stream, self._stream = self._stream, None
+                    try:
+                        stream.close()
+                    except OSError:
+                        pass
+                    import warnings
+                    warnings.warn(
+                        f"trace stream to {self._stream_path} failed "
+                        f"({e}) — streaming disabled, ring buffer "
+                        "retention continues", stacklevel=3)
 
     def current_depth(self) -> int:
         """Open-span nesting depth on the calling thread."""
@@ -149,6 +185,76 @@ class SpanTracer:
             with open(path, "w") as f:
                 f.write(doc)
         return doc
+
+    # ------------------------------------------------------------- streaming
+    def stream_to(self, path: str) -> "SpanTracer":
+        """Append every completed span to ``path`` as it is recorded —
+        the disk-resident escape hatch from the ring buffer's horizon: a
+        long fit's early spans survive on disk after the ring evicted
+        them. The file is the Chrome Trace Event JSON-array format
+        (Perfetto loads a truncated array from a killed process too);
+        :meth:`stop_stream` terminates it properly with the thread-name
+        metadata. Idempotent per path; a second call with a different
+        path closes the first stream."""
+        with self._lock:
+            if self._stream is not None:
+                if self._stream_path == path:
+                    return self
+                self._close_stream_locked()
+            f = open(path, "w", buffering=1 << 16)
+            f.write("[\n")
+            self._stream = f
+            self._stream_path = path
+            self._stream_count = 0
+            self._stream_tids = set()
+        return self
+
+    def stop_stream(self) -> Optional[str]:
+        """Finish the streamed trace (thread-name metadata + closing
+        bracket) and close the file. Returns the path, or None when no
+        stream was active."""
+        with self._lock:
+            return self._close_stream_locked()
+
+    def _close_stream_locked(self) -> Optional[str]:
+        # contract: caller holds self._lock (the _locked suffix) — the
+        # static linter cannot see a caller-held lock, hence the noqas
+        if self._stream is None:
+            return None
+        path, stream = self._stream_path, self._stream
+        self._stream = None               # dl4j: noqa=E201
+        self._stream_path = None          # dl4j: noqa=E201
+        try:
+            # every (pid, tid) that EVER streamed — not just the ring's
+            # survivors: early-epoch threads whose spans aged out of the
+            # ring still get their Perfetto row labelled
+            seen = set(self._stream_tids)
+            self._stream_tids = set()     # dl4j: noqa=E201 (lock held)
+            for ev in self._events:
+                seen.add((ev["pid"], ev["tid"]))
+            for pid, tid in sorted(seen):
+                prefix = ",\n" if self._stream_count else ""
+                stream.write(prefix + json.dumps(
+                    {"name": "thread_name", "ph": "M", "pid": pid,
+                     "tid": tid, "args": {"name": _thread_name(tid)}}))
+                self._stream_count += 1   # dl4j: noqa=E202
+            stream.write("\n]\n")
+        except OSError as e:
+            # same contract as the recording path: a full disk at
+            # teardown warns — a truncated array is Perfetto-loadable,
+            # and stop_stream must never crash the end-of-fit path
+            import warnings
+            warnings.warn(
+                f"trace stream finalize to {path} failed ({e}) — the "
+                "streamed file is a truncated (still loadable) array",
+                stacklevel=3)
+        finally:
+            try:
+                stream.close()
+            except OSError:
+                pass
+        self._stream_count = 0            # dl4j: noqa=E201
+        return path
 
 
 def _thread_name(tid: int) -> str:

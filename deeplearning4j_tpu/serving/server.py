@@ -206,22 +206,31 @@ def resolve_forward(model):
 
 def _make_head(head):
     """Compile a results-only post-processing head: the device->host
-    copy then moves the head's (small) output instead of full logits."""
+    copy then moves the head's (small) output instead of full logits.
+    Heads sit behind the compile-cache seam (nn.compilecache) like the
+    forwards, so a warmed process's heads deserialize from the
+    persistent cache too."""
     if head is None:
         return None
+    from deeplearning4j_tpu.nn import compilecache as _cc
     import jax.numpy as jnp
     if isinstance(head, str) and head.startswith("top_k"):
         k = int(head.split(":", 1)[1]) if ":" in head else 5
         head = ("top_k", k)
     if isinstance(head, (tuple, list)) and tuple(head)[0] == "top_k":
         k = int(tuple(head)[1])
-        return jax.jit(lambda y: jax.lax.top_k(y, k))
+        return _cc.cached_dispatch(lambda y: jax.lax.top_k(y, k),
+                                   "serving:head", key_parts=("top_k", k))
     if head == "argmax":
-        return jax.jit(lambda y: jnp.argmax(y, axis=-1))
+        return _cc.cached_dispatch(lambda y: jnp.argmax(y, axis=-1),
+                                   "serving:head", key_parts=("argmax",))
     if head == "softmax":
-        return jax.jit(lambda y: jax.nn.softmax(y, axis=-1))
+        return _cc.cached_dispatch(lambda y: jax.nn.softmax(y, axis=-1),
+                                   "serving:head", key_parts=("softmax",))
     if callable(head):
-        return jax.jit(head)
+        return _cc.cached_dispatch(
+            head, "serving:head",
+            key_parts=("callable", getattr(head, "__qualname__", "?")))
     raise ValueError(
         f"unknown head {head!r} (expected 'argmax', 'softmax', "
         "'top_k[:k]', or a callable)")
@@ -621,7 +630,10 @@ class ModelServer:
         W201 churn detector; :meth:`recompiles_after_warmup` measures
         steady-state compiles against this baseline."""
         shapes = [tuple(int(d) for d in s) for s in shapes]
-        report = self.validate(shapes=shapes)
+        # check_cache: warmup is the moment the cold-start bill lands, so
+        # DL4J-W112 (no/unwritable persistent compile cache — every
+        # rollout pays full compile) fires here, not on static validate()
+        report = self.validate(shapes=shapes, check_cache=True)
         if strict:
             report.raise_if_errors()
         for d in report.diagnostics:
@@ -662,13 +674,16 @@ class ModelServer:
         return self._churn.signature_count("serving:forward",
                                            owner=self) - self._warm_sig_count
 
-    def validate(self, shapes=None, hbm_gb=None):
+    def validate(self, shapes=None, hbm_gb=None, check_cache: bool = False):
         """Static serving-config lint: buckets x mesh x HBM (analysis.
-        serving) plus any W201 churn findings recorded for this server."""
+        serving) plus any W201 churn findings recorded for this server.
+        ``check_cache=True`` (what ``warmup`` passes) adds the DL4J-W112
+        persistent-compile-cache check."""
         from deeplearning4j_tpu.analysis.serving import lint_serving
         return lint_serving(self.model, self.buckets(), mesh=self.mesh,
                             shapes=shapes, hbm_gb=hbm_gb,
                             input_dtype=self.input_dtype,
+                            check_cache=check_cache,
                             extra=self._churn.diagnostics_for(owner=self))
 
     # ------------------------------------------------------- health surface

@@ -672,6 +672,7 @@ class TrainingSession:
                 self._signals.append(sig)
         self._cursors = deque()
         self._cursor_at_step = None
+        self._last_batch_sig = None
         self._snapshot = None
         self._skip_reset = False
         self._next_save = None
@@ -743,12 +744,38 @@ class TrainingSession:
             upd._lr_scale = lr_scale
             self._bust_step_caches()
         self._good_steps = int(res_state.get("good_steps", 0))
+        lss = res_state.get("loss_scale_state")
+        if lss is not None and hasattr(self.model, "_dynamic_scaling") \
+                and self.model._dynamic_scaling():
+            # the dynamic loss-scale automaton resumes exactly where the
+            # checkpoint left it (NOT at the policy's init value)
+            self.model._scale_state = jax.numpy.asarray(
+                lss, jax.numpy.float32)
         self.resumed = True
         self.restored = info
         logger.info("resumed from %s (step %d, status=%s)", info["path"],
                     self.model._iteration, info["manifest"].get("status"))
         self._arm_next_save()
         return True
+
+    def warm_after_resume(self, steps_per_dispatch: int = 1) -> bool:
+        """Kill the resume cold start: when the persistent compile cache
+        is configured (nn.compilecache), AOT-warm the train step for the
+        batch signature the restored checkpoint recorded — a previously-
+        seen (model, shapes, policy) tuple deserializes from disk
+        instead of paying the first-dispatch XLA compile. Fit loops call
+        this right after ``begin_session`` (they know the dispatch K).
+        Best-effort and gated OFF when no cache dir is configured, so
+        un-cached fits behave exactly as before."""
+        if not self.resumed:
+            return False
+        from deeplearning4j_tpu.nn import compilecache as _cc
+        if _cc.cache_dir() is None:
+            return False
+        sig = ((self.restored.get("extra") or {}).get("resilience")
+               or {}).get("batch_signature")
+        return _cc.warm_from_batch_signature(
+            self.model, sig, steps_per_dispatch=steps_per_dispatch)
 
     def _arm_next_save(self):
         if self.manager is not None and self.config.every_steps:
@@ -775,6 +802,12 @@ class TrainingSession:
                 from deeplearning4j_tpu.faults import _poison
                 ds = _poison(ds)
             self._cursors.append(None if it is None else it.cursor())
+            if self.manager is not None:
+                # recorded into the checkpoint manifest so a resumed
+                # process can AOT-warm the train step for this signature
+                # (nn.compilecache) before its first dispatch
+                from deeplearning4j_tpu.nn.compilecache import describe_batch
+                self._last_batch_sig = describe_batch(ds)
             yield ds
 
     # --------------------------------------------------------------- hooks
@@ -859,11 +892,19 @@ class TrainingSession:
             return None
         # the BACKOFF_LR recovery state is training state too: a resume
         # that silently restored full LR mid-backoff would re-trip the
-        # very instability the backoff was suppressing
+        # very instability the backoff was suppressing. Likewise the
+        # dynamic loss-scale automaton (nn.precision): resuming at the
+        # policy's init scale mid-backoff would replay the overflows.
         upd = self.model.conf.base.updater
-        extra = {"resilience": {
+        res_extra = {
             "lr_scale": float(getattr(upd, "_lr_scale", 1.0)),
-            "good_steps": int(self._good_steps)}}
+            "good_steps": int(self._good_steps),
+            "batch_signature": self._last_batch_sig}
+        scale_state = getattr(self.model, "_scale_state", None)
+        if scale_state is not None:
+            res_extra["loss_scale_state"] = [
+                float(v) for v in np.asarray(jax.device_get(scale_state))]
+        extra = {"resilience": res_extra}
         path = self.manager.save(
             self.model, status=status, cursor=self._cursor_at_step,
             normalizer=self.normalizer, extra=extra)

@@ -197,10 +197,14 @@ def use_dispatch_stream(data, steps: int, session) -> bool:
 
 def scan_megastep(body, num_carry: int):
     """Wrap a single-step ``body(*carry, *xs) -> (*new_carry, loss)`` into
-    a K-step program: carry threads (params, states, opt_state, t), every
-    xs leaf gains a leading K axis, and the K per-step losses come back as
-    ONE device vector. The body is the exact function the single-step path
-    jits, so K scanned steps == K single-step fits numerically."""
+    a K-step program: carry threads (params, states, opt_state, t) —
+    plus the dynamic loss-scale state ``[scale, good_steps]`` when the
+    attached PrecisionPolicy is dynamic (``num_carry=5``) — every xs
+    leaf gains a leading K axis, and the K per-step losses come back as
+    ONE device vector. The body is the exact function the single-step
+    path jits, so K scanned steps == K single-step fits numerically
+    (the scale automaton ticks per scanned sub-step exactly as it would
+    per dispatch)."""
     def megastep(*args):
         carry, xs = args[:num_carry], args[num_carry:]
 

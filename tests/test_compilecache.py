@@ -1,0 +1,1020 @@
+"""Persistent/AOT compilation cache + unified warmup (ISSUE 13).
+
+Pins, per tier:
+
+- DiskCompileCache: atomic write/read roundtrip, corruption QUARANTINE
+  (never trusted, renamed aside), version-mismatch = ignored+rewritten,
+  LRU eviction past max_entries.
+- CachedDispatch: plain-jit passthrough when disabled, AOT warm()
+  compiles without executing, in-process disk reuse across instances,
+  graceful fallback when serialization breaks.
+- THE cross-process pin: a second fresh process reports disk misses==0
+  and ZERO cold compile seconds for the same (model, shapes, policy)
+  across fit, resume (checkpoint-recorded batch signature), and
+  serving bucket warmup.
+- Key busting: a policy or mesh/sharding change maps to different
+  entries (no false sharing).
+- The existing zero-steady-state-recompile pins stay green with the
+  persistent cache enabled (megastep, serving buckets, precision
+  re-attach).
+- Concurrent writers race safely (``-m races``).
+- DL4J-W112: serving warmup without a (writable) persistent cache dir.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.analysis import get_churn_detector
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.nn import compilecache as cc
+from deeplearning4j_tpu.nn.config import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.train.updaters import Adam
+from deeplearning4j_tpu.serving.server import ModelServer
+
+
+@pytest.fixture(autouse=True)
+def _clean_cache_config():
+    """Every test starts with the cache disabled and zeroed stats, and
+    cannot leak its configuration into the rest of the suite."""
+    cc.configure(None)
+    cc.reset_stats()
+    yield
+    cc.reset_configuration()
+    cc.reset_stats()
+
+
+def _mlp_conf(seed=7, hidden=16):
+    return (NeuralNetConfiguration.Builder().seed(seed).updater(Adam(0.01))
+            .weightInit("xavier").list()
+            .layer(DenseLayer(nOut=hidden, activation="relu"))
+            .layer(OutputLayer(nOut=3, lossFunction="mcxent",
+                               activation="softmax"))
+            .setInputType(InputType.feedForward(8))
+            .build())
+
+
+def _graph_conf(seed=7):
+    return (NeuralNetConfiguration.Builder().seed(seed).updater(Adam(0.01))
+            .graphBuilder()
+            .addInputs("in")
+            .setInputTypes(InputType.feedForward(8))
+            .addLayer("fc", DenseLayer(nOut=16, activation="relu"), "in")
+            .addLayer("out", OutputLayer(nOut=3, lossFunction="mcxent",
+                                         activation="softmax"), "fc")
+            .setOutputs("out")
+            .build())
+
+
+def _data(n=16, seed=0):
+    rng = np.random.RandomState(seed)
+    return DataSet(rng.randn(n, 8).astype(np.float32),
+                   np.eye(3, dtype=np.float32)[rng.randint(0, 3, n)])
+
+
+def _iterator(seed=0, n=48, batch=8):
+    """Cursor-capable source (exact resume needs seek())."""
+    from deeplearning4j_tpu.data.dataset import ListDataSetIterator
+    return ListDataSetIterator(_data(n, seed), batch_size=batch)
+
+
+# ------------------------------------------------------------- disk store
+class TestDiskStore:
+    def test_roundtrip(self, tmp_path):
+        store = cc.DiskCompileCache(str(tmp_path))
+        key = cc.content_key("t", b"program-bytes", ("part",))
+        assert store.get(key) is None
+        store.put(key, b"payload", scope="t")
+        assert store.get(key) == b"payload"
+        assert store.entry_count() == 1
+
+    def test_corrupt_entry_quarantined(self, tmp_path):
+        store = cc.DiskCompileCache(str(tmp_path))
+        key = cc.content_key("t", b"p", ())
+        path = store.put(key, b"payload")
+        with open(path, "r+b") as f:          # flip payload bytes: the
+            f.seek(-3, os.SEEK_END)           # header checksum must catch
+            f.write(b"zzz")
+        with pytest.warns(UserWarning, match="quarantined corrupt"):
+            assert store.get(key) is None
+        assert not os.path.exists(path)
+        quarantined = [n for n in os.listdir(tmp_path)
+                       if n.startswith("quarantine_")]
+        assert len(quarantined) == 1
+        # a rewrite restores the entry
+        store.put(key, b"payload")
+        assert store.get(key) == b"payload"
+
+    def test_truncated_entry_quarantined(self, tmp_path):
+        store = cc.DiskCompileCache(str(tmp_path))
+        key = cc.content_key("t", b"p2", ())
+        path = store.put(key, b"payload-bytes")
+        with open(path, "wb") as f:
+            f.write(b"DL4")                  # not even the magic survives
+        with pytest.warns(UserWarning, match="quarantined"):
+            assert store.get(key) is None
+
+    def test_version_mismatch_ignored_and_rewritten(self, tmp_path):
+        store = cc.DiskCompileCache(str(tmp_path))
+        key = cc.content_key("t", b"p3", ())
+        path = store.put(key, b"payload")
+        # doctor the header to an older runtime: ignored, NOT quarantined
+        with open(path, "rb") as f:
+            f.readline()
+            header = json.loads(f.readline().decode())
+            payload = f.read()
+        header["runtime"] = "jax=0.0.1;jaxlib=0.0.1;backend=cpu"
+        with open(path, "wb") as f:
+            f.write(b"DL4JCC1\n")
+            f.write(json.dumps(header).encode() + b"\n")
+            f.write(payload)
+        assert store.get(key) is None
+        assert os.path.exists(path)           # still there — and a fresh
+        store.put(key, b"payload")            # put overwrites it in place
+        assert store.get(key) == b"payload"
+
+    def test_eviction_lru(self, tmp_path):
+        store = cc.DiskCompileCache(str(tmp_path), max_entries=3)
+        keys = [cc.content_key("t", f"p{i}".encode(), ()) for i in range(5)]
+        for i, k in enumerate(keys):
+            store.put(k, b"x")
+            os.utime(store._path(k), (1000 + i, 1000 + i))
+        store.put(keys[0], b"x")              # refresh + trigger evict
+        assert store.entry_count() == 3
+
+    def test_concurrent_put_same_key_atomic(self, tmp_path):
+        store = cc.DiskCompileCache(str(tmp_path))
+        key = cc.content_key("t", b"race", ())
+        payload = b"P" * 4096
+        errors = []
+        barrier = threading.Barrier(4)
+
+        def writer():
+            try:
+                barrier.wait()
+                for _ in range(20):
+                    store.put(key, payload)
+                    got = store.get(key)
+                    assert got == payload
+            except BaseException as e:          # noqa: B017
+                errors.append(e)
+        threads = [threading.Thread(target=writer) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        assert store.get(key) == payload
+
+    def test_cache_dir_status(self, tmp_path):
+        assert cc.cache_dir_status() == (None, False)
+        cc.configure(str(tmp_path))
+        d, writable = cc.cache_dir_status()
+        assert d == str(tmp_path) and writable
+        # unwritable: a path whose "parent" is a regular file (chmod
+        # tricks don't work under root, which CI may run as)
+        blocker = tmp_path / "blocker"
+        blocker.write_text("x")
+        cc.configure(str(blocker / "sub"))
+        d, writable = cc.cache_dir_status()
+        assert not writable
+
+    def test_env_var_resolution(self, tmp_path, monkeypatch):
+        cc.reset_configuration()
+        monkeypatch.setenv(cc.ENV_DIR, str(tmp_path))
+        assert cc.cache_dir() == str(tmp_path)
+        cc.configure(None)                    # explicit disable wins
+        assert cc.cache_dir() is None
+
+
+# -------------------------------------------------------- cached dispatch
+class TestCachedDispatch:
+    def test_passthrough_when_disabled(self):
+        calls = []
+
+        def f(x):
+            calls.append(1)
+            return x * 2
+        d = cc.cached_dispatch(f, "test:pt")
+        out = d(jnp.ones((4,)))
+        assert float(out[0]) == 2.0
+        assert d.warmed_signatures() == 0     # plain jit path, no AOT
+
+    def test_warm_compiles_without_executing(self, tmp_path):
+        cc.configure(str(tmp_path))
+        executed = []
+
+        def f(x):
+            executed.append(1)                # traced once, run never
+            return x + 1
+        d = cc.cached_dispatch(f, "test:warm")
+        d.warm(jnp.zeros((4,)))
+        assert d.warmed_signatures() == 1
+        stats = cc.cache_stats()
+        assert stats["compile_seconds"]["cold_compiles"] == 1
+        assert stats["disk"]["entries"] == 1
+        # the call now hits the memory tier
+        cc.reset_stats()
+        assert float(d(jnp.ones((4,)))[0]) == 2.0
+        assert cc.cache_stats()["memory"]["hits"] == 1
+
+    def test_disk_reuse_across_instances(self, tmp_path):
+        cc.configure(str(tmp_path))
+
+        def f(x):
+            return jnp.dot(x, x.T)
+        cc.cached_dispatch(f, "test:reuse").warm(jnp.zeros((8, 8)))
+        cc.reset_stats()
+        d2 = cc.cached_dispatch(f, "test:reuse")
+        d2.warm(jnp.zeros((8, 8)))
+        s = cc.cache_stats()
+        assert s["disk"]["hits"] == 1 and s["disk"]["misses"] == 0
+        assert s["compile_seconds"]["cold_compiles"] == 0
+        assert s["compile_seconds"]["warm_loads"] == 1
+        out = d2(jnp.full((8, 8), 2.0))
+        assert float(np.asarray(out)[0, 0]) == pytest.approx(32.0)
+
+    def test_key_parts_bust(self, tmp_path):
+        cc.configure(str(tmp_path))
+
+        def f(x):
+            return x * 3
+        cc.cached_dispatch(f, "test:kp", key_parts=("a",)).warm(
+            jnp.zeros((2,)))
+        cc.reset_stats()
+        cc.cached_dispatch(f, "test:kp", key_parts=("b",)).warm(
+            jnp.zeros((2,)))
+        s = cc.cache_stats()                  # different key part: a miss
+        assert s["disk"]["misses"] == 1 and s["disk"]["hits"] == 0
+
+    def test_serialize_failure_falls_back(self, tmp_path, monkeypatch):
+        cc.configure(str(tmp_path))
+
+        def boom(exe):
+            raise RuntimeError("injected serialize failure")
+        monkeypatch.setattr(cc, "_serialize_executable", boom)
+
+        def f(x):
+            return x - 1
+        d = cc.cached_dispatch(f, "test:fb")
+        with pytest.warns(UserWarning, match="persistent-cache write"):
+            out = d(jnp.ones((2,)))
+        assert float(out[0]) == 0.0           # dispatch survived
+        assert cc.cache_stats()["disk"]["entries"] == 0
+
+    def test_sharding_in_signature(self, tmp_path):
+        from deeplearning4j_tpu.parallel.mesh import DeviceMesh
+        if len(jax.devices()) < 2:
+            pytest.skip("needs multi-device")
+        cc.configure(str(tmp_path))
+
+        def f(x):
+            return x * 2
+        d = cc.cached_dispatch(f, "test:shard")
+        mesh = DeviceMesh.data_parallel()
+        host = jnp.zeros((8, 4))
+        with mesh:
+            sharded = jax.device_put(host, mesh.batch_sharding(2))
+            d.warm(sharded)
+        d.warm(host)
+        # two placements, two programs — a mesh change can never reuse
+        # the single-device executable
+        assert d.warmed_signatures() == 2
+
+
+# ------------------------------------------------------------ model paths
+class TestModelIntegration:
+    def test_fit_bit_exact_with_cache(self, tmp_path):
+        ds = _data()
+        base = MultiLayerNetwork(_mlp_conf()).init()
+        base.fit(ds, epochs=3)
+        cc.configure(str(tmp_path))
+        cached = MultiLayerNetwork(_mlp_conf()).init()
+        cached.fit(ds, epochs=3)
+        assert np.array_equal(np.asarray(base.params()),
+                              np.asarray(cached.params()))
+        assert cc.cache_stats()["disk"]["entries"] >= 1
+
+    def test_fit_from_disk_bit_exact(self, tmp_path):
+        """An executable DESERIALIZED from the store trains bit-exactly
+        like a freshly compiled one."""
+        ds = _data()
+        cc.configure(str(tmp_path))
+        a = MultiLayerNetwork(_mlp_conf()).init()
+        a.fit(ds, epochs=2)                   # populates the store
+        cc.reset_stats()
+        b = MultiLayerNetwork(_mlp_conf()).init()
+        b.fit(ds, epochs=2)                   # deserializes
+        s = cc.cache_stats()
+        assert s["disk"]["hits"] >= 1
+        assert s["compile_seconds"]["cold_compiles"] == 0
+        assert np.array_equal(np.asarray(a.params()), np.asarray(b.params()))
+
+    def test_megastep_with_cache_bit_exact(self, tmp_path):
+        batches = [_data(8, seed=i) for i in range(4)]
+        base = MultiLayerNetwork(_mlp_conf()).init()
+        base.fit(list(batches), epochs=1, steps_per_dispatch=2)
+        cc.configure(str(tmp_path))
+        cached = MultiLayerNetwork(_mlp_conf()).init()
+        cached.fit(list(batches), epochs=1, steps_per_dispatch=2)
+        assert np.array_equal(np.asarray(base.params()),
+                              np.asarray(cached.params()))
+
+    def test_graph_fit_with_cache(self, tmp_path):
+        ds = _data()
+        base = ComputationGraph(_graph_conf()).init()
+        base.fit(ds, epochs=2)
+        cc.configure(str(tmp_path))
+        cached = ComputationGraph(_graph_conf()).init()
+        cached.fit(ds, epochs=2)
+        lb = [np.asarray(v) for v in jax.tree_util.tree_leaves(base._params)]
+        lc = [np.asarray(v)
+              for v in jax.tree_util.tree_leaves(cached._params)]
+        assert all(np.array_equal(x, y) for x, y in zip(lb, lc))
+        cc.reset_stats()
+        g2 = ComputationGraph(_graph_conf()).init()
+        g2.fit(ds, epochs=1)
+        assert cc.cache_stats()["disk"]["hits"] >= 1
+
+    def test_policy_change_busts_key(self, tmp_path):
+        """Key busting: a different PrecisionPolicy must not reuse the
+        fp32 executable (and vice versa)."""
+        ds = _data()
+        cc.configure(str(tmp_path))
+        MultiLayerNetwork(_mlp_conf()).init().fit(ds, epochs=1)
+        cc.reset_stats()
+        MultiLayerNetwork(_mlp_conf()).init().fit(ds, epochs=1,
+                                                  precision="bf16")
+        s = cc.cache_stats()
+        assert s["disk"]["misses"] >= 1       # bf16 = new program
+        cc.reset_stats()
+        MultiLayerNetwork(_mlp_conf()).init().fit(ds, epochs=1,
+                                                  precision="bf16")
+        s = cc.cache_stats()                  # second bf16 fit = disk hit
+        assert s["disk"]["misses"] == 0 and s["disk"]["hits"] >= 1
+
+    def test_zero_steady_state_recompiles_with_cache(self, tmp_path):
+        """The churn-detector pin with the persistent cache enabled:
+        20 steps of steady-state fit = ONE signature at the fit site."""
+        cc.configure(str(tmp_path))
+        det = get_churn_detector()
+        net = MultiLayerNetwork(_mlp_conf()).init()
+        before = det.signature_count("MultiLayerNetwork.fit", owner=net)
+        for _ in range(20):
+            net.fit(_data(), epochs=1)
+        assert det.signature_count("MultiLayerNetwork.fit",
+                                   owner=net) - before == 1
+
+    def test_warmup_api_forward_and_train(self, tmp_path):
+        cc.configure(str(tmp_path))
+        net = MultiLayerNetwork(_mlp_conf()).init()
+        cc.warmup(net, [((16, 8), (16, 3)), (16, 8)])
+        s = cc.cache_stats()
+        assert s["compile_seconds"]["cold_compiles"] == 2
+        p_before = np.asarray(net.params())
+        cc.reset_stats()
+        net.fit(_data(), epochs=1)            # no compile at dispatch
+        net.output(np.zeros((16, 8), np.float32))
+        s = cc.cache_stats()
+        assert s["compile_seconds"]["cold_compiles"] == 0
+        assert s["memory"]["hits"] >= 2
+        # warmup itself never touched state
+        net2 = MultiLayerNetwork(_mlp_conf()).init()
+        assert np.array_equal(p_before, np.asarray(net2.params()))
+
+    def test_warmup_megastep(self, tmp_path):
+        cc.configure(str(tmp_path))
+        net = MultiLayerNetwork(_mlp_conf()).init()
+        cc.warmup(net, [((8, 8), (8, 3))], steps_per_dispatch=2)
+        cc.reset_stats()
+        net.fit([_data(8, seed=i) for i in range(2)], epochs=1,
+                steps_per_dispatch=2)
+        assert cc.cache_stats()["compile_seconds"]["cold_compiles"] == 0
+
+    def test_warmup_graph(self, tmp_path):
+        cc.configure(str(tmp_path))
+        g = ComputationGraph(_graph_conf()).init()
+        cc.warmup(g, [((16, 8), (16, 3)), (16, 8)])
+        cc.reset_stats()
+        g.fit(_data(), epochs=1)
+        g.output(np.zeros((16, 8), np.float32))
+        assert cc.cache_stats()["compile_seconds"]["cold_compiles"] == 0
+
+    def test_warmup_bad_spec_rejected(self):
+        net = MultiLayerNetwork(_mlp_conf()).init()
+        with pytest.raises(ValueError, match="warmup shape spec"):
+            cc.warmup(net, [((1, 2), (3, 4), (5, 6))])
+
+    def test_warmup_delegates_to_server(self, tmp_path):
+        cc.configure(str(tmp_path))
+        net = MultiLayerNetwork(_mlp_conf()).init()
+        sv = ModelServer(net, batch_limit=8, name="cc-deleg")
+        try:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                cc.warmup(sv, [(8,)])
+            assert sv._warmed and sv.recompiles_after_warmup() == 0
+        finally:
+            sv.close()
+
+
+# ---------------------------------------------------------------- serving
+class TestServingCache:
+    def test_serving_warmup_zero_recompiles_with_cache(self, tmp_path):
+        cc.configure(str(tmp_path))
+        net = MultiLayerNetwork(_mlp_conf()).init()
+        sv = ModelServer(net, batch_limit=8, name="cc-srv1")
+        try:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                sv.warmup([(8,)])
+            out = sv.output(np.random.RandomState(0)
+                            .randn(4, 8).astype(np.float32))
+            assert out.shape == (4, 3)
+            assert sv.recompiles_after_warmup() == 0
+        finally:
+            sv.close()
+
+    def test_second_server_warmup_hits_disk(self, tmp_path):
+        """The registry hot-swap staging scenario in miniature: warming
+        a NEW server over a previously-seen (model, bucket, mesh)
+        performs zero cold compiles."""
+        cc.configure(str(tmp_path))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            sv1 = ModelServer(MultiLayerNetwork(_mlp_conf()).init(),
+                              batch_limit=8, name="cc-srv2")
+            sv1.warmup([(8,)])
+            sv1.close()
+            cc.reset_stats()
+            sv2 = ModelServer(MultiLayerNetwork(_mlp_conf()).init(),
+                              batch_limit=8, name="cc-srv3")
+            sv2.warmup([(8,)])
+        try:
+            s = cc.cache_stats()
+            assert s["compile_seconds"]["cold_compiles"] == 0
+            assert s["disk"]["misses"] == 0 and s["disk"]["hits"] >= 1
+            assert sv2.recompiles_after_warmup() == 0
+        finally:
+            sv2.close()
+
+    def test_registry_load_staging_hits_disk(self, tmp_path):
+        from deeplearning4j_tpu.serving.registry import ModelRegistry
+        cc.configure(str(tmp_path))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            reg = ModelRegistry(batch_limit=8)
+            reg.load("m", MultiLayerNetwork(_mlp_conf()).init(),
+                     shapes=[(8,)])
+            cc.reset_stats()
+            # v2 of the same architecture: AOT staging = pure disk reads
+            reg.load("m", MultiLayerNetwork(_mlp_conf()).init())
+            reg.roll("m")
+        try:
+            s = cc.cache_stats()
+            assert s["compile_seconds"]["cold_compiles"] == 0
+            assert s["disk"]["misses"] == 0 and s["disk"]["hits"] >= 1
+        finally:
+            reg.close()
+
+
+# ----------------------------------------------------------------- resume
+class TestResumeWarmup:
+    def test_checkpoint_records_batch_signature(self, tmp_path):
+        from deeplearning4j_tpu.train.resilience import CheckpointConfig
+        net = MultiLayerNetwork(_mlp_conf()).init()
+        ck = str(tmp_path / "ck")
+        net.fit([_data(), _data(16, 1)], epochs=1,
+                checkpoint=CheckpointConfig(ck, every_steps=1))
+        cps = sorted(d for d in os.listdir(ck) if d.startswith("ckpt_"))
+        with open(os.path.join(ck, cps[-1], "extra.json")) as f:
+            extra = json.load(f)
+        sig = extra["extra"]["resilience"]["batch_signature"]
+        assert sig["features"] == [[16, 8], "float32"]
+        assert sig["labels"] == [[16, 3], "float32"]
+
+    def test_resume_warms_from_recorded_signature(self, tmp_path):
+        from deeplearning4j_tpu.train.resilience import CheckpointConfig
+        cc.configure(str(tmp_path / "cache"))
+        ck = str(tmp_path / "ck")
+        a = MultiLayerNetwork(_mlp_conf()).init()
+        a.fit([_data(), _data(16, 1)], epochs=1,
+              checkpoint=CheckpointConfig(ck, every_steps=1))
+        # a "fresh process" stand-in: new model object, resume=True
+        cc.reset_stats()
+        b = MultiLayerNetwork(_mlp_conf()).init()
+        b.fit([_data(), _data(16, 1)], epochs=2,
+              checkpoint=CheckpointConfig(ck, resume=True))
+        s = cc.cache_stats()
+        assert s["compile_seconds"]["cold_compiles"] == 0
+        assert s["disk"]["hits"] >= 1 and s["disk"]["misses"] == 0
+
+    def test_resume_warm_noop_without_cache(self, tmp_path):
+        """No cache dir configured -> warm_after_resume is a no-op and
+        resumed fits behave exactly as before (and stay bit-exact)."""
+        from deeplearning4j_tpu.train.resilience import CheckpointConfig
+        from deeplearning4j_tpu.faults import FaultPlan
+        ck = str(tmp_path / "ck")
+        full = MultiLayerNetwork(_mlp_conf()).init()
+        full.fit(_iterator(), epochs=1)
+        part = MultiLayerNetwork(_mlp_conf()).init()
+        part.fit(_iterator(), epochs=1,
+                 checkpoint=CheckpointConfig(ck, every_steps=1),
+                 faults=FaultPlan(preempt_at_step=2))
+        resumed = MultiLayerNetwork(_mlp_conf()).init()
+        resumed.fit(_iterator(), epochs=1,
+                    checkpoint=CheckpointConfig(ck, resume=True))
+        assert np.array_equal(np.asarray(full.params()),
+                              np.asarray(resumed.params()))
+
+
+# ---------------------------------------------------------------- elastic
+class TestElasticWarm:
+    def test_survivor_mesh_warm_populates_cache(self, tmp_path):
+        """The shrink path's warm seam: given a checkpoint-recorded
+        batch signature, the survivor-mesh megastep is AOT-compiled
+        (padded + sharded like the dispatch loop stages it) without
+        touching model state, and a repeat warm is a disk hit."""
+        import types
+        from deeplearning4j_tpu.parallel.elastic import _warm_survivor_mesh
+        from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+        if len(jax.devices()) < 2:
+            pytest.skip("needs multi-device")
+        cc.configure(str(tmp_path))
+        net = MultiLayerNetwork(_mlp_conf()).init()
+        wrapper = ParallelWrapper(net)
+        session = types.SimpleNamespace(_last_batch_sig={
+            "features": [[16, 8], "float32"],
+            "labels": [[16, 3], "float32"]})
+        p_before = np.asarray(net.params())
+        _warm_survivor_mesh(wrapper, net, session, wrapper.mesh, k=2)
+        s = cc.cache_stats()
+        assert s["compile_seconds"]["cold_compiles"] == 1
+        assert np.array_equal(p_before, np.asarray(net.params()))
+        # a later process/mesh-twin warms from disk
+        cc.reset_stats()
+        net2 = MultiLayerNetwork(_mlp_conf()).init()
+        _warm_survivor_mesh(ParallelWrapper(net2), net2, session,
+                            wrapper.mesh, k=2)
+        s = cc.cache_stats()
+        assert s["compile_seconds"]["cold_compiles"] == 0
+        assert s["disk"]["hits"] == 1
+
+    def test_survivor_warm_noop_without_cache(self):
+        import types
+        from deeplearning4j_tpu.parallel.elastic import _warm_survivor_mesh
+        from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+        net = MultiLayerNetwork(_mlp_conf()).init()
+        wrapper = ParallelWrapper(net)
+        session = types.SimpleNamespace(_last_batch_sig={
+            "features": [[16, 8], "float32"],
+            "labels": [[16, 3], "float32"]})
+        _warm_survivor_mesh(wrapper, net, session, wrapper.mesh, k=1)
+        assert net._megastep_cache == {} and net._train_step_cache == {}
+
+
+# ---------------------------------------------------------- cross-process
+_XPROC = r"""
+import json, sys, warnings
+warnings.simplefilter("ignore")
+import numpy as np
+from deeplearning4j_tpu.nn.config import NeuralNetConfiguration, InputType
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn import compilecache as cc
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.train.updaters import Adam
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.serving.server import ModelServer
+
+cc.configure(sys.argv[1])
+conf = (NeuralNetConfiguration.Builder().seed(7).updater(Adam(0.01))
+        .weightInit("xavier").list()
+        .layer(DenseLayer(nOut=16, activation="relu"))
+        .layer(OutputLayer(nOut=3, lossFunction="mcxent",
+                           activation="softmax"))
+        .setInputType(InputType.feedForward(8)).build())
+net = MultiLayerNetwork(conf).init()
+rng = np.random.RandomState(0)
+ds = DataSet(rng.randn(16, 8).astype(np.float32),
+             np.eye(3, dtype=np.float32)[rng.randint(0, 3, 16)])
+net.fit(ds, epochs=2)
+sv = ModelServer(net, batch_limit=8, name="xproc")
+sv.warmup([(8,)])
+sv.close()
+print("PARAMS0=%.9e" % float(np.asarray(net.params())[0]))
+print(json.dumps(cc.cache_stats()))
+"""
+
+
+def _run_xproc(cache_dir):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = (os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))) + os.pathsep + env.get("PYTHONPATH", ""))
+    env.pop("DL4J_TPU_COMPILE_CACHE_DIR", None)
+    proc = subprocess.run([sys.executable, "-c", _XPROC, cache_dir],
+                          capture_output=True, text=True, timeout=600,
+                          env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = proc.stdout.strip().splitlines()
+    return lines[-2], json.loads(lines[-1])
+
+
+class TestCrossProcess:
+    def test_second_process_zero_misses_and_no_cold_compiles(self, tmp_path):
+        """THE acceptance pin: fit + serving warmup in a fresh process
+        over a populated cache report disk misses==0 and materially
+        lower compile seconds (zero cold compiles), bit-identical
+        training included."""
+        d = str(tmp_path)
+        p1, s1 = _run_xproc(d)
+        assert s1["disk"]["misses"] >= 1          # first process populated
+        assert s1["compile_seconds"]["cold"] > 0
+        p2, s2 = _run_xproc(d)
+        assert s2["disk"]["misses"] == 0
+        assert s2["disk"]["hits"] >= 2            # train step + forward
+        assert s2["compile_seconds"]["cold"] == 0.0
+        assert s2["compile_seconds"]["warm"] < s1["compile_seconds"]["cold"]
+        assert p1 == p2                           # cached exe = same math
+
+
+# ------------------------------------------------------------------ races
+@pytest.mark.races
+class TestConcurrentWriters:
+    def test_many_threads_one_key(self, tmp_path):
+        """N threads AOT-compile the same program into one store
+        concurrently: no corruption, every call correct, exactly one
+        final entry readable."""
+        cc.configure(str(tmp_path))
+        errors = []
+        barrier = threading.Barrier(6)
+
+        def work(i):
+            try:
+                def f(x):
+                    return x * 2 + 1
+                d = cc.cached_dispatch(f, "races:onekey")
+                barrier.wait()
+                out = d(jnp.full((4,), float(i)))
+                assert float(out[0]) == 2.0 * i + 1
+            except BaseException as e:              # noqa: B017
+                errors.append(e)
+        threads = [threading.Thread(target=work, args=(i,))
+                   for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        disk = cc.disk_cache()
+        assert disk.entry_count() == 1
+        # and the surviving entry is loadable
+        cc.reset_stats()
+
+        def f(x):
+            return x * 2 + 1
+        cc.cached_dispatch(f, "races:onekey").warm(jnp.zeros((4,)))
+        assert cc.cache_stats()["disk"]["hits"] == 1
+
+
+# ------------------------------------------------------------------- W112
+class TestW112:
+    def _server(self):
+        return ModelServer(MultiLayerNetwork(_mlp_conf()).init(),
+                           batch_limit=8, name="w112")
+
+    def test_warmup_without_cache_warns_w112(self):
+        sv = self._server()
+        try:
+            with pytest.warns(UserWarning, match="DL4J-W112"):
+                sv.warmup([(8,)])
+        finally:
+            sv.close()
+
+    def test_warmup_with_cache_no_w112(self, tmp_path):
+        cc.configure(str(tmp_path))
+        sv = self._server()
+        try:
+            with warnings.catch_warnings(record=True) as caught:
+                warnings.simplefilter("always")
+                sv.warmup([(8,)])
+            assert not any("W112" in str(w.message) for w in caught)
+        finally:
+            sv.close()
+
+    def test_unwritable_dir_warns_w112(self, tmp_path):
+        blocker = tmp_path / "blocker"          # file-as-parent: root-proof
+        blocker.write_text("x")
+        cc.configure(str(blocker / "cache"))
+        sv = self._server()
+        try:
+            with pytest.warns(UserWarning, match="writable"):
+                sv.warmup([(8,)])
+        finally:
+            sv.close()
+
+    def test_static_validate_stays_silent(self):
+        """A pure-static validate() (no warmup) must NOT fire W112 —
+        the pre-existing clean-bill pins depend on it."""
+        sv = self._server()
+        try:
+            assert "DL4J-W112" not in sv.validate().codes()
+            assert "DL4J-W112" in sv.validate(check_cache=True).codes()
+        finally:
+            sv.close()
+
+    def test_lint_compile_cache_direct(self, tmp_path):
+        from deeplearning4j_tpu.analysis import lint_compile_cache
+        diags = lint_compile_cache()
+        assert diags and diags[0].code == "DL4J-W112"
+        cc.configure(str(tmp_path))
+        assert lint_compile_cache() == []
+
+    def test_w112_suppressible(self):
+        sv = self._server()
+        try:
+            report = sv.validate(check_cache=True)
+            assert "DL4J-W112" in report.codes()
+            report2 = report.apply_config(suppress=["DL4J-W112"])
+            assert "DL4J-W112" not in report2.codes()
+        finally:
+            sv.close()
+
+    def test_w112_documented(self):
+        from deeplearning4j_tpu.analysis.diagnostics import DIAGNOSTIC_CODES
+        assert "DL4J-W112" in DIAGNOSTIC_CODES
+
+
+# ------------------------------------------------------- tracer streaming
+class TestTraceStreaming:
+    def test_stream_past_ring_horizon(self, tmp_path):
+        from deeplearning4j_tpu.profiler.tracer import SpanTracer
+        tr = SpanTracer(capacity=10)
+        path = str(tmp_path / "trace.json")
+        tr.stream_to(path)
+        for i in range(50):
+            tr.add_event(f"span{i}", float(i), 1.0)
+        assert len(tr) == 10                  # ring kept only the tail
+        out = tr.stop_stream()
+        assert out == path
+        with open(path) as f:
+            doc = json.load(f)                # valid JSON array
+        names = [e["name"] for e in doc if e.get("ph") == "X"]
+        assert names[:1] == ["span0"] and len(names) == 50
+
+    def test_stream_truncated_is_loadable_prefix(self, tmp_path):
+        """A killed process's stream (no stop_stream) is a truncated
+        JSON array whose events are still individually parseable."""
+        from deeplearning4j_tpu.profiler.tracer import (SpanTracer,
+                                                        _STREAM_FLUSH_EVERY)
+        tr = SpanTracer(capacity=4)
+        path = str(tmp_path / "t.json")
+        tr.stream_to(path)
+        for i in range(_STREAM_FLUSH_EVERY + 10):
+            tr.add_event(f"s{i}", float(i), 1.0)
+        with open(path) as f:                 # flushed prefix on disk
+            body = f.read()
+        assert body.startswith("[\n")
+        first = body[2:].split(",\n")[0]
+        assert json.loads(first)["name"] == "s0"
+        tr.stop_stream()
+
+    def test_stream_to_same_path_idempotent(self, tmp_path):
+        from deeplearning4j_tpu.profiler.tracer import SpanTracer
+        tr = SpanTracer()
+        path = str(tmp_path / "t.json")
+        tr.stream_to(path)
+        tr.add_event("a", 0.0, 1.0)
+        tr.stream_to(path)                    # no restart, no truncation
+        tr.add_event("b", 1.0, 1.0)
+        tr.stop_stream()
+        with open(path) as f:
+            doc = json.load(f)
+        assert [e["name"] for e in doc if e.get("ph") == "X"] == ["a", "b"]
+
+    def test_stream_via_global_tracer_spans(self, tmp_path):
+        from deeplearning4j_tpu import profiler as prof
+        tr = prof.get_tracer()
+        path = str(tmp_path / "g.json")
+        tr.stream_to(path)
+        prof.enable_tracing()
+        try:
+            with prof.trace_span("test:streamed"):
+                pass
+        finally:
+            prof.disable_tracing()
+            tr.stop_stream()
+        with open(path) as f:
+            doc = json.load(f)
+        assert any(e["name"] == "test:streamed" for e in doc)
+
+
+# ------------------------------------------------- dynamic loss scaling
+class TestDynamicLossScaling:
+    def test_policy_coercion_and_signature(self):
+        from deeplearning4j_tpu.nn.precision import PrecisionPolicy
+        p = PrecisionPolicy("fp16", loss_scale="dynamic")
+        assert p.is_dynamic and p.numeric_loss_scale() == 2.0 ** 15
+        assert p.loss_scale_init == 2.0 ** 15
+        q = PrecisionPolicy.from_config(p.to_config())
+        assert q == p and q.signature() == p.signature()
+        # a different knob = a different signature (cache bust)
+        r = PrecisionPolicy("fp16", loss_scale="dynamic",
+                            growth_interval=10)
+        assert r.signature() != p.signature()
+        with pytest.raises(ValueError, match="only string value"):
+            PrecisionPolicy("fp16", loss_scale="auto")
+
+    def test_static_pins_unchanged(self):
+        from deeplearning4j_tpu.nn.precision import PrecisionPolicy
+        p = PrecisionPolicy("fp16", loss_scale=2048.0)
+        assert not p.is_dynamic and p.numeric_loss_scale() == 2048.0
+        assert p.signature() == ("float16", "float32", 2048.0)
+
+    def test_no_overflow_equals_static_bit_exact(self):
+        """With no overflow and growth disabled, dynamic(init=S) ==
+        static(S) bit-exactly — the automaton is pure bookkeeping until
+        something overflows."""
+        from deeplearning4j_tpu.nn.precision import PrecisionPolicy
+        ds = _data()
+        dyn = MultiLayerNetwork(_mlp_conf()).init()
+        dyn.fit(ds, epochs=3, precision=PrecisionPolicy(
+            "fp16", loss_scale="dynamic", loss_scale_init=2.0 ** 10,
+            growth_interval=10 ** 9))
+        st = MultiLayerNetwork(_mlp_conf()).init()
+        st.fit(ds, epochs=3, precision=PrecisionPolicy(
+            "fp16", loss_scale=2.0 ** 10))
+        assert np.array_equal(np.asarray(dyn.params()),
+                              np.asarray(st.params()))
+        assert dyn.current_loss_scale() == 2.0 ** 10
+
+    def test_overflow_skips_update_and_backs_off(self):
+        """An absurd init scale overflows the fp16 backward: the step's
+        update is DROPPED (params unchanged) and the scale halves."""
+        from deeplearning4j_tpu.nn.precision import PrecisionPolicy
+        net = MultiLayerNetwork(_mlp_conf()).init()
+        net.setPrecisionPolicy(PrecisionPolicy(
+            "fp16", loss_scale="dynamic", loss_scale_init=2.0 ** 31))
+        before = np.asarray(net.params())
+        net.fit(_data(), epochs=1)
+        assert np.array_equal(before, np.asarray(net.params()))
+        assert net.current_loss_scale() == 2.0 ** 30
+        # ...and training still makes progress once the scale descends
+        for _ in range(25):
+            net.fit(_data(), epochs=1)
+        assert not np.array_equal(before, np.asarray(net.params()))
+        assert net.current_loss_scale() < 2.0 ** 31
+
+    def test_growth_after_interval(self):
+        from deeplearning4j_tpu.nn.precision import PrecisionPolicy
+        net = MultiLayerNetwork(_mlp_conf()).init()
+        net.setPrecisionPolicy(PrecisionPolicy(
+            "fp16", loss_scale="dynamic", loss_scale_init=4.0,
+            growth_interval=2))
+        for _ in range(4):
+            net.fit(_data(), epochs=1)
+        assert net.current_loss_scale() == 16.0     # 4 -> 8 -> 16
+
+    def test_growth_capped_at_max(self):
+        from deeplearning4j_tpu.nn.precision import PrecisionPolicy
+        net = MultiLayerNetwork(_mlp_conf()).init()
+        net.setPrecisionPolicy(PrecisionPolicy(
+            "fp16", loss_scale="dynamic", loss_scale_init=8.0,
+            growth_interval=1, max_loss_scale=16.0))
+        for _ in range(5):
+            net.fit(_data(), epochs=1)
+        assert net.current_loss_scale() == 16.0
+
+    def test_megastep_dynamic_equals_single_steps(self):
+        from deeplearning4j_tpu.nn.precision import PrecisionPolicy
+        batches = [_data(8, seed=i) for i in range(4)]
+        pol = PrecisionPolicy("fp16", loss_scale="dynamic",
+                              loss_scale_init=2.0 ** 10,
+                              growth_interval=3)
+        a = MultiLayerNetwork(_mlp_conf()).init()
+        a.fit(list(batches), epochs=1, steps_per_dispatch=2, precision=pol)
+        b = MultiLayerNetwork(_mlp_conf()).init()
+        b.fit(list(batches), epochs=1, precision=pol)
+        assert np.array_equal(np.asarray(a.params()), np.asarray(b.params()))
+        assert a.current_loss_scale() == b.current_loss_scale()
+
+    def test_graph_dynamic_scaling(self):
+        from deeplearning4j_tpu.nn.precision import PrecisionPolicy
+        ds = _data()
+        dyn = ComputationGraph(_graph_conf()).init()
+        dyn.fit(ds, epochs=2, precision=PrecisionPolicy(
+            "fp16", loss_scale="dynamic", loss_scale_init=2.0 ** 10,
+            growth_interval=10 ** 9))
+        st = ComputationGraph(_graph_conf()).init()
+        st.fit(ds, epochs=2, precision=PrecisionPolicy(
+            "fp16", loss_scale=2.0 ** 10))
+        ld = [np.asarray(v) for v in jax.tree_util.tree_leaves(dyn._params)]
+        ls = [np.asarray(v) for v in jax.tree_util.tree_leaves(st._params)]
+        assert all(np.array_equal(x, y) for x, y in zip(ld, ls))
+
+    def test_scale_carried_through_checkpoint_resume(self, tmp_path):
+        """Resume restores the automaton mid-flight: interrupted + resumed
+        == uninterrupted, scale state included (bit-exact)."""
+        from deeplearning4j_tpu.nn.precision import PrecisionPolicy
+        from deeplearning4j_tpu.train.resilience import CheckpointConfig
+        from deeplearning4j_tpu.faults import FaultPlan
+        pol = PrecisionPolicy("fp16", loss_scale="dynamic",
+                              loss_scale_init=4.0, growth_interval=2)
+        full = MultiLayerNetwork(_mlp_conf()).init()
+        full.fit(_iterator(), epochs=1, precision=pol)
+        ck = str(tmp_path / "ck")
+        part = MultiLayerNetwork(_mlp_conf()).init()
+        part.fit(_iterator(), epochs=1, precision=pol,
+                 checkpoint=CheckpointConfig(ck, every_steps=1),
+                 faults=FaultPlan(preempt_at_step=3))
+        assert part.current_loss_scale() > 4.0      # grew before preempt
+        res = MultiLayerNetwork(_mlp_conf()).init()
+        res.fit(_iterator(), epochs=1, precision=pol,
+                checkpoint=CheckpointConfig(ck, resume=True))
+        assert np.array_equal(np.asarray(full.params()),
+                              np.asarray(res.params()))
+        assert res.current_loss_scale() == full.current_loss_scale()
+
+    def test_policy_reattach_keeps_programs(self, tmp_path):
+        """Equal dynamic policy re-attach keeps the compiled step (zero
+        recompiles); a changed one busts it — with the persistent cache
+        enabled."""
+        from deeplearning4j_tpu.nn.precision import PrecisionPolicy
+        cc.configure(str(tmp_path))
+        net = MultiLayerNetwork(_mlp_conf()).init()
+        pol = PrecisionPolicy("fp16", loss_scale="dynamic",
+                              loss_scale_init=2.0 ** 10)
+        net.fit(_data(), epochs=1, precision=pol)
+        step = net._train_step_cache[(False, False)]
+        net.setPrecisionPolicy(PrecisionPolicy(
+            "fp16", loss_scale="dynamic", loss_scale_init=2.0 ** 10))
+        assert net._train_step_cache[(False, False)] is step
+        net.setPrecisionPolicy(PrecisionPolicy(
+            "fp16", loss_scale="dynamic", loss_scale_init=2.0 ** 8))
+        assert (False, False) not in net._train_step_cache
+
+    def test_sanitizer_attribution_with_dynamic_policy(self):
+        """NAN_PANIC provenance must survive a dynamic policy: the
+        replay rolls the scale carry and attributes the poisoned batch
+        instead of crashing on the extra step argument."""
+        from deeplearning4j_tpu.nn.precision import PrecisionPolicy
+        from deeplearning4j_tpu.profiler.modes import (ProfilingMode,
+                                                       set_profiling_mode)
+        from deeplearning4j_tpu.profiler.sanitizer import \
+            NonfiniteAttributionError
+        net = MultiLayerNetwork(_mlp_conf()).init()
+        net.setPrecisionPolicy(PrecisionPolicy(
+            "fp16", loss_scale="dynamic", loss_scale_init=2.0 ** 8))
+        set_profiling_mode(ProfilingMode.NAN_PANIC)
+        try:
+            net.fit(_data(), epochs=1)        # clean dispatch first
+            bad = _data(seed=1)
+            bad.features[0, 0] = np.nan
+            with pytest.raises(NonfiniteAttributionError, match="batch"):
+                net.fit(bad, epochs=1)
+        finally:
+            set_profiling_mode(ProfilingMode.OFF)
+
+    def test_w302_handles_dynamic(self):
+        from deeplearning4j_tpu.nn.precision import PrecisionPolicy
+        from deeplearning4j_tpu.analysis.numerics import lint_numerics
+        # dynamic on bf16 is still pointless -> W302; on fp16 it is the
+        # recommended configuration -> silent, and E303 (missing scale)
+        # must NOT fire
+        conf = _mlp_conf()
+        rep = lint_numerics(conf, policy=PrecisionPolicy(
+            "bf16", loss_scale="dynamic"))
+        assert "DL4J-W302" in [d.code for d in rep]
+        rep = lint_numerics(conf, policy=PrecisionPolicy(
+            "fp16", loss_scale="dynamic"))
+        codes = [d.code for d in rep]
+        assert "DL4J-E303" not in codes and "DL4J-W302" not in codes
+        # a dynamic automaton whose INIT scale already overflows the
+        # declared range is judged at that worst case: every run starts
+        # by dropping updates until backoff converges -> E303
+        rep = lint_numerics(conf, policy=PrecisionPolicy(
+            "fp16", loss_scale="dynamic", loss_scale_init=2.0 ** 24),
+            data_range="0..255")
+        assert "DL4J-E303" in [d.code for d in rep]
+
+    def test_cli_accepts_dynamic_policy(self, capsys):
+        from deeplearning4j_tpu.analysis.__main__ import main
+        rc = main(["LeNet", "--policy",
+                   "compute=fp16,loss_scale=dynamic,growth_interval=100",
+                   "--warnings-ok"])
+        assert rc == 0
+        with pytest.raises(SystemExit):        # typo'd scale: clean usage
+            main(["LeNet", "--policy", "compute=fp16,loss_scale=auto"])
+        capsys.readouterr()
