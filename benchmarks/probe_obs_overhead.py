@@ -8,12 +8,17 @@ trains a tiny LeNet for a fixed number of iterations three ways:
   off    — ProfilingMode.OFF, tracing disabled (the default ship state)
   basic  — ProfilingMode.BASIC + span tracing: per-iteration step/data-wait
            histograms and spans (what a perf investigation turns on)
+  basic_devicetime — BASIC after a ``profiler.devicetime`` measurement
+           exported its ``dl4j_op_device_seconds{model,layer,op}`` series
+           (ISSUE 14): the bridge is PULL-based — an explicit measure()
+           call, never a fit-loop hook — so a populated attribution
+           registry must leave the fit loop inside the same <5% bound.
 
 and prints ONE JSON line so BENCH rounds can track instrumentation cost
 over time:
 
   {"probe": "obs_overhead", "off_sec_per_iter": ..., "basic_sec_per_iter":
-   ..., "overhead_ratio": ...}
+   ..., "overhead_ratio": ..., "devicetime_overhead_ratio": ...}
 
 ``overhead_ratio`` = basic/off - 1. The interesting regression signal is
 this ratio growing, not the absolute numbers (CPU-backend step times are
@@ -69,8 +74,10 @@ def run(iters: int, warmup: int, blocks: int) -> dict:
     swamps any back-to-back A/B comparison, and alternating short blocks
     exposes both modes to the same noise distribution."""
     from deeplearning4j_tpu import profiler
+    from deeplearning4j_tpu.profiler import devicetime
     net_off, ds = build()
     net_basic, _ = build()
+    net_dt, _ = build()
     try:
         _set_mode(False)
         for _ in range(warmup):
@@ -78,17 +85,27 @@ def run(iters: int, warmup: int, blocks: int) -> dict:
         _set_mode(True)
         for _ in range(warmup):
             net_basic.fit(ds)
+        # devicetime net: measure + export the per-layer attribution
+        # series ONCE (the bridge is pull-based; nothing hooks the fit
+        # loop), then fit with BASIC on like net_basic
+        for _ in range(warmup):
+            net_dt.fit(ds)
+        devicetime.measure(net_dt, ds.features, reps=2,
+                           mode="sync").export_metrics("probe")
         per = max(1, iters // blocks)
-        t_off, t_basic = [], []
+        t_off, t_basic, t_dt = [], [], []
         for _ in range(blocks):
             _set_mode(False)
             t_off.append(_block(net_off, ds, per))
             _set_mode(True)
             t_basic.append(_block(net_basic, ds, per))
+            t_dt.append(_block(net_dt, ds, per))
         t_off.sort()
         t_basic.sort()
+        t_dt.sort()
         return {"off": t_off[len(t_off) // 2],
-                "basic": t_basic[len(t_basic) // 2]}
+                "basic": t_basic[len(t_basic) // 2],
+                "basic_devicetime": t_dt[len(t_dt) // 2]}
     finally:
         profiler.set_profiling_mode(None)
         profiler.disable_tracing()
@@ -105,12 +122,15 @@ def main():
 
     res = run(args.iters, args.warmup, args.blocks)
     off, basic = res["off"], res["basic"]
+    dt = res["basic_devicetime"]
     print(json.dumps({
         "probe": "obs_overhead",
         "iters": args.iters,
         "off_sec_per_iter": round(off, 6),
         "basic_sec_per_iter": round(basic, 6),
+        "basic_devicetime_sec_per_iter": round(dt, 6),
         "overhead_ratio": round(basic / off - 1.0, 4),
+        "devicetime_overhead_ratio": round(dt / off - 1.0, 4),
     }))
 
 

@@ -203,7 +203,8 @@ def _analyze_multilayer(conf, batch_size, data_devices,
         _propagate_multilayer(conf, layers, preprocessors, report)
 
     report.extend(_layout.lint_layers(
-        (_layer_loc(i, l), l) for i, l in enumerate(layers)))
+        ((_layer_loc(i, l), l) for i, l in enumerate(layers)),
+        compute_layout=getattr(conf.base, "compute_layout", "NCHW")))
     report.extend(_layout.lint_dtype(
         getattr(conf.base, "dtype", None)))
     if mesh is not None:
@@ -447,7 +448,8 @@ def _analyze_graph(conf, batch_size, data_devices,
         _propagate_graph(topo, input_types, preprocessors, report)
 
     report.extend(_layout.lint_layers(
-        (_node_loc(n), n.obj) for n in nodes if n.kind == "layer"))
+        ((_node_loc(n), n.obj) for n in nodes if n.kind == "layer"),
+        compute_layout=getattr(conf.base, "compute_layout", "NCHW")))
     report.extend(_layout.lint_dtype(getattr(conf.base, "dtype", None)))
     if mesh is not None:
         report.extend(_dist.lint_graph(conf, mesh, batch_size))

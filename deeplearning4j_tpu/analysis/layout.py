@@ -39,34 +39,73 @@ def padding_waste(dim: int, tile: int = MXU_LANES) -> float:
     return (padded - dim) / padded
 
 
-def lint_lane_dim(dim: int, location: str) -> Optional[Diagnostic]:
-    """W101 when a single matmul lane dim pads wastefully on the MXU."""
+def _is_conv(layer) -> bool:
+    """4-D spatial conv-family check WITHOUT importing nn.layers (this
+    module stays jax-free): the conv classes all carry kernel+stride
+    geometry.  Convolution1D/3D are excluded — the NHWC compute-layout
+    seam only stamps the 2-D family, so the layout-aware W101 text
+    would prescribe (or claim) a fix that never applies to them."""
+    name = type(layer).__name__
+    return (("Convolution" in name or "Deconvolution" in name)
+            and not name.endswith(("1D", "3D"))
+            and hasattr(layer, "kernel") and hasattr(layer, "stride"))
+
+
+def lint_lane_dim(dim: int, location: str, *, conv: bool = False,
+                  compute_layout: str = "NCHW") -> Optional[Diagnostic]:
+    """W101 when a single matmul lane dim pads wastefully on the MXU.
+
+    For conv layers the finding is layout-aware (the ISSUE-14 extension):
+    under the default NCHW compute layout the fix hint points at the
+    NHWC seam (``setComputeLayout("NHWC")`` / ``computeLayout("NHWC")``)
+    as well as the channel rounding; when the NHWC layout fix is ACTIVE
+    the message says so — the remaining waste is pure tile padding, and
+    only the channel count can recover it."""
     if not dim or dim < MIN_LINT_DIM or dim % MXU_LANES == 0:
         return None
     waste = padding_waste(dim)
     if waste <= WASTE_THRESHOLD:
         return None
     padded = ((dim + MXU_LANES - 1) // MXU_LANES) * MXU_LANES
-    return Diagnostic(
-        "DL4J-W101", Severity.WARNING, location,
-        f"lane dim {dim} pads to {padded} on the {MXU_SUBLANES}x{MXU_LANES} "
-        f"MXU tile grid — {waste:.0%} of every MAC in this matmul is dead "
-        f"padding",
-        fix_hint=f"round the feature/channel count to a multiple of "
-                 f"{MXU_LANES} (e.g. {padded} or "
-                 f"{max(MXU_LANES, padded - MXU_LANES)})")
+    msg = (f"lane dim {dim} pads to {padded} on the "
+           f"{MXU_SUBLANES}x{MXU_LANES} MXU tile grid — {waste:.0%} of "
+           f"every MAC in this matmul is dead padding")
+    hint = (f"round the feature/channel count to a multiple of "
+            f"{MXU_LANES} (e.g. {padded} or "
+            f"{max(MXU_LANES, padded - MXU_LANES)})")
+    if conv:
+        if compute_layout == "NHWC":
+            msg += (" (NHWC compute layout is active — the remaining "
+                    "waste is tile padding, not layout)")
+        else:
+            hint += ("; for conv stacks also enable the NHWC compute "
+                     "layout (setComputeLayout('NHWC') / builder "
+                     ".computeLayout('NHWC')) so channels sit on the "
+                     "lane axis natively")
+    return Diagnostic("DL4J-W101", Severity.WARNING, location, msg,
+                      fix_hint=hint)
 
 
-def lint_layers(located_layers) -> List[Diagnostic]:
+def lint_layers(located_layers,
+                compute_layout: str = "NCHW") -> List[Diagnostic]:
     """W101 over ``(location, layer)`` pairs using each layer's
-    ``mxu_lane_dims()`` declared-shape hook."""
+    ``mxu_lane_dims()`` declared-shape hook. ``compute_layout`` is the
+    model's active conv compute layout — it shapes the conv findings'
+    text (see ``lint_lane_dim``) without changing when they fire."""
     diags = []
     for location, layer in located_layers:
         dims = getattr(layer, "mxu_lane_dims", None)
         if dims is None:
             continue
+        conv = _is_conv(layer)
+        # a per-layer ``data_format`` stamp (the networks' NHWC seam —
+        # an INSTANCE attribute; the class default is not a stamp) wins
+        # over the config-level declaration
+        fmt = getattr(layer, "__dict__", {}).get("data_format") \
+            or compute_layout
         for d in dims():
-            diag = lint_lane_dim(d, location)
+            diag = lint_lane_dim(d, location, conv=conv,
+                                 compute_layout=fmt)
             if diag is not None:
                 diags.append(diag)
     return diags

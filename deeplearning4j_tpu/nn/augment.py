@@ -17,9 +17,11 @@ step compiles exactly once — the zero-steady-state-recompile property
 the W201 churn detector pins.
 
 Use :meth:`DeviceAugmentation.from_transforms` to compile the
-``ImageTransform`` presets that have device kernels; transforms without
-one (Rotate, Resize, probabilistic pipelines) raise — keep those on the
-host path (``decode(transform=...)``), which remains fully supported::
+``ImageTransform`` presets that have device kernels — Flip, Crop, Scale,
+Brightness, ColorConversion, Resize (``jax.image.resize`` bilinear), and
+Rotate (inverse-mapped bilinear gather) all do. Transforms without one
+(probabilistic/shuffled pipelines) raise — keep those on the host path
+(``decode(transform=...)``), which remains fully supported::
 
     aug = (DeviceAugmentation(seed=7)
            .crop(4)                  # random 4px crop -> [H-4, W-4]
@@ -151,6 +153,77 @@ class DeviceAugmentation:
         self._ops.append((("brightness", d, bool(random)), op))
         return self
 
+    def resize(self, height: int, width: int) -> "DeviceAugmentation":
+        """Bilinear resize to a fixed ``[height, width]`` (host
+        ``ResizeImageTransform`` moved on device via ``jax.image.resize``
+        — same bilinear family as the host PIL kernel; edge-sample
+        weights differ by implementation, so parity is distributional,
+        like the random ops)."""
+        h, w = int(height), int(width)
+        if h <= 0 or w <= 0:
+            raise ValueError("resize dims must be positive")
+
+        def op(x, key):
+            b, c = x.shape[0], x.shape[1]
+            return jax.image.resize(x, (b, c, h, w), "linear")
+        self._ops.append((("resize", h, w), op))
+        return self
+
+    def rotate(self, angle: float, random: bool = False
+               ) -> "DeviceAugmentation":
+        """Rotate about the image center by ``angle`` degrees (or a
+        per-image uniform draw in ``[-angle, angle]`` when ``random``) —
+        host ``RotateImageTransform`` moved on device: inverse-mapped
+        coordinate grid + bilinear gather, out-of-bounds filled with 0
+        (PIL's fill). Output shape is unchanged, so the compiled step
+        signature stays stable."""
+        a = float(angle)
+
+        def op(x, key):
+            b, c, h, w = x.shape
+            if random:
+                deg = jax.random.uniform(key, (b,), minval=-a, maxval=a)
+            else:
+                deg = jnp.full((b,), a, jnp.float32)
+            # PIL rotates counter-clockwise; inverse-map each output
+            # pixel back into the source image (hence the negated angle)
+            rad = -deg * (jnp.pi / 180.0)
+            cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+            yy = jnp.arange(h, dtype=jnp.float32)[:, None] - cy   # [H,1]
+            xx = jnp.arange(w, dtype=jnp.float32)[None, :] - cx   # [1,W]
+            cos = jnp.cos(rad)[:, None, None]
+            sin = jnp.sin(rad)[:, None, None]
+            sy = cos * yy - sin * xx + cy                         # [B,H,W]
+            sx = sin * yy + cos * xx + cx
+
+            y0 = jnp.floor(sy)
+            x0 = jnp.floor(sx)
+            wy = sy - y0
+            wx = sx - x0
+            y0i = y0.astype(jnp.int32)
+            x0i = x0.astype(jnp.int32)
+
+            def corner(img, yi, xi):
+                """img [C,H,W], yi/xi [H,W] -> gathered [C,H,W] with
+                out-of-bounds as 0."""
+                inb = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+                yc = jnp.clip(yi, 0, h - 1)
+                xc = jnp.clip(xi, 0, w - 1)
+                g = img[:, yc, xc]
+                return jnp.where(inb[None], g, 0.0)
+
+            def one(img, y0i, x0i, wy, wx):
+                tl = corner(img, y0i, x0i)
+                tr = corner(img, y0i, x0i + 1)
+                bl = corner(img, y0i + 1, x0i)
+                br = corner(img, y0i + 1, x0i + 1)
+                top = tl * (1 - wx) + tr * wx
+                bot = bl * (1 - wx) + br * wx
+                return top * (1 - wy) + bot * wy
+            return jax.vmap(one)(x, y0i, x0i, wy, wx).astype(x.dtype)
+        self._ops.append((("rotate", a, bool(random)), op))
+        return self
+
     def grayscale(self) -> "DeviceAugmentation":
         """RGB -> luma, kept 3-channel (host ``ColorConversionTransform``)."""
 
@@ -191,6 +264,10 @@ class DeviceAugmentation:
                     aug.flip(t.mode)
             elif isinstance(t, _img.CropImageTransform):
                 aug.crop(t.crop)
+            elif isinstance(t, _img.ResizeImageTransform):
+                aug.resize(t.height, t.width)
+            elif isinstance(t, _img.RotateImageTransform):
+                aug.rotate(t.angle, t.random)
             elif isinstance(t, _img.ScaleImageTransform):
                 aug.scale(t.scale)
             elif isinstance(t, _img.BrightnessTransform):
@@ -232,10 +309,13 @@ class DeviceAugmentation:
 
     def output_hw(self, height: int, width: int) -> Tuple[int, int]:
         """Static output spatial dims for declared input dims (crops
-        shrink them) — what the model's InputType should declare."""
+        shrink them, resizes replace them) — what the model's InputType
+        should declare."""
         for sig, _ in self._ops:
             if sig[0] == "crop":
                 height, width = height - sig[1], width - sig[1]
+            elif sig[0] == "resize":
+                height, width = sig[1], sig[2]
         return height, width
 
     def __repr__(self):

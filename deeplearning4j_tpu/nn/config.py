@@ -116,6 +116,7 @@ class NeuralNetConfiguration:
             self._grad_norm = None           # None | 'clip_value' | 'clip_l2' | 'clip_global' | 'renorm'
             self._grad_norm_threshold = 1.0
             self._dtype = "float32"
+            self._compute_layout = "NCHW"
 
         def seed(self, s):
             self._seed = int(s)
@@ -143,6 +144,18 @@ class NeuralNetConfiguration:
 
         def dataType(self, dt):
             self._dtype = str(dt)
+            return self
+
+        def computeLayout(self, fmt: str):
+            """Compute layout for spatial layers inside the compiled
+            step: "NHWC" runs conv/pool/BN channels-minor (TPU-
+            preferred; see the networks' setComputeLayout) while the
+            public NCHW API is unchanged."""
+            fmt = str(fmt).upper()
+            if fmt not in ("NCHW", "NHWC"):
+                raise ValueError(f"computeLayout must be 'NCHW' or "
+                                 f"'NHWC', got {fmt!r}")
+            self._compute_layout = fmt
             return self
 
         def gradientNormalization(self, kind, threshold: float = 1.0):
@@ -177,6 +190,7 @@ class NeuralNetConfiguration:
             cfg.grad_norm = self._grad_norm
             cfg.grad_norm_threshold = self._grad_norm_threshold
             cfg.dtype = self._dtype
+            cfg.compute_layout = self._compute_layout
             return cfg
 
     def __init__(self):
@@ -190,13 +204,14 @@ class NeuralNetConfiguration:
         self.grad_norm = None
         self.grad_norm_threshold = 1.0
         self.dtype = "float32"
+        self.compute_layout = "NCHW"
 
     def to_config(self):
         return {"seed": self.seed, "updater": self.updater.to_config(),
                 "weight_init": self.weight_init, "activation": self.activation,
                 "l1": self.l1, "l2": self.l2, "grad_norm": self.grad_norm,
                 "grad_norm_threshold": self.grad_norm_threshold,
-                "dtype": self.dtype}
+                "dtype": self.dtype, "compute_layout": self.compute_layout}
 
     @staticmethod
     def from_config(d):

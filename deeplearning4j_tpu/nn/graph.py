@@ -36,6 +36,7 @@ from deeplearning4j_tpu.nn.multilayer import (_dynamic_scale_next,
                                               _predict_batches,
                                               _process_and_apply_grads,
                                               _select_update)
+from deeplearning4j_tpu.profiler import devicetime as _devicetime
 from deeplearning4j_tpu.profiler import sanitizer as _sanitizer
 from deeplearning4j_tpu.train import stepping as _stepping
 
@@ -413,6 +414,14 @@ class ComputationGraph:
         self._precision = None  # PrecisionPolicy (see setPrecisionPolicy)
         self._scale_state = None  # dynamic loss scale [scale, good_steps]
         self._initialized = False
+        # NHWC compute layout + fused epilogues (ISSUE 14) — opt-in,
+        # public NCHW API unchanged (see MultiLayerNetwork)
+        self._compute_layout = "NCHW"
+        self._fuse_epilogues = False
+        self._epilogue_plan = None
+        fmt = getattr(conf.base, "compute_layout", None)
+        if fmt and fmt != "NCHW":
+            self.setComputeLayout(fmt)
 
     def validate(self, batch_size: int = None, data_devices: int = None,
                  **kw):
@@ -455,29 +464,68 @@ class ComputationGraph:
     def _forward(self, params, states, inputs: Dict[str, Any], train, key,
                  fmask=None):
         cdt = self._compute_dtype()
+        nhwc = self._compute_layout == "NHWC"
+        plan = self._ensure_epilogue_plan() if self._fuse_epilogues else {}
+        fused_act = {act: bn for bn, (act, _c, _a) in plan.items()}
+        fused_conv = {c for _a, c, _al in plan.values() if c}
         env = {k: (v.astype(jnp.float32)
                    if cdt is None and getattr(v, "dtype", None) == jnp.uint8
                    else v)
                for k, v in inputs.items()}   # on-device image-byte cast
+        fmt = {k: False for k in env}        # node name -> output is NHWC
+        pending_bias: Dict[str, Any] = {}    # fused conv name -> cast bias
         new_states = {}
-        for node in self.conf.topo:
-            xs = [env[i] for i in node.inputs]
+        for ti, node in enumerate(self.conf.topo):
+            if node.name in fused_act:
+                # folded into its BN's scale_shift_act epilogue; keep the
+                # RNG stream identical to the unfused forward
+                key, _ = jax.random.split(key)
+                env[node.name] = env[fused_act[node.name]]
+                fmt[node.name] = fmt[fused_act[node.name]]
+                new_states[node.name] = states[node.name]
+                continue
+            scope = _devicetime.scope_name(ti, node.name)
             if node.kind == "layer":
-                x = xs[0]
+                x = env[node.inputs[0]]
+                cur_nhwc = fmt[node.inputs[0]]
                 if node.name in self.conf.preprocessors:
+                    if cur_nhwc:
+                        x, cur_nhwc = L.to_nchw(x), False
                     x = self.conf.preprocessors[node.name](x)
+                x, cur_nhwc = L.layout_step(node.obj, x, cur_nhwc, nhwc)
                 p = params[node.name]
                 if cdt is not None:
                     p, x = L.policy_cast(node.obj, p, x, cdt)
                 key, sub = jax.random.split(key)
-                if isinstance(node.obj, _MASK_AWARE):
-                    out, ns = node.obj.apply(p, states[node.name],
-                                             x, train, sub, mask=fmask)
-                else:
-                    out, ns = node.obj.apply(p, states[node.name],
-                                             x, train, sub)
+                with jax.named_scope(scope):
+                    if node.name in plan:          # BN anchoring a fusion
+                        act_name, conv_name, alpha = plan[node.name]
+                        out, ns = L.fused_bn_act(
+                            node.obj, p, states[node.name], x, train, alpha,
+                            bias=pending_bias.pop(conv_name, None))
+                    elif node.name in fused_conv:  # bias folds into the BN
+                        out, ns = node.obj.apply(p, states[node.name], x,
+                                                 train, sub, skip_bias=True)
+                        pending_bias[node.name] = p.get("b")
+                    elif isinstance(node.obj, _MASK_AWARE):
+                        out, ns = node.obj.apply(p, states[node.name],
+                                                 x, train, sub, mask=fmask)
+                    else:
+                        out, ns = node.obj.apply(p, states[node.name],
+                                                 x, train, sub)
                 new_states[node.name] = ns
+                fmt[node.name] = cur_nhwc and getattr(out, "ndim", 0) == 4
             else:
+                xs = [env[i] for i in node.inputs]
+                in_fmts = [fmt[i] for i in node.inputs]
+                transparent = isinstance(node.obj, (ElementWiseVertex,
+                                                    ScaleVertex, ShiftVertex))
+                if transparent and any(in_fmts) and all(in_fmts):
+                    out_nhwc = True                # elementwise: keep NHWC
+                else:
+                    xs = [L.to_nchw(a) if f else a
+                          for a, f in zip(xs, in_fmts)]
+                    out_nhwc = False
                 if cdt is not None and len(xs) > 1:
                     # merge/elementwise vertices: align mixed fp32/bf16 inputs
                     # (e.g. a BN branch meeting a conv branch)
@@ -486,9 +534,12 @@ class ComputationGraph:
                         xs = [a.astype(jnp.bfloat16)
                               if getattr(a, "dtype", None) == jnp.float32 else a
                               for a in xs]
-                out = node.obj.apply(*xs)
+                with jax.named_scope(scope):
+                    out = node.obj.apply(*xs)
+                fmt[node.name] = out_nhwc and getattr(out, "ndim", 0) == 4
             env[node.name] = out
-        return [env[o] for o in self.conf.graph_outputs], new_states
+        return [L.to_nchw(env[o]) if fmt.get(o) else env[o]
+                for o in self.conf.graph_outputs], new_states
 
     def _as_input_dict(self, inputs) -> Dict[str, jnp.ndarray]:
         if isinstance(inputs, dict):
@@ -555,16 +606,23 @@ class ComputationGraph:
         return self
 
     def feedForward(self, inputs, train: bool = False):
+        """Per-node activations, PUBLIC layout (NCHW) even under the
+        NHWC compute seam."""
         ins = self._as_input_dict(inputs)
         env = dict(ins)
         key = jax.random.PRNGKey(0)
+        nhwc = self._compute_layout == "NHWC"
+        fmt = {k: False for k in env}
         acts = {}
         for node in self.conf.topo:
-            xs = [env[i] for i in node.inputs]
             if node.kind == "layer":
-                x = xs[0]
+                x = env[node.inputs[0]]
+                cur_nhwc = fmt[node.inputs[0]]
                 if node.name in self.conf.preprocessors:
+                    if cur_nhwc:
+                        x, cur_nhwc = L.to_nchw(x), False
                     x = self.conf.preprocessors[node.name](x)
+                x, cur_nhwc = L.layout_step(node.obj, x, cur_nhwc, nhwc)
                 key, sub = jax.random.split(key)
                 if isinstance(node.obj, _MASK_AWARE):
                     out, _ = node.obj.apply(self._params[node.name],
@@ -573,10 +631,14 @@ class ComputationGraph:
                 else:
                     out, _ = node.obj.apply(self._params[node.name],
                                             self._states[node.name], x, train, sub)
+                fmt[node.name] = cur_nhwc and getattr(out, "ndim", 0) == 4
             else:
+                xs = [L.to_nchw(env[i]) if fmt[i] else env[i]
+                      for i in node.inputs]
                 out = node.obj.apply(*xs)
+                fmt[node.name] = False
             env[node.name] = out
-            acts[node.name] = out
+            acts[node.name] = L.to_nchw(out) if fmt[node.name] else out
         return acts
 
     # ------------------------------------------------------------------ loss
@@ -746,7 +808,8 @@ class ComputationGraph:
         return (fp,
                 pol.signature() if pol is not None else None,
                 aug.signature() if aug is not None else None,
-                steps)
+                steps, self._compute_layout,
+                self._fuse_epilogues)
 
     def _dynamic_scaling(self) -> bool:
         pol = self._precision
@@ -784,6 +847,81 @@ class ComputationGraph:
         if self._t_dev is None:
             self._t_dev = jnp.asarray(self._iteration, jnp.int32)
         return self._t_dev
+
+    def setComputeLayout(self, fmt: str) -> "ComputationGraph":
+        """NHWC compute layout for the conv stacks — semantics identical
+        to ``MultiLayerNetwork.setComputeLayout`` (channels-minor conv/
+        pool/BN inside the compiled step, transpose-at-boundary, public
+        NCHW API unchanged; elementwise vertices — the ResNet residual
+        add — stay in NHWC between aware layers)."""
+        if fmt not in ("NCHW", "NHWC"):
+            raise ValueError(f"compute layout must be 'NCHW' or 'NHWC', "
+                             f"got {fmt!r}")
+        if fmt != getattr(self, "_compute_layout", "NCHW"):
+            self._train_step_cache.clear()
+            self._megastep_cache.clear()
+            self._fwd_cache = None
+        self._compute_layout = fmt
+        # recorded on the config too, so save/load round-trips the seam
+        self.conf.base.compute_layout = fmt
+        self._conf_fingerprint = None    # config JSON changed
+        L.stamp_layout([n.obj for n in self.conf.topo if n.kind == "layer"],
+                       fmt)
+        return self
+
+    def setEpilogueFusion(self, enabled: bool = True) -> "ComputationGraph":
+        """Fuse conv-bias+BN+relu / BN+leaky blocks into one
+        ``scale_shift_act`` dispatch — see
+        ``MultiLayerNetwork.setEpilogueFusion``. On a graph, a fusion
+        anchors at a BatchNormalization node whose ONLY consumer is a
+        relu/leaky ActivationLayer node (the folded conv additionally
+        requires the BN to be the conv's only consumer)."""
+        enabled = bool(enabled)
+        if enabled != self._fuse_epilogues:
+            self._train_step_cache.clear()
+            self._megastep_cache.clear()
+            self._fwd_cache = None
+            self._epilogue_plan = None
+        self._fuse_epilogues = enabled
+        return self
+
+    def _ensure_epilogue_plan(self):
+        """{bn_node: (act_node, folded_conv_node|None, alpha)} — static,
+        built once per fusion toggle from the graph topology."""
+        if self._epilogue_plan is not None:
+            return self._epilogue_plan
+        conf = self.conf
+        consumers: Dict[str, List[str]] = {}
+        for node in conf.topo:
+            for inp in node.inputs:
+                consumers.setdefault(inp, []).append(node.name)
+        for out in conf.graph_outputs:
+            consumers.setdefault(out, []).append("__output__")
+        plan: Dict[str, tuple] = {}
+        by_name = conf.node_by_name
+        for node in conf.topo:
+            if node.kind != "layer" or not L.fusable_bn(node.obj):
+                continue
+            cons = consumers.get(node.name, [])
+            if len(cons) != 1 or cons[0] == "__output__":
+                continue
+            act_node = by_name[cons[0]]
+            if (act_node.kind != "layer" or len(act_node.inputs) != 1
+                    or act_node.name in conf.preprocessors):
+                continue
+            alpha = L.activation_alpha(act_node.obj)
+            if alpha is None:
+                continue
+            conv_name = None
+            src = by_name.get(node.inputs[0]) if node.inputs else None
+            if (src is not None and src.kind == "layer"
+                    and L.fusable_conv(src.obj) and src.obj.has_bias
+                    and len(consumers.get(src.name, [])) == 1
+                    and node.name not in conf.preprocessors):
+                conv_name = src.name
+            plan[node.name] = (act_node.name, conv_name, alpha)
+        self._epilogue_plan = plan
+        return plan
 
     def setDeviceAugmentation(self, augment) -> "ComputationGraph":
         """Attach (or detach with ``None``) a

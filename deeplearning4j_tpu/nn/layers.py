@@ -81,6 +81,13 @@ class Layer:
 
     input_kind: Optional[str] = "ff"
     has_params = True
+    #: compute layout for spatial (4-D) inputs. "NCHW" is the reference's
+    #: public layout everywhere; the networks' ``setComputeLayout("NHWC")``
+    #: stamps layout-aware layers with an instance attribute so conv/pool/
+    #: BN/LRN paths run channels-minor on the MXU while the public API
+    #: (weights [O,I,kH,kW], inputs/outputs NCHW) is unchanged — the
+    #: forward transposes once at each layout boundary.
+    data_format = "NCHW"
 
     def __init__(self, nOut: int = None, nIn: int = None, activation: str = None,
                  weightInit: str = None, biasInit: float = 0.0,
@@ -323,11 +330,13 @@ class ConvolutionLayer(Layer):
             shapes["b"] = (self.nOut,)
         return shapes
 
-    def apply(self, params, state, x, train, key):
+    def apply(self, params, state, x, train, key, *, skip_bias=False):
         x = self._maybe_dropout(x, train, key)
-        out = conv_ops.conv2d(x, params["W"], params.get("b"),
+        out = conv_ops.conv2d(x, params["W"],
+                              None if skip_bias else params.get("b"),
                               stride=self.stride, pad=self.padding,
-                              dilation=self.dilation, mode=self.mode)
+                              dilation=self.dilation, mode=self.mode,
+                              data_format=self.data_format)
         return act.get(self.activation)(out), state
 
     def output_type(self, it: InputType) -> InputType:
@@ -343,7 +352,8 @@ class Deconvolution2D(ConvolutionLayer):
 
     def apply(self, params, state, x, train, key):
         out = conv_ops.deconv2d(x, params["W"], params.get("b"),
-                                stride=self.stride, pad=self.padding, mode=self.mode)
+                                stride=self.stride, pad=self.padding,
+                                mode=self.mode, data_format=self.data_format)
         return act.get(self.activation)(out), state
 
     def output_type(self, it: InputType) -> InputType:
@@ -385,7 +395,8 @@ class DepthwiseConvolution2D(ConvolutionLayer):
     def apply(self, params, state, x, train, key):
         out = conv_ops.depthwise_conv2d(x, params["W"], params.get("b"),
                                         stride=self.stride, pad=self.padding,
-                                        dilation=self.dilation, mode=self.mode)
+                                        dilation=self.dilation, mode=self.mode,
+                                        data_format=self.data_format)
         return act.get(self.activation)(out), state
 
 
@@ -421,7 +432,8 @@ class SeparableConvolution2D(ConvolutionLayer):
         out = conv_ops.separable_conv2d(x, params["Wd"], params["Wp"],
                                         params.get("b"), stride=self.stride,
                                         pad=self.padding, dilation=self.dilation,
-                                        mode=self.mode)
+                                        mode=self.mode,
+                                        data_format=self.data_format)
         return act.get(self.activation)(out), state
 
 
@@ -459,7 +471,7 @@ class SubsamplingLayer(Layer):
         fn = {"max": conv_ops.maxpool2d, "avg": conv_ops.avgpool2d,
               "pnorm": conv_ops.pnormpool2d}[self.pooling]
         kw = {"kernel": self.kernel, "stride": self.stride, "pad": self.padding,
-              "mode": self.mode}
+              "mode": self.mode, "data_format": self.data_format}
         if self.pooling == "pnorm":
             kw["pnorm"] = self.pnorm
         return fn(x, **kw), state
@@ -504,18 +516,23 @@ class BatchNormalization(Layer):
             return {}
         return {"gamma": (self.nIn,), "beta": (self.nIn,)}
 
+    def _channel_axis(self, x) -> int:
+        if x.ndim == 4 and self.data_format == "NHWC":
+            return x.ndim - 1
+        return 1 if x.ndim >= 3 else x.ndim - 1
+
     def apply(self, params, state, x, train, key):
         # mixed-precision island handled inside the ops: stats accumulate
         # fp32, the normalize is an FMA in x.dtype (no fp32 activation copy)
-        axis = 1 if x.ndim >= 3 else -1
+        axis = self._channel_axis(x)
         if train:
             out, new_mean, new_var = norm_ops.batch_norm_train(
                 x, params["gamma"], params["beta"], state["mean"], state["var"],
-                eps=self.eps, decay=self.decay, axis=axis if axis != -1 else x.ndim - 1)
+                eps=self.eps, decay=self.decay, axis=axis)
             return out, {"mean": new_mean, "var": new_var}
         out = norm_ops.batch_norm(x, params["gamma"], params["beta"],
                                   state["mean"], state["var"], eps=self.eps,
-                                  axis=axis if axis != -1 else x.ndim - 1)
+                                  axis=axis)
         return out, state
 
     def output_type(self, it: InputType) -> InputType:
@@ -541,7 +558,7 @@ class LocalResponseNormalization(Layer):
 
     def apply(self, params, state, x, train, key):
         return norm_ops.lrn(x, depth=self.n, alpha=self.alpha, beta=self.beta,
-                            bias=self.k), state
+                            bias=self.k, data_format=self.data_format), state
 
     def output_type(self, it):
         return it
@@ -635,7 +652,8 @@ class ZeroPaddingLayer(Layer):
         self.nIn = self.nOut = it.channels
 
     def apply(self, params, state, x, train, key):
-        return conv_ops.zero_padding2d(x, self.pad), state
+        return conv_ops.zero_padding2d(x, self.pad,
+                                       data_format=self.data_format), state
 
     def output_type(self, it):
         p = self.pad
@@ -660,7 +678,8 @@ class Upsampling2D(Layer):
         self.nIn = self.nOut = it.channels
 
     def apply(self, params, state, x, train, key):
-        return conv_ops.upsampling2d(x, self.scale), state
+        return conv_ops.upsampling2d(x, self.scale,
+                                     data_format=self.data_format), state
 
     def output_type(self, it):
         return InputType.convolutional(it.height * self.scale[0],
@@ -681,7 +700,8 @@ class Cropping2D(Layer):
         self.nIn = self.nOut = it.channels
 
     def apply(self, params, state, x, train, key):
-        return conv_ops.cropping2d(x, self.crop), state
+        return conv_ops.cropping2d(x, self.crop,
+                                   data_format=self.data_format), state
 
     def output_type(self, it):
         c = self.crop
@@ -708,7 +728,10 @@ class GlobalPoolingLayer(Layer):
             else it.size if it.kind == "rnn" else it.arrayElementsPerExample()
 
     def apply(self, params, state, x, train, key, mask=None):
-        return conv_ops.global_pool(x, self.pooling, data_format="NCHW",
+        # the NHWC stamp only applies to spatial input; rnn [N,C,T] input
+        # stays channels-second regardless of the compute layout
+        fmt = self.data_format if x.ndim == 4 else "NCHW"
+        return conv_ops.global_pool(x, self.pooling, data_format=fmt,
                                     mask=mask), state
 
     def output_type(self, it):
@@ -1602,6 +1625,173 @@ def policy_cast(layer, params, x, compute_dt):
             lambda a: a.astype(compute_dt)
             if getattr(a, "dtype", None) == jnp.float32 else a, params)
     return params, x
+
+
+# ----------------------------------------------------------- compute layout
+# NHWC seam (ISSUE 14): image convs on TPU want channels on the lane
+# (minor-most) axis — XLA's NCHW lowering transposes internally per op or
+# runs channel-padded tiles (the W101 story). The networks'
+# ``setComputeLayout("NHWC")`` keeps the PUBLIC layout NCHW (inputs,
+# outputs, weights [O,I,kH,kW], checkpoints) and transposes once at each
+# layout boundary inside the compiled step; layout-aware layers carry a
+# ``data_format`` stamp their apply reads.
+
+#: layers whose apply computes natively in NHWC when stamped (the conv
+#: family covers Deconvolution/Depthwise/Separable via subclassing)
+LAYOUT_AWARE = (ConvolutionLayer, SubsamplingLayer, BatchNormalization,
+                LocalResponseNormalization, ZeroPaddingLayer, Upsampling2D,
+                Cropping2D, GlobalPoolingLayer)
+
+#: elementwise layers that keep whatever layout flows in (no transpose)
+LAYOUT_TRANSPARENT = (ActivationLayer, DropoutLayer)
+
+
+def to_nhwc(x):
+    return jnp.transpose(x, (0, 2, 3, 1))
+
+
+def to_nchw(x):
+    return jnp.transpose(x, (0, 3, 1, 2))
+
+
+def layout_step(layer, x, cur_nhwc: bool, nhwc_active: bool):
+    """THE transpose-at-boundary rule, one layer at a time: returns
+    ``(x, now_nhwc)``. Aware layers pull spatial input into NHWC,
+    transparent layers keep whatever flows in, everything else (dense,
+    output heads, preprocess boundaries) forces NCHW back. Shared by the
+    compiled forwards, ``feedForward``, the sanitizer's eager replay
+    walkers, and the devicetime bridge so the mirrors cannot drift."""
+    if getattr(x, "ndim", 0) != 4:
+        return x, False
+    want = (nhwc_active and isinstance(layer, LAYOUT_AWARE)) or \
+        (cur_nhwc and isinstance(layer, LAYOUT_TRANSPARENT))
+    if want and not cur_nhwc:
+        return to_nhwc(x), True
+    if not want and cur_nhwc:
+        return to_nchw(x), False
+    return x, cur_nhwc
+
+
+def stamp_layout(layers, fmt: str) -> None:
+    """Stamp ``data_format`` on every layout-aware layer (instance attr,
+    so it round-trips through to_config/from_config). ``"NCHW"`` removes
+    the stamp, restoring the class default."""
+    if fmt not in ("NCHW", "NHWC"):
+        raise ValueError(f"compute layout must be 'NCHW' or 'NHWC', "
+                         f"got {fmt!r}")
+    for layer in layers:
+        if isinstance(layer, LAYOUT_AWARE):
+            if fmt == "NHWC":
+                layer.data_format = "NHWC"
+            elif "data_format" in layer.__dict__:
+                del layer.data_format
+
+
+# --------------------------------------------------------- fused epilogues
+# bias+BN+activation epilogue fusion (ISSUE 14): the conv stacks' hot
+# non-matmul block is BatchNorm followed by relu/leaky-relu. Fused here
+# into ONE scale_shift_act op — batch statistics stay the fp32
+# reductions of norm_ops.batch_norm_train, the normalize+activation
+# becomes a single FMA+select the 'scale_shift_act' registry op executes
+# (a Pallas VMEM one-pass kernel when the platform override is installed
+# and the shape tiles; the composed-jnp generic otherwise, which is
+# bit-identical to the unfused batch_norm+activation path). A preceding
+# identity-activation conv's bias folds into the shift algebraically
+# (BN subtracts the mean, so the bias cancels in train mode and shifts
+# the recorded running mean; inference un-shifts it from the running
+# stats) — the conv itself dispatches bias-less.
+
+
+def activation_alpha(layer) -> Optional[float]:
+    """The epilogue slope for an ActivationLayer: 0.0 for relu, the leak
+    for leakyrelu, None for anything else (not fusable)."""
+    if type(layer) is not ActivationLayer or layer.dropout:
+        return None
+    name = str(layer.activation or "").lower()
+    if name == "relu":
+        return 0.0
+    if name == "leakyrelu":
+        return 0.01      # ops.activations.leakyrelu default slope
+    return None
+
+
+def fusable_conv(layer) -> bool:
+    """A plain ConvolutionLayer whose own epilogue is empty (identity
+    activation, no dropout) and whose bias can therefore fold into the
+    following BN's shift."""
+    return (type(layer) is ConvolutionLayer
+            and str(layer.activation or "identity").lower() == "identity"
+            and not layer.dropout)
+
+
+def fusable_bn(layer) -> bool:
+    return type(layer) is BatchNormalization and not layer.dropout
+
+
+def fused_bn_act(bn, params, state, x, train, alpha: float, bias=None):
+    """BatchNorm + relu/leaky epilogue (+ optional folded conv bias) as
+    one ``scale_shift_act`` dispatch. Returns ``(out, new_bn_state)``.
+
+    Statistics are bit-identical to ``norm_ops.batch_norm_train`` (fp32
+    accumulate); with ``bias`` the batch stats run over the BIAS-LESS
+    conv output (variance is bias-invariant; the recorded running mean
+    adds the bias back so inference-mode behaviour matches the unfused
+    stack).
+    """
+    from deeplearning4j_tpu.ops import registry as _registry
+    axis = bn._channel_axis(x)
+    gamma, beta = params["gamma"], params["beta"]
+    b32 = bias.astype(jnp.float32) if bias is not None else None
+    if train:
+        axes = tuple(i for i in range(x.ndim) if i != axis)
+        m = jnp.mean(x, axis=axes, dtype=jnp.float32)
+        m2 = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=axes)
+        v = jnp.maximum(m2 - jnp.square(m), 0.0)
+        m_rec = m + b32 if b32 is not None else m
+        new_state = {"mean": bn.decay * state["mean"] + (1.0 - bn.decay) * m_rec,
+                     "var": bn.decay * state["var"] + (1.0 - bn.decay) * v}
+        mean_eff = m        # the folded bias cancels against the batch mean
+    else:
+        mean_eff = state["mean"] - b32 if b32 is not None else state["mean"]
+        v = state["var"]
+        new_state = state
+    inv = jax.lax.rsqrt(v.astype(jnp.float32) + bn.eps)
+    scale = (gamma * inv).astype(x.dtype)
+    shift = (beta - gamma * mean_eff * inv).astype(x.dtype)
+    out = _registry.get("scale_shift_act")(x, scale, shift, alpha=alpha,
+                                           axis=axis)
+    return out, new_state
+
+
+def build_epilogue_plan(layers, preprocessors=()) -> Dict[int, Tuple[int, bool, float]]:
+    """Static fusion plan over a sequential layer list:
+    ``{start_index: (n_layers_consumed, conv_leads, alpha)}`` —
+    3 for conv(identity,bias)+BN+act triples (bias folds), 2 for BN+act
+    pairs. Built once at step-compile time; fit dispatch consults it.
+
+    ``preprocessors`` are the layer indices carrying an input
+    preprocessor: a block whose INTERIOR index has one cannot fuse (the
+    fused dispatch jumps straight through and would drop it); one at the
+    block's start is fine — it runs before the block either way."""
+    plan: Dict[int, Tuple[int, bool, float]] = {}
+    pre = frozenset(preprocessors)
+    i = 0
+    while i < len(layers):
+        if (i + 2 < len(layers) and fusable_conv(layers[i])
+                and layers[i].has_bias and fusable_bn(layers[i + 1])
+                and activation_alpha(layers[i + 2]) is not None
+                and not (pre & {i + 1, i + 2})):
+            plan[i] = (3, True, activation_alpha(layers[i + 2]))
+            i += 3
+            continue
+        if (i + 1 < len(layers) and fusable_bn(layers[i])
+                and activation_alpha(layers[i + 1]) is not None
+                and i + 1 not in pre):
+            plan[i] = (2, False, activation_alpha(layers[i + 1]))
+            i += 2
+            continue
+        i += 1
+    return plan
 
 
 class SelfAttentionLayer(Layer):

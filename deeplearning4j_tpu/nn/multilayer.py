@@ -22,6 +22,7 @@ import numpy as np
 
 from deeplearning4j_tpu import profiler as _prof
 from deeplearning4j_tpu.analysis import churn as _churn
+from deeplearning4j_tpu.profiler import devicetime as _devicetime
 from deeplearning4j_tpu.profiler import sanitizer as _sanitizer
 from deeplearning4j_tpu.data.dataset import (AsyncDataSetIterator, DataSet,
                                              DataSetIterator,
@@ -213,6 +214,14 @@ class MultiLayerNetwork:
         self._scale_state = None  # dynamic loss scale [scale, good_steps]
         self._score = float("nan")
         self._initialized = False
+        # NHWC compute-layout seam + fused epilogues (ISSUE 14) — both
+        # opt-in; public API/layouts stay NCHW either way
+        self._compute_layout = "NCHW"
+        self._fuse_epilogues = False
+        self._epilogue_plan = None
+        fmt = getattr(conf.base, "compute_layout", None)
+        if fmt and fmt != "NCHW":
+            self.setComputeLayout(fmt)
 
     # ------------------------------------------------------------ validation
     def validate(self, batch_size: int = None, data_devices: int = None,
@@ -269,36 +278,90 @@ class MultiLayerNetwork:
         cdt = self._compute_dtype()
         if cdt is None and getattr(x, "dtype", None) == jnp.uint8:
             x = x.astype(jnp.float32)   # on-device image-byte cast (fp32 nets)
-        new_states = []
-        for i, layer in enumerate(self.layers):
+        nhwc = self._compute_layout == "NHWC"
+        plan = self._ensure_epilogue_plan() if self._fuse_epilogues else {}
+        new_states = [None] * len(self.layers)
+        cur_nhwc = False
+        i = 0
+        while i < len(self.layers):
+            layer = self.layers[i]
             if i in self.conf.preprocessors:
+                if cur_nhwc:
+                    x, cur_nhwc = L.to_nchw(x), False
                 x = self.conf.preprocessors[i](x)
+            x, cur_nhwc = L.layout_step(layer, x, cur_nhwc, nhwc)
+            fuse = plan.get(i)
+            scope = _devicetime.scope_name(
+                i, getattr(layer, "name", None) or type(layer).__name__)
+            if fuse is not None:
+                n_used, conv_leads, alpha = fuse
+                # one RNG split per consumed layer keeps the key stream
+                # identical to the unfused forward (downstream dropout
+                # draws the same bits — the parity pins rely on it)
+                subs = []
+                for _ in range(n_used):
+                    key, sub = jax.random.split(key)
+                    subs.append(sub)
+                with jax.named_scope(scope):
+                    bn_idx = i
+                    bias = None
+                    if conv_leads:
+                        p = params[i]
+                        if cdt is not None:
+                            p, x = L.policy_cast(layer, p, x, cdt)
+                        x, new_states[i] = layer.apply(
+                            p, states[i], x, train, subs[0], skip_bias=True)
+                        bias = p.get("b")
+                        bn_idx = i + 1
+                    bn = self.layers[bn_idx]
+                    pbn = params[bn_idx]
+                    if cdt is not None:
+                        pbn, x = L.policy_cast(bn, pbn, x, cdt)
+                    x, new_states[bn_idx] = L.fused_bn_act(
+                        bn, pbn, states[bn_idx], x, train, alpha, bias=bias)
+                for j in range(bn_idx + 1, i + n_used):
+                    new_states[j] = states[j]   # the folded activation
+                i += n_used
+                continue
             p = params[i]
             if cdt is not None:
                 p, x = L.policy_cast(layer, p, x, cdt)
             key, sub = jax.random.split(key)
-            if isinstance(layer, _MASK_AWARE):
-                x, ns = layer.apply(p, states[i], x, train, sub, mask=fmask)
-            else:
-                x, ns = layer.apply(p, states[i], x, train, sub)
-            new_states.append(ns)
+            with jax.named_scope(scope):
+                if isinstance(layer, _MASK_AWARE):
+                    x, ns = layer.apply(p, states[i], x, train, sub,
+                                        mask=fmask)
+                else:
+                    x, ns = layer.apply(p, states[i], x, train, sub)
+            new_states[i] = ns
+            i += 1
+        if cur_nhwc and getattr(x, "ndim", 0) == 4:
+            x = L.to_nchw(x)
         return x, new_states
 
     def feedForward(self, x, train: bool = False):
-        """All layer activations (ref: feedForward returns list)."""
+        """All layer activations (ref: feedForward returns list). The
+        returned activations are PUBLIC-layout (NCHW) even under the
+        NHWC compute seam."""
         x = jnp.asarray(x)
         acts = [x]
         key = jax.random.PRNGKey(0)
         cur = x
+        nhwc = self._compute_layout == "NHWC"
+        cur_nhwc = False
         for i, layer in enumerate(self.layers):
             if i in self.conf.preprocessors:
+                if cur_nhwc:
+                    cur, cur_nhwc = L.to_nchw(cur), False
                 cur = self.conf.preprocessors[i](cur)
+            cur, cur_nhwc = L.layout_step(layer, cur, cur_nhwc, nhwc)
             key, sub = jax.random.split(key)
             if isinstance(layer, _MASK_AWARE):
                 cur, _ = layer.apply(self._params[i], self._states[i], cur, train, sub, mask=None)
             else:
                 cur, _ = layer.apply(self._params[i], self._states[i], cur, train, sub)
-            acts.append(cur)
+            cur_nhwc = cur_nhwc and getattr(cur, "ndim", 0) == 4
+            acts.append(L.to_nchw(cur) if cur_nhwc else cur)
         return acts
 
     def output(self, x, train: bool = False):
@@ -542,7 +605,8 @@ class MultiLayerNetwork:
                 pol.signature() if pol is not None else None,
                 aug.signature() if aug is not None else None,
                 tuple(sorted(getattr(self, "_frozen_layers", None) or ())),
-                steps)
+                steps, self._compute_layout,
+                self._fuse_epilogues)
 
     def _dynamic_scaling(self) -> bool:
         pol = self._precision
@@ -582,6 +646,56 @@ class MultiLayerNetwork:
         if self._t_dev is None:
             self._t_dev = jnp.asarray(self._iteration, jnp.int32)
         return self._t_dev
+
+    def setComputeLayout(self, fmt: str) -> "MultiLayerNetwork":
+        """Compute layout for the conv stacks: ``"NHWC"`` runs conv/pool/
+        BN/LRN channels-minor inside the compiled step (the MXU-preferred
+        layout W101 points at) with ONE transpose at each layout
+        boundary; the public API — inputs, outputs, weights
+        ``[O,I,kH,kW]``, checkpoints — stays NCHW and is bit-compatible.
+        ``"NCHW"`` (default) restores the reference layout. Changing the
+        layout busts the compiled step caches (one recompile); steady
+        state stays at zero recompiles either way."""
+        if fmt not in ("NCHW", "NHWC"):
+            raise ValueError(f"compute layout must be 'NCHW' or 'NHWC', "
+                             f"got {fmt!r}")
+        if fmt != getattr(self, "_compute_layout", "NCHW"):
+            self._train_step_cache = {}
+            self._megastep_cache = {}
+            self._fwd_cache = None
+        self._compute_layout = fmt
+        # recorded on the config too, so save/load round-trips the seam
+        # (the per-layer stamps alone would deserialize into an NCHW
+        # forward feeding NHWC-stamped layers)
+        self.conf.base.compute_layout = fmt
+        # the config JSON changed: recompute the persistent-cache
+        # fingerprint so a fresh process hashing the saved config lands
+        # on the same disk keys
+        self._conf_fingerprint = None
+        L.stamp_layout(self.layers, fmt)
+        return self
+
+    def setEpilogueFusion(self, enabled: bool = True) -> "MultiLayerNetwork":
+        """Fuse conv-bias+BN+relu (and BN+leaky-relu) blocks into ONE
+        ``scale_shift_act`` dispatch — a Pallas one-pass VMEM kernel on
+        channels-minor shapes that tile (install
+        ``ops.pallas_kernels.install_platform_overrides()``), the
+        bit-identical composed-jnp lowering otherwise. Opt-in; busts the
+        step caches when toggled."""
+        enabled = bool(enabled)
+        if enabled != self._fuse_epilogues:
+            self._train_step_cache = {}
+            self._megastep_cache = {}
+            self._fwd_cache = None
+            self._epilogue_plan = None
+        self._fuse_epilogues = enabled
+        return self
+
+    def _ensure_epilogue_plan(self):
+        if self._epilogue_plan is None:
+            self._epilogue_plan = L.build_epilogue_plan(
+                self.layers, self.conf.preprocessors)
+        return self._epilogue_plan
 
     def setDeviceAugmentation(self, augment) -> "MultiLayerNetwork":
         """Attach (or detach with ``None``) a

@@ -64,6 +64,26 @@ def batch_norm_train(x, gamma, beta, running_mean, running_var, *,
     return out, new_mean, new_var
 
 
+def scale_shift_act(x, scale, shift, *, alpha: float = 0.0, axis: int = 1):
+    """Fused per-channel FMA + relu/leaky epilogue: ``act(x*scale+shift)``
+    with ``scale``/``shift`` broadcast along ``axis`` (the bias+BN+ReLU
+    block of the conv stacks, pre-folded into scale/shift by the
+    caller — see ``nn.layers.fused_bn_act``). ``alpha`` is the negative
+    slope: 0.0 = relu, 0.01 = the reference's leaky-relu.
+
+    This generic lowering is bit-identical to ``batch_norm`` followed by
+    the activation; the Pallas platform override (``ops.pallas_kernels.
+    make_scale_shift_act_override``) shadows it with a one-VMEM-pass
+    kernel on channels-minor shapes that tile."""
+    shape = [1] * x.ndim
+    shape[axis] = -1
+    y = x * jnp.reshape(scale.astype(x.dtype), shape) \
+        + jnp.reshape(shift.astype(x.dtype), shape)
+    if alpha == 0.0:
+        return jax.nn.relu(y)
+    return jnp.where(y >= 0, y, alpha * y)
+
+
 def layer_norm(x, gain, bias=None, *, axis=-1, eps: float = 1e-5):
     """Layer norm (ref: libnd4j ``layer_norm``)."""
     axes = (axis,) if isinstance(axis, int) else tuple(axis)

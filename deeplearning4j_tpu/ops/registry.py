@@ -422,6 +422,7 @@ register("batchnorm", _norm.batch_norm)
 register("batchnorm_sd", lambda x, m, v, g, b, eps=1e-5, axis=1:
          _norm.batch_norm(x, g, b, m, v, eps=eps, axis=axis))
 register("layer_norm", _norm.layer_norm)
+register("scale_shift_act", _norm.scale_shift_act)
 register("rms_norm", _norm.rms_norm)
 register("lrn", _norm.lrn)
 register("dropout", _norm.dropout)

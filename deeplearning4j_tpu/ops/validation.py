@@ -727,6 +727,12 @@ def _build_nn_cases() -> List[OpCase]:
         golden=lambda x, g: x / np.sqrt((x * x).mean(-1, keepdims=True)
                                         + 1e-6) * g)
     add("lrn", lambda rng: (rng.randn(1, 4, 3, 3).astype(np.float32),))
+    add("scale_shift_act", lambda rng: (rng.randn(4, 6).astype(np.float32),
+                                        rng.randn(6).astype(np.float32),
+                                        rng.randn(6).astype(np.float32)),
+        golden=lambda x, sc, sh, alpha=0.01, axis=-1:
+        np.where(x * sc + sh >= 0, x * sc + sh, alpha * (x * sc + sh)),
+        kwargs={"alpha": 0.01, "axis": -1}, grad=True)
     add("bias_add", lambda rng: (rng.randn(2, 3).astype(np.float32),
                                  rng.randn(3).astype(np.float32)),
         golden=lambda x, b: x + b, grad=True)

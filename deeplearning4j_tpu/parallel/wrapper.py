@@ -52,6 +52,58 @@ class ParallelWrapper:
         return self.model.validate(batch_size=batch_size, mesh=self.mesh,
                                    **kw)
 
+    def warmup(self, shapes, *, steps_per_dispatch: int = 1, dtype=None,
+               label_dtype=None, policy=None):
+        """AOT-warm the wrapped model's programs under THIS wrapper's
+        mesh — replicated params, batch-sharded inputs — through the
+        PR-13 compile-cache seam (the replication-path warmup the
+        elastic shrink path already had). ``shapes`` follows
+        ``nn.compilecache.warmup``: ``(features, labels)`` pairs warm
+        the train step/megastep, bare feature shapes warm the forward.
+        Batch dims are padded up to a multiple of the data-axis width
+        exactly like ``fit`` pads real batches, so the warmed program IS
+        the dispatched one. With a persistent cache dir configured, a
+        fresh process warms from disk (zero cold compiles)."""
+        from deeplearning4j_tpu.nn import compilecache as _cc
+        model = self.model
+        if not model._initialized:
+            model.init()
+        n = self.mesh.size("data")
+
+        def pad_shape(shape):
+            shape = tuple(int(d) for d in shape)
+            b = shape[0]
+            if b % n:
+                b += n - b % n
+            return (b,) + shape[1:]
+
+        padded = []
+        for spec in shapes:
+            if (isinstance(spec, (tuple, list)) and len(spec) == 2
+                    and isinstance(spec[0], (tuple, list))):
+                padded.append((pad_shape(spec[0]), pad_shape(spec[1])))
+            else:
+                padded.append(pad_shape(spec))
+        k = max(int(steps_per_dispatch), 1)
+        if k > 1 and any(not (isinstance(s, (tuple, list)) and len(s) == 2
+                              and isinstance(s[0], (tuple, list)))
+                         for s in padded):
+            raise ValueError(
+                "steps_per_dispatch>1 warms the megastep from "
+                "(features, labels) pairs; bare forward shapes cannot "
+                "be megabatched — warm them in a separate call")
+        with self.mesh:
+            model._ensure_opt_state()
+            model._params = self.mesh.replicate(model._params)
+            model._states = self.mesh.replicate(model._states)
+            model._opt_state = self.mesh.replicate(model._opt_state)
+            model._t_dev = None
+            _cc.warmup(model, padded, policy=policy,
+                       steps_per_dispatch=k, dtype=dtype,
+                       label_dtype=label_dtype,
+                       placement=lambda a: self._mesh_placement(a, k > 1))
+        return model
+
     def fit(self, iterator: DataSetIterator, epochs: int = 1,
             steps_per_dispatch: int = 1, checkpoint=None, nan_policy=None,
             faults=None, elastic=None):
@@ -118,6 +170,12 @@ class ParallelWrapper:
             # see incompatible devices; _ensure_clock rebuilds it (fresh,
             # uncommitted) from _iteration on the first sharded step
             model._t_dev = None
+            from deeplearning4j_tpu.nn import compilecache as _cc
+            # auto-warm the first sharded batch signature when the
+            # persistent cache is engaged (PR-13 carried remainder: the
+            # plain replication path now flows through the same seam the
+            # elastic shrink re-warm uses)
+            warm_first = _cc.cache_dir() is not None
             from deeplearning4j_tpu.train.resilience import fit_scope
             with fit_scope(session, model, epochs) as n_epochs:
                 for e in range(n_epochs):
@@ -134,7 +192,20 @@ class ParallelWrapper:
                         stream = session.wrap_batches(pulls()) \
                             if session is not None else pulls()
                         for ds in stream:
-                            model._fit_one(self._shard(ds))
+                            sds = self._shard(ds)
+                            if warm_first:
+                                # replication-path warmup through the
+                                # compile-cache seam: the first sharded
+                                # signature AOT-compiles (or loads from
+                                # the persistent disk tier) before the
+                                # dispatch, which then hits the warmed
+                                # executable — zero extra compiles
+                                warm_first = False
+                                model._warm_dispatch(
+                                    sds.features, sds.labels,
+                                    fmask=sds.features_mask,
+                                    lmask=sds.labels_mask)
+                            model._fit_one(sds)
                     model._epoch += 1
                     if session is not None:
                         session.on_epoch_end()

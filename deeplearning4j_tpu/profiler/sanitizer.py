@@ -407,9 +407,50 @@ def _walk_multilayer(model, params, states, x, fmask, t, train):
         x = x.astype(jnp.float32)
     key = jax.random.fold_in(jax.random.PRNGKey(model.conf.base.seed),
                              jnp.asarray(t, jnp.int32))
-    for i, layer in enumerate(model.layers):
+    nhwc = getattr(model, "_compute_layout", "NCHW") == "NHWC"
+    plan = model._ensure_epilogue_plan() \
+        if getattr(model, "_fuse_epilogues", False) else {}
+    cur_nhwc = False
+    i = 0
+    while i < len(model.layers):
+        layer = model.layers[i]
         if i in model.conf.preprocessors:
+            if cur_nhwc:
+                x, cur_nhwc = L.to_nchw(x), False
             x = model.conf.preprocessors[i](x)
+        x, cur_nhwc = L.layout_step(layer, x, cur_nhwc, nhwc)
+        fuse = plan.get(i)
+        if fuse is not None:
+            # mirror the fused-epilogue dispatch (same split count, same
+            # bias fold) so replay reproduces the compiled step exactly
+            n_used, conv_leads, alpha = fuse
+            subs = []
+            for _ in range(n_used):
+                key, sub = jax.random.split(key)
+                subs.append(sub)
+            bn_idx = i
+            bias = None
+            if conv_leads:
+                p = params[i]
+                if cdt is not None:
+                    p, x = L.policy_cast(layer, p, x, cdt)
+                x, _ = layer.apply(p, states[i], x, train, subs[0],
+                                   skip_bias=True)
+                bias = p.get("b")
+                yield f"{i}:{layer.name}", layer, p, x
+                bn_idx = i + 1
+            bn = model.layers[bn_idx]
+            pbn = params[bn_idx]
+            if cdt is not None:
+                pbn, x = L.policy_cast(bn, pbn, x, cdt)
+            x, _ = L.fused_bn_act(bn, pbn, states[bn_idx], x, train,
+                                  alpha, bias=bias)
+            yield f"{bn_idx}:{bn.name}", bn, pbn, x
+            for j in range(bn_idx + 1, i + n_used):
+                yield (f"{j}:{model.layers[j].name}", model.layers[j],
+                       params[j], x)      # the folded activation
+            i += n_used
+            continue
         p = params[i]
         if cdt is not None:
             p, x = L.policy_cast(layer, p, x, cdt)
@@ -418,7 +459,9 @@ def _walk_multilayer(model, params, states, x, fmask, t, train):
             x, _ = layer.apply(p, states[i], x, train, sub, mask=fmask)
         else:
             x, _ = layer.apply(p, states[i], x, train, sub)
+        cur_nhwc = cur_nhwc and getattr(x, "ndim", 0) == 4
         yield f"{i}:{layer.name}", layer, p, x
+        i += 1
 
 
 def _walk_graph(model, params, states, env, t, train):
@@ -434,22 +477,53 @@ def _walk_graph(model, params, states, env, t, train):
     cdt = model._compute_dtype()
     key = jax.random.fold_in(jax.random.PRNGKey(model.conf.base.seed),
                              jnp.asarray(t, jnp.int32))
+    nhwc = getattr(model, "_compute_layout", "NCHW") == "NHWC"
+    plan = model._ensure_epilogue_plan() \
+        if getattr(model, "_fuse_epilogues", False) else {}
+    fused_act = {act: bn for bn, (act, _c, _a) in plan.items()}
+    fused_conv = {c for _a, c, _al in plan.values() if c}
+    pending_bias = {}
+    fmt = {k: False for k in env}
     for node in model.conf.topo:
+        if node.name in fused_act:
+            # folded into its BN's epilogue; keep the RNG stream aligned
+            key, _ = jax.random.split(key)
+            env[node.name] = env[fused_act[node.name]]
+            fmt[node.name] = fmt[fused_act[node.name]]
+            yield node, None, env[node.name]
+            continue
         xs = [env[i] for i in node.inputs]
         if node.kind == "layer":
             xv = xs[0]
+            cur_nhwc = fmt.get(node.inputs[0], False)
             if node.name in model.conf.preprocessors:
+                if cur_nhwc:
+                    xv, cur_nhwc = L.to_nchw(xv), False
                 xv = model.conf.preprocessors[node.name](xv)
+            xv, cur_nhwc = L.layout_step(node.obj, xv, cur_nhwc, nhwc)
             p = params[node.name]
             if cdt is not None:
                 p, xv = L.policy_cast(node.obj, p, xv, cdt)
             key, sub = jax.random.split(key)
-            if isinstance(node.obj, _MASK_AWARE):
+            if node.name in plan:          # BN anchoring a fusion
+                _act, conv_name, alpha = plan[node.name]
+                out, _ = L.fused_bn_act(
+                    node.obj, p, states[node.name], xv, train, alpha,
+                    bias=pending_bias.pop(conv_name, None))
+            elif node.name in fused_conv:  # bias folds into the BN
+                out, _ = node.obj.apply(p, states[node.name], xv, train,
+                                        sub, skip_bias=True)
+                pending_bias[node.name] = p.get("b")
+            elif isinstance(node.obj, _MASK_AWARE):
                 out, _ = node.obj.apply(p, states[node.name], xv, train,
                                         sub, mask=None)
             else:
                 out, _ = node.obj.apply(p, states[node.name], xv, train,
                                         sub)
+            fmt[node.name] = cur_nhwc and getattr(out, "ndim", 0) == 4
+            if fmt[node.name]:
+                out = L.to_nchw(out)     # env stays public-layout NCHW
+                fmt[node.name] = False
         else:
             if cdt is not None and len(xs) > 1:
                 if any(getattr(a, "dtype", None) == jnp.bfloat16

@@ -692,15 +692,23 @@ class TestDeviceAugmentation:
         assert "sentinel" not in net._train_step_cache
 
     def test_from_transforms_unsupported_raises(self):
-        from deeplearning4j_tpu.data.image import (PipelineImageTransform,
+        from deeplearning4j_tpu.data.image import (ImageTransform,
+                                                   PipelineImageTransform,
                                                    RotateImageTransform,
                                                    ScaleImageTransform)
         from deeplearning4j_tpu.nn.augment import DeviceAugmentation
+
+        class ExoticTransform(ImageTransform):
+            pass
+
         with pytest.raises(ValueError, match="no device kernel"):
-            DeviceAugmentation.from_transforms([RotateImageTransform(10)])
+            DeviceAugmentation.from_transforms([ExoticTransform()])
         with pytest.raises(ValueError, match="probabilistic"):
             DeviceAugmentation.from_transforms([PipelineImageTransform(
                 [(ScaleImageTransform(0.5), 0.3)])])
+        # Rotate gained a device kernel in PR 14 — it compiles now
+        aug = DeviceAugmentation.from_transforms([RotateImageTransform(10)])
+        assert aug.signature()[1][0] == "rotate"
 
     def test_output_hw_and_crop_shapes(self):
         import jax
