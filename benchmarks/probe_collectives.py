@@ -1,0 +1,116 @@
+"""Collective-volume characterization: W107's scaling model vs compiled HLO.
+
+The W107 lint predicts each layer's per-step gradient-allreduce payload
+with the ring model (``2(N-1)/N x`` the per-device gradient shard). In
+the spirit of the CUDA-Aware-MPI characterization paper (PAPERS.md):
+don't trust a scaling model you never measured against the real
+program. This probe compiles the GSPMD train step
+(:class:`~deeplearning4j_tpu.distributed.gspmd.ShardedTrainingPlan`,
+one ``jax.jit`` with shardings) across mesh shapes, extracts the
+all-reduce / all-gather / reduce-scatter byte counts from the
+POST-SPMD-PARTITIONING HLO, and asserts the lint's estimate is within
+2x of the measured all-reduce volume at every mesh shape.
+
+Accounting note: XLA may fuse per-layer gradient all-reduces or emit
+reduce-scatter + all-gather pairs; the comparison is therefore against
+the TOTAL gradient-collective bytes (all-reduce + reduce-scatter +
+all-gather attributable to the backward), which is what the lint's sum
+models. HLO shape bytes are per-device op outputs; the ring factor is
+applied to both sides identically.
+
+Run: ``python benchmarks/probe_collectives.py [--json]`` — prints one
+JSON line; non-zero exit when any mesh shape misses the 2x envelope.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# 8 virtual CPU devices, set before jax initializes (same bootstrap as
+# tests/conftest.py)
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("DL4J_TPU_MATMUL_PRECISION", "float32")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from deeplearning4j_tpu.analysis.distribution import (  # noqa: E402
+    estimate_gradient_collectives)
+from deeplearning4j_tpu.data.dataset import DataSet  # noqa: E402
+from deeplearning4j_tpu.distributed import ShardedTrainingPlan  # noqa: E402
+from deeplearning4j_tpu.distributed.gspmd import (  # noqa: E402
+    compiled_train_step_hlo, hlo_collective_bytes)
+from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,  # noqa: E402
+                                   NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer  # noqa: E402
+from deeplearning4j_tpu.parallel.mesh import DeviceMesh  # noqa: E402
+from deeplearning4j_tpu.train import updaters  # noqa: E402
+
+#: backward-pass gradient collectives the ring model covers
+GRAD_COLLECTIVES = ("all-reduce", "reduce-scatter", "all-gather")
+
+
+def build_model():
+    conf = (NeuralNetConfiguration.Builder().seed(5)
+            .updater(updaters.Sgd(0.1)).list()
+            .layer(DenseLayer(nOut=512, activation="relu"))
+            .layer(DenseLayer(nOut=256, activation="relu"))
+            .layer(OutputLayer(nOut=32, lossFunction="mcxent",
+                               activation="softmax"))
+            .setInputType(InputType.feedForward(256))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def measure(n_data: int, per_shard: int = 16) -> dict:
+    model = build_model()
+    mesh = DeviceMesh.create(data=n_data, model=1, seq=1,
+                             devices=jax.devices()[:n_data])
+    plan = ShardedTrainingPlan(mesh)
+    model.setShardingPlan(plan)
+    plan.apply(model)
+    batch = per_shard * n_data
+    rng = np.random.RandomState(0)
+    X = rng.randn(batch, 256).astype(np.float32)
+    Y = np.eye(32, dtype=np.float32)[rng.randint(0, 32, batch)]
+    hlo = compiled_train_step_hlo(model, X, Y)
+    coll = hlo_collective_bytes(hlo)
+    # measured side with the same ring accounting the lint applies: an
+    # HLO all-reduce op of size S moves ~2(N-1)/N * S per device
+    ring = 2.0 * (n_data - 1) / n_data
+    measured = ring * sum(coll.get(k, 0) for k in GRAD_COLLECTIVES)
+    estimate = sum(estimate_gradient_collectives(model.conf,
+                                                 mesh.spec()).values())
+    ratio = (estimate / measured) if measured else float("inf")
+    # one real dispatch to confirm the compiled program actually runs
+    model._fit_one(DataSet(X, Y))
+    ok = measured > 0 and 0.5 <= ratio <= 2.0
+    return {"data_shards": n_data, "global_batch": batch,
+            "hlo_collective_bytes": coll,
+            "measured_ring_bytes": int(measured),
+            "w107_estimate_bytes": int(estimate),
+            "estimate_over_measured": round(ratio, 4),
+            "within_2x": ok}
+
+
+def main(argv):
+    points = [measure(n) for n in (2, 4, 8)]
+    ok = all(p["within_2x"] for p in points)
+    print(json.dumps({"probe": "collectives",
+                      "lint_model": "ring allreduce 2(N-1)/N x grad shard",
+                      "points": points, "ok": ok}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -106,6 +106,10 @@ def main(argv=None) -> int:
                     help="declared device mesh, e.g. 'data=8' or "
                          "'data=4,model=2' — enables the E1xx/W10x "
                          "distribution lints")
+    ap.add_argument("--zero", action="store_true",
+                    help="declare ZeRO updater-state sharding over the "
+                         "data axis (ISSUE 15): E104 counts optimizer "
+                         "state at 1/data-axis and W109 stays quiet")
     ap.add_argument("--hbm-gb", type=float, default=None,
                     help="per-device HBM budget in GiB for the E104 "
                          "parameter-footprint check (default 16)")
@@ -158,6 +162,8 @@ def main(argv=None) -> int:
             ap.error(f"--severity: {e}")
     if args.hbm_gb is not None and not args.mesh:
         ap.error("--hbm-gb needs a mesh declaration: pass --mesh as well")
+    if args.zero and not args.mesh:
+        ap.error("--zero needs a mesh declaration: pass --mesh as well")
     policy_spec = None
     if args.policy:
         from deeplearning4j_tpu.nn.precision import PrecisionPolicy
@@ -240,7 +246,9 @@ def main(argv=None) -> int:
     for name, obj in targets:
         report = analyze(obj, batch_size=args.batch_size,
                          data_devices=args.devices, mesh=args.mesh,
-                         hbm_gb=args.hbm_gb, input_pipeline=pipeline_spec,
+                         hbm_gb=args.hbm_gb,
+                         zero=True if args.zero else None,
+                         input_pipeline=pipeline_spec,
                          policy=policy_spec, data_range=range_spec,
                          suppress=suppress, severity_overrides=overrides)
         report.subject = name

@@ -36,7 +36,7 @@ _REGRESSION_LOSSES = {"mse", "l2", "l1", "mae", "squaredloss", "huber"}
 
 def analyze(target, batch_size: Optional[int] = None,
             data_devices: Optional[int] = None, mesh=None, sharding=None,
-            pipeline=None, hbm_gb: Optional[float] = None,
+            pipeline=None, hbm_gb: Optional[float] = None, zero=None,
             input_pipeline=None, policy=None, data_range=None,
             suppress=None, severity_overrides=None) -> ValidationReport:
     """Analyze a configuration, builder, network, or SameDiff graph.
@@ -48,7 +48,11 @@ def analyze(target, batch_size: Optional[int] = None,
     ``{axis: size}`` dict, a ``"data=8,model=2"`` string, or a runtime
     ``DeviceMesh``) switches on the E1xx/W10x distribution lints;
     ``sharding`` (``ShardingRule`` or {regex: spec}), ``pipeline``
-    (``PipelineSpec``/stage count), and ``hbm_gb`` refine them.
+    (``PipelineSpec``/stage count), ``hbm_gb``, and ``zero`` (a ZeRO
+    updater-state-sharding declaration: ``True``, an axis name, a
+    dict, or a runtime ``distributed.zero.ZeroPlan`` — E104 then
+    counts updater state at 1/data-axis and W109 stays quiet) refine
+    them.
     ``input_pipeline`` (an
     :class:`~deeplearning4j_tpu.analysis.pipeline.InputPipelineSpec`,
     dict, or ``"workers=8,batch=256,decode_ms=1.3"`` string) switches on
@@ -64,7 +68,7 @@ def analyze(target, batch_size: Optional[int] = None,
     (:meth:`ValidationReport.apply_config`).
     """
     conf = getattr(target, "conf", target)
-    mesh_spec = _mesh_spec(mesh, sharding, pipeline, hbm_gb)
+    mesh_spec = _mesh_spec(mesh, sharding, pipeline, hbm_gb, zero)
     if hasattr(conf, "_nodes") and hasattr(conf, "_placeholders"):
         if mesh_spec is not None:
             raise ValueError(
@@ -105,20 +109,24 @@ def analyze(target, batch_size: Optional[int] = None,
     return report.apply_config(suppress, severity_overrides)
 
 
-def _mesh_spec(mesh, sharding, pipeline, hbm_gb) -> Optional[MeshSpec]:
+def _mesh_spec(mesh, sharding, pipeline, hbm_gb,
+               zero=None) -> Optional[MeshSpec]:
     spec = MeshSpec.coerce(mesh)
     if spec is None:
         if sharding is not None or pipeline is not None \
-                or hbm_gb is not None:
-            raise ValueError("sharding/pipeline/hbm_gb lints need a mesh "
-                             "declaration — pass mesh=... as well")
+                or hbm_gb is not None or zero is not None:
+            raise ValueError("sharding/pipeline/hbm_gb/zero lints need a "
+                             "mesh declaration — pass mesh=... as well")
         return None
-    if sharding is not None or pipeline is not None or hbm_gb is not None:
+    if sharding is not None or pipeline is not None or hbm_gb is not None \
+            or zero is not None:
         spec = MeshSpec(
             spec.axes, data_axis=spec.data_axis,
             sharding=sharding if sharding is not None else spec.sharding,
             pipeline=pipeline if pipeline is not None else spec.pipeline,
-            hbm_gb=hbm_gb if hbm_gb is not None else spec.hbm_gb)
+            hbm_gb=hbm_gb if hbm_gb is not None else spec.hbm_gb,
+            devices=spec.devices,   # keep the E102 axes-vs-devices lint
+            zero=zero if zero is not None else spec.zero)
     return spec
 
 

@@ -92,6 +92,12 @@ DIAGNOSTIC_CODES = {
                  "per-core decode rate, bandwidth / image bytes) is below "
                  "the model's estimated device img/s — the accelerator "
                  "idles regardless of stage overlap",
+    "DL4J-W109": "replicated optimizer state: a data-parallel mesh trains "
+                 "with the full updater state (Adam moments etc.) "
+                 "replicated on every replica above the size threshold "
+                 "and no ZeRO plan declared — cross-replica weight-update "
+                 "sharding (distributed.zero.ZeroPlan) cuts per-device "
+                 "optimizer HBM ~n_data x with identical math",
     # E11x/W11x serving-config lints (analysis/serving.py): validate the
     # bucket ladder x mesh x HBM budget before warmup burns the compiles.
     "DL4J-E110": "serving bucket/mesh mismatch: a batch bucket does not "

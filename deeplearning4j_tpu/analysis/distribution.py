@@ -23,6 +23,9 @@ Codes (documented in :mod:`analysis.diagnostics`):
 - ``W105`` pipeline stage FLOP imbalance beyond tolerance
 - ``W106`` sub-MXU per-device shard after splitting
 - ``W107`` per-layer gradient-collective bytes per step above threshold
+- ``W109`` data-parallel mesh with fully-replicated optimizer state
+  above threshold and no ZeRO plan declared (ISSUE 15: declare
+  ``zero=`` — the runtime mirror is ``distributed.zero.ZeroPlan``)
 
 Entry points: ``analyze(conf, mesh=...)`` / ``conf.validate(mesh=...)``
 (the lints run from :mod:`analysis.analyzer`), and the CLI's ``--mesh``
@@ -47,6 +50,33 @@ COLLECTIVE_BYTES_THRESHOLD = 1024 ** 3
 #: Default E104 per-device HBM budget (GiB) — a TPUv4-ish chip. Params
 #: only; the message reminds that optimizer state multiplies it.
 DEFAULT_HBM_GB = 16.0
+#: W109 only fires when the replicated per-device optimizer state
+#: exceeds this (small state is the normal, correct layout).
+OPT_REPLICATED_BYTES_THRESHOLD = 64 * 1024 * 1024
+
+#: Per-updater optimizer-state size factor (state bytes = factor x param
+#: bytes) — the jax-free mirror of ``train.updaters`` ``init_state``
+#: shapes, keyed by config class name.
+UPDATER_STATE_FACTORS = {
+    "Sgd": 0, "NoOp": 0,
+    "Nesterovs": 1, "RmsProp": 1, "AdaGrad": 1,
+    "Adam": 2, "AdamW": 2, "Nadam": 2, "AdaMax": 2, "AdaDelta": 2,
+    "AMSGrad": 3,
+}
+
+
+def updater_state_factor(updater) -> int:
+    """Optimizer-state bytes per parameter byte for an updater config
+    (instance, class, or name string). Unknown stateful updaters
+    default to 2 (the Adam-family shape); stateless to 0."""
+    if updater is None:
+        return 0
+    name = updater if isinstance(updater, str) \
+        else type(updater).__name__ if not isinstance(updater, type) \
+        else updater.__name__
+    if name in UPDATER_STATE_FACTORS:
+        return UPDATER_STATE_FACTORS[name]
+    return 2 if getattr(updater, "has_state", True) else 0
 
 _DTYPE_BYTES = {"float64": 8, "double": 8, "f64": 8,
                 "float32": 4, "float": 4, "f32": 4,
@@ -129,7 +159,7 @@ class MeshSpec:
 
     def __init__(self, axes: Dict[str, int], data_axis: str = "data",
                  sharding=None, pipeline=None, hbm_gb: float = DEFAULT_HBM_GB,
-                 devices: Optional[int] = None):
+                 devices: Optional[int] = None, zero=None):
         self.axes = {str(k): int(v) for k, v in dict(axes).items()}
         for name, size in self.axes.items():
             if size < 1:
@@ -138,12 +168,35 @@ class MeshSpec:
         self.sharding = sharding
         self.pipeline = PipelineSpec.coerce(pipeline)
         self.hbm_gb = hbm_gb
+        # ZeRO declaration (ISSUE 15): the jax-free mirror of
+        # ``distributed.zero.ZeroPlan`` — {"axis": ..., "min_bytes": ...}.
+        # When declared, E104 counts updater state at 1/axis-size and
+        # W109 stays quiet.
+        self.zero = self._coerce_zero(zero)
         # optional PHYSICAL device count: when declared (DeviceMesh.spec()
         # does, and the elastic shrink revalidation does), _lint_axes
         # checks the axes product against it (E102) — a mesh declaration
         # that no longer matches the surviving hardware is exactly the
         # misconfiguration an elastic resume must catch before replicating
         self.devices = None if devices is None else int(devices)
+
+    def _coerce_zero(self, zero) -> Optional[Dict[str, Any]]:
+        if zero is None or zero is False:
+            return None
+        if zero is True:
+            return {"axis": self.data_axis, "min_bytes": 65536}
+        if isinstance(zero, str):
+            return {"axis": zero, "min_bytes": 65536}
+        if isinstance(zero, dict):
+            return {"axis": str(zero.get("axis", self.data_axis)),
+                    "min_bytes": int(zero.get("min_bytes", 65536))}
+        # duck-typed runtime ZeroPlan (never imported: stays jax-free)
+        axis = getattr(zero, "axis", None)
+        if axis is not None:
+            return {"axis": str(axis),
+                    "min_bytes": int(getattr(zero, "min_bytes", 65536))}
+        raise TypeError(f"cannot interpret {zero!r} as a ZeRO declaration "
+                        "(use True, an axis name, or a dict)")
 
     @staticmethod
     def parse(text: str) -> "MeshSpec":
@@ -401,7 +454,9 @@ def lint_multilayer(conf, mesh: MeshSpec,
     entries = [(_layer_loc(i, l), l, types[i][0], types[i][1])
                for i, l in enumerate(layers)]
     diags = lint_entries(entries, mesh, batch_size,
-                         getattr(getattr(conf, "base", None), "dtype", None))
+                         getattr(getattr(conf, "base", None), "dtype", None),
+                         updater=getattr(getattr(conf, "base", None),
+                                         "updater", None))
     diags.extend(_lint_pipeline(entries, mesh))
     return diags
 
@@ -414,20 +469,29 @@ def lint_graph(conf, mesh: MeshSpec,
     entries = [(_node_loc(n), n.obj, None, None)
                for n in conf.nodes if n.kind == "layer"]
     return lint_entries(entries, mesh, batch_size,
-                        getattr(getattr(conf, "base", None), "dtype", None))
+                        getattr(getattr(conf, "base", None), "dtype", None),
+                        updater=getattr(getattr(conf, "base", None),
+                                        "updater", None))
 
 
 def lint_entries(entries, mesh: MeshSpec, batch_size: Optional[int],
-                 dtype) -> List[Diagnostic]:
+                 dtype, updater=None) -> List[Diagnostic]:
     """Mesh-wide checks over ``(location, layer, in_type, out_type)``
-    entries — shared by the sequential and graph paths."""
+    entries — shared by the sequential and graph paths. ``updater``
+    (the config's IUpdater, when known) feeds the optimizer-state
+    accounting: the ZeRO-aware E104 and the W109 replicated-state
+    warning."""
     diags: List[Diagnostic] = []
     diags.extend(_lint_batch(mesh, batch_size))
     diags.extend(_lint_axes(mesh))
     facts = _param_facts(entries, mesh, dtype_bytes(dtype))
     diags.extend(_lint_hbm(facts, mesh,
-                           _stage_assignment(mesh, len(entries))))
+                           _stage_assignment(mesh, len(entries)),
+                           updater=updater))
     diags.extend(_lint_replicated(facts, mesh))
+    diags.extend(_lint_opt_replication(facts, mesh, updater,
+                                       _stage_assignment(mesh,
+                                                         len(entries))))
     diags.extend(_lint_shard_geometry(facts, mesh))
     diags.extend(_lint_collectives(facts, mesh))
     return diags
@@ -546,37 +610,121 @@ def _lint_pipeline(entries, mesh: MeshSpec) -> List[Diagnostic]:
     return diags
 
 
+def _zero_state_divisor(f: "_ParamFact", mesh: MeshSpec) -> int:
+    """How many ways the declared ZeRO plan splits this param's updater
+    state — the static mirror of ``ZeroPlan.state_spec``: the data-axis
+    size when the tensor is big enough and has a free dim the axis
+    divides, else 1 (state keeps the param's sharding)."""
+    zero = mesh.zero
+    if zero is None:
+        return 1
+    n = mesh.size(zero["axis"])
+    if n <= 1 or f.bytes_total < zero["min_bytes"]:
+        return 1
+    spec = tuple(f.spec) + (None,) * (len(f.shape) - len(f.spec))
+    if zero["axis"] in _spec_axes(spec):
+        # the param spec already shards over the ZeRO axis (FSDP-style):
+        # bytes_per_device is already divided by it — dividing again
+        # would under-count E104's state bytes n-fold
+        return 1
+    for dim, entry in zip(f.shape, spec):
+        if entry is None and dim >= n and dim % n == 0:
+            return n
+    return 1
+
+
+def _opt_bytes_per_device(f: "_ParamFact", mesh: MeshSpec,
+                          factor: int) -> float:
+    return f.bytes_per_device * factor / _zero_state_divisor(f, mesh)
+
+
 def _lint_hbm(facts, mesh: MeshSpec,
-              stages: Optional[List[int]] = None) -> List[Diagnostic]:
+              stages: Optional[List[int]] = None,
+              updater=None) -> List[Diagnostic]:
     if mesh.hbm_gb is None or not facts:
         return []
     budget = float(mesh.hbm_gb) * 1024 ** 3
+    # E104 counts updater state only under a declared ZeRO plan (ISSUE
+    # 15): each state tensor at 1/data-axis of its replicated size. The
+    # no-ZeRO replicated-optimizer hazard is W109's, keeping E104's
+    # params-only baseline stable for existing budgets.
+    factor = updater_state_factor(updater) if mesh.zero is not None else 0
+
+    def per_device(f):
+        return f.bytes_per_device + _opt_bytes_per_device(f, mesh, factor)
+
     if stages is not None:
         # pipeline: a device holds only its own stage's layers — budget
         # the heaviest stage, not the whole model
         per_stage: Dict[int, float] = {}
         for f in facts:
             per_stage[stages[f.idx]] = per_stage.get(stages[f.idx], 0.0) \
-                + f.bytes_per_device
+                + per_device(f)
         worst = max(per_stage, key=per_stage.get)
         total = per_stage[worst]
         location = f"pipeline stage {worst}"
         facts = [f for f in facts if stages[f.idx] == worst]
     else:
-        total = sum(f.bytes_per_device for f in facts)
+        total = sum(per_device(f) for f in facts)
         location = "mesh"
     if total <= budget:
         return []
     top = sorted(facts, key=lambda f: -f.bytes_per_device)[:3]
     biggest = "; ".join(f"{f.name} {f.shape} {_fmt_bytes(f.bytes_per_device)}"
                         f"/device" for f in top)
+    if factor:
+        accounting = (f"params + ZeRO-sharded updater state over "
+                      f"{mesh.size(mesh.zero['axis'])} "
+                      f"'{mesh.zero['axis']}' shards")
+    else:
+        accounting = "params only — optimizer state multiplies this 2-3x"
     return [Diagnostic(
         "DL4J-E104", Severity.ERROR, location,
         f"per-device parameter footprint {_fmt_bytes(total)} exceeds the "
-        f"{mesh.hbm_gb:g} GiB HBM budget (params only — optimizer state "
-        f"multiplies this 2-3x). Biggest shards: {biggest}",
+        f"{mesh.hbm_gb:g} GiB HBM budget ({accounting}). "
+        f"Biggest shards: {biggest}",
         fix_hint="shard the large tensors over a model axis (ShardingRule"
                  "), raise the budget (--hbm-gb), or shrink the model")]
+
+
+def _lint_opt_replication(facts, mesh: MeshSpec, updater,
+                          stages: Optional[List[int]] = None
+                          ) -> List[Diagnostic]:
+    """W109: a data-parallel mesh training with fully-replicated
+    optimizer state above threshold and NO ZeRO plan declared — every
+    extra replica burns ``factor x params`` HBM that cross-replica
+    weight-update sharding would reclaim (PAPERS.md). Stage-aware like
+    E104: under a pipeline, a device replicates only its own stage's
+    state."""
+    if mesh.zero is not None or not facts:
+        return []
+    n = mesh.size(mesh.data_axis)
+    if n <= 1:
+        return []
+    factor = updater_state_factor(updater)
+    if factor < 1:
+        return []
+    if stages is not None:
+        per_stage: Dict[int, float] = {}
+        for f in facts:
+            per_stage[stages[f.idx]] = per_stage.get(stages[f.idx], 0.0) \
+                + f.bytes_per_device
+        opt_bytes = max(per_stage.values()) * factor
+    else:
+        opt_bytes = sum(f.bytes_per_device for f in facts) * factor
+    if opt_bytes <= OPT_REPLICATED_BYTES_THRESHOLD:
+        return []
+    return [Diagnostic(
+        "DL4J-W109", Severity.WARNING, "mesh",
+        f"fully-replicated optimizer state: "
+        f"{type(updater).__name__ if updater is not None else 'the updater'}"
+        f" keeps {_fmt_bytes(opt_bytes)} of state on EVERY of the {n} "
+        f"'{mesh.data_axis}' replicas — sharding it across the data axis "
+        f"(ZeRO-style cross-replica weight-update sharding) cuts that to "
+        f"~{_fmt_bytes(opt_bytes / n)} per device with identical math",
+        fix_hint="declare zero= on the mesh (MeshSpec(zero=True)) and "
+                 "train with ShardedTrainingPlan(mesh, "
+                 "zero=ZeroPlan()) — distributed.zero")]
 
 
 def _lint_replicated(facts, mesh: MeshSpec) -> List[Diagnostic]:
@@ -638,30 +786,53 @@ def _lint_shard_geometry(facts, mesh: MeshSpec) -> List[Diagnostic]:
     return diags
 
 
-def _lint_collectives(facts, mesh: MeshSpec) -> List[Diagnostic]:
-    """Per-layer gradient-allreduce estimate from the SHARDED facts: the
-    gradient carries the parameter's sharding, so model-sharding a tensor
-    shrinks its allreduce payload — following W104/W107's own fix hint
-    clears the warning."""
+def collective_payload_estimates(facts, mesh: MeshSpec) -> Dict[str, float]:
+    """The W107 scaling model: per-layer estimated gradient-allreduce
+    payload in bytes per device per step — ring allreduce moves
+    ~``2(N-1)/N`` of each per-device gradient shard over the data axis.
+    Returns {} on a 1-wide data axis (no gradient collective at all)."""
     n = mesh.size(mesh.data_axis)
     if n <= 1:
-        return []
+        return {}
     ring = 2.0 * (n - 1) / n
     per_layer: Dict[str, float] = {}
     for f in facts:
         per_layer[f.location] = per_layer.get(f.location, 0.0) \
             + f.bytes_per_device
+    return {loc: b * ring for loc, b in per_layer.items()}
+
+
+def estimate_gradient_collectives(conf, mesh) -> Dict[str, float]:
+    """Public entry for the collective-volume characterization
+    (``benchmarks/probe_collectives.py``): the SAME per-layer estimate
+    the W107 lint thresholds, for a sequential configuration under any
+    mesh declaration. Jax-free — the measured counterpart comes from
+    the compiled HLO (``distributed.gspmd.hlo_collective_bytes``)."""
+    from deeplearning4j_tpu.analysis.analyzer import _layer_loc
+    mesh = MeshSpec.coerce(mesh)
+    entries = [(_layer_loc(i, l), l, None, None)
+               for i, l in enumerate(conf.layers)]
+    facts = _param_facts(entries, mesh, dtype_bytes(
+        getattr(getattr(conf, "base", None), "dtype", None)))
+    return collective_payload_estimates(facts, mesh)
+
+
+def _lint_collectives(facts, mesh: MeshSpec) -> List[Diagnostic]:
+    """Per-layer gradient-allreduce estimate from the SHARDED facts: the
+    gradient carries the parameter's sharding, so model-sharding a tensor
+    shrinks its allreduce payload — following W104/W107's own fix hint
+    clears the warning."""
     diags = []
-    for loc, pbytes in per_layer.items():
-        payload = pbytes * ring
+    n = mesh.size(mesh.data_axis)
+    for loc, payload in collective_payload_estimates(facts, mesh).items():
         if payload > COLLECTIVE_BYTES_THRESHOLD:
             diags.append(Diagnostic(
                 "DL4J-W107", Severity.WARNING, loc,
                 f"estimated gradient allreduce for this layer moves "
                 f"{_fmt_bytes(payload)} per device per step (ring "
-                f"allreduce of its {_fmt_bytes(pbytes)} per-device grad "
-                f"shard over {n} '{mesh.data_axis}' devices) — likely "
-                f"the step's communication bottleneck",
+                f"allreduce of its {_fmt_bytes(payload * n / (2.0 * (n - 1)))}"
+                f" per-device grad shard over {n} '{mesh.data_axis}' "
+                f"devices) — likely the step's communication bottleneck",
                 fix_hint="shard the tensor over a model axis, keep grads "
                          "in bf16 for the allreduce, or shrink the layer"))
     return diags

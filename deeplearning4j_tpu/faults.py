@@ -35,6 +35,13 @@ seedable*, behind the seams the real failures would hit:
 - **Slow replica at step k** — a shorter stall (``slow_seconds``)
   modelling one replica lagging the collective; the straggler
   histogram, not the timeout path, must account for it.
+- **Coordinator peer death at barrier generation g**
+  (``coord_peer_death={"participant": p, "generation": g}``, ISSUE 15)
+  — the named participant's heartbeats stop counting at a plan-aware
+  :class:`~deeplearning4j_tpu.distributed.coordinator.
+  SocketCoordinatorServer` from generation g on, so every waiter in
+  that barrier round deterministically receives the structured
+  dead-peer error instead of N independent timeouts.
 
 Serving fault kinds (ISSUE 7 — the model server's degradation paths):
 
@@ -144,7 +151,8 @@ class FaultPlan:
                  slow_seconds: float = 0.1,
                  serve_fail_at: Iterable[int] = (),
                  serve_device_loss_at_batch: Optional[int] = None,
-                 nan_layer_params_at: Optional[dict] = None):
+                 nan_layer_params_at: Optional[dict] = None,
+                 coord_peer_death: Optional[dict] = None):
         self.seed = seed
         self.nan_grads_at = _as_step_set(nan_grads_at)
         self.data_error_at = _as_step_set(data_error_at)
@@ -167,6 +175,14 @@ class FaultPlan:
         #: whatever the loss scalar looks like K layers later.
         self.nan_layer_params_at = {int(k): v for k, v in
                                     (nan_layer_params_at or {}).items()}
+        #: {"participant": name, "generation": g} — coordinator-peer-death
+        #: fault kind (ISSUE 15 tier 3): from barrier generation ``g`` on,
+        #: the named participant's heartbeats stop counting at a
+        #: plan-aware :class:`~deeplearning4j_tpu.distributed.coordinator.
+        #: SocketCoordinatorServer`, so the dead-peer detector fires
+        #: deterministically for every waiter in that round.
+        self.coord_peer_death = dict(coord_peer_death) \
+            if coord_peer_death else None
         # consumed-state: each fault fires once
         self._nan_pending = set(self.nan_grads_at)
         self._data_pending = set(self.data_error_at)
@@ -360,6 +376,19 @@ class FaultPlan:
         if step is not None and step < self.device_loss_at_step:
             return set()
         return set(self.lose_devices)
+
+    # --------------------------------------------------- coordination seams
+    def coord_peer_dead(self, participant: str,
+                        generation: int) -> bool:
+        """Coordinator-peer-death fault kind: True when the planned
+        participant should read as dead (heartbeats ignored) at barrier
+        generation ``generation``. Persistent from the planned
+        generation on — a dead peer stays dead, like a lost chip."""
+        plan = self.coord_peer_death
+        if not plan:
+            return False
+        return (str(participant) == str(plan.get("participant"))
+                and int(generation) >= int(plan.get("generation", 0)))
 
     # -------------------------------------------------------- serving seams
     def serving_forward(self, batch_index: int, device_ids) -> None:

@@ -412,6 +412,7 @@ class ComputationGraph:
         self._fwd_cache = None
         self._augment = None    # DeviceAugmentation (see setDeviceAugmentation)
         self._precision = None  # PrecisionPolicy (see setPrecisionPolicy)
+        self._sharding_plan = None  # ShardedTrainingPlan (see setShardingPlan)
         self._scale_state = None  # dynamic loss scale [scale, good_steps]
         self._initialized = False
         # NHWC compute layout + fused epilogues (ISSUE 14) — opt-in,
@@ -696,6 +697,11 @@ class ComputationGraph:
             return self._make_dynamic_train_step(steps=steps,
                                                  with_lmasks=with_lmasks)
         loss_scale = pol.loss_scale if pol is not None else None
+        # GSPMD output sharding constraints — see
+        # MultiLayerNetwork._make_train_step
+        plan = self._sharding_plan
+        psh, osh = (None, None) if plan is None \
+            else plan.step_constraints(self)
 
         def step(params, states, opt_state, t, ins, labels, lmasks):
             # per-step RNG from the donated device counter (see
@@ -723,6 +729,8 @@ class ComputationGraph:
                 grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
             new_params, new_opt = _process_and_apply_grads(
                 base, updater, params, grads, opt_state, t.astype(jnp.float32))
+            new_params = _stepping.constrain_tree(new_params, psh)
+            new_opt = _stepping.constrain_tree(new_opt, osh)
             return new_params, new_states, new_opt, t + 1, loss
         # donate params/states/opt_state/t: the step consumes and replaces
         # them, halving peak HBM for the update and letting dependent
@@ -747,6 +755,9 @@ class ComputationGraph:
         seed = base.seed
         augment = self._augment
         pol = self._precision
+        plan = self._sharding_plan
+        psh, osh = (None, None) if plan is None \
+            else plan.step_constraints(self)
 
         def step(params, states, opt_state, t, scale_state, ins, labels,
                  lmasks):
@@ -773,6 +784,8 @@ class ComputationGraph:
             new_params = _select_update(ok, new_params, params)
             new_opt = _select_update(ok, new_opt, opt_state)
             new_states = _select_update(ok, new_states, states)
+            new_params = _stepping.constrain_tree(new_params, psh)
+            new_opt = _stepping.constrain_tree(new_opt, osh)
             return (new_params, new_states, new_opt, t + 1,
                     _dynamic_scale_next(pol, scale_state, ok), loss)
         if steps > 1:
@@ -805,11 +818,13 @@ class ComputationGraph:
         fp = getattr(self, "_conf_fingerprint", None)
         if fp is None:
             fp = self._conf_fingerprint = _cc.model_fingerprint(self)
+        plan = self._sharding_plan
         return (fp,
                 pol.signature() if pol is not None else None,
                 aug.signature() if aug is not None else None,
                 steps, self._compute_layout,
-                self._fuse_epilogues)
+                self._fuse_epilogues,
+                plan.signature() if plan is not None else None)
 
     def _dynamic_scaling(self) -> bool:
         pol = self._precision
@@ -819,8 +834,11 @@ class ComputationGraph:
         """Device-resident ``[scale, good_steps]`` dynamic loss-scale
         carry — see MultiLayerNetwork._ensure_scale_state."""
         if self._scale_state is None:
-            self._scale_state = jnp.asarray(
+            s = jnp.asarray(
                 [float(self._precision.loss_scale_init), 0.0], jnp.float32)
+            if self._sharding_plan is not None:  # see _ensure_clock
+                s = jax.device_put(s, self._sharding_plan.mesh.replicated())
+            self._scale_state = s
         return self._scale_state
 
     def current_loss_scale(self):
@@ -843,9 +861,12 @@ class ComputationGraph:
     def _ensure_clock(self):
         """Device-resident iteration counter (int32 scalar), donated and
         incremented inside the compiled step — see
-        MultiLayerNetwork._ensure_clock."""
+        MultiLayerNetwork._ensure_clock (incl. the GSPMD-plan commit)."""
         if self._t_dev is None:
-            self._t_dev = jnp.asarray(self._iteration, jnp.int32)
+            t = jnp.asarray(self._iteration, jnp.int32)
+            if self._sharding_plan is not None:
+                t = jax.device_put(t, self._sharding_plan.mesh.replicated())
+            self._t_dev = t
         return self._t_dev
 
     def setComputeLayout(self, fmt: str) -> "ComputationGraph":
@@ -938,6 +959,26 @@ class ComputationGraph:
             self._megastep_cache.clear()
         return self
 
+    def setShardingPlan(self, plan) -> "ComputationGraph":
+        """Attach (or detach with ``None``) a
+        :class:`~deeplearning4j_tpu.distributed.gspmd.
+        ShardedTrainingPlan` — semantics identical to
+        ``MultiLayerNetwork.setShardingPlan`` (NamedSharding placement
+        on params/updater state, plan-derived batch staging, output
+        sharding constraints inside the ONE compiled step; a changed
+        plan signature busts the step caches, an equal one keeps
+        them)."""
+        cur = self._sharding_plan
+        same = (plan.signature() if plan is not None else None) == \
+            (cur.signature() if cur is not None else None)
+        self._sharding_plan = plan
+        if not same:
+            self._train_step_cache.clear()
+            self._megastep_cache.clear()
+            self._fwd_cache = None
+            self._t_dev = None  # the device clock moves to the plan's mesh
+        return self
+
     def setPrecisionPolicy(self, policy) -> "ComputationGraph":
         """Attach (or detach with ``None``) a
         :class:`~deeplearning4j_tpu.nn.precision.PrecisionPolicy` (or a
@@ -1022,9 +1063,12 @@ class ComputationGraph:
                 with _prof.trace_span("train:epoch", epoch=self._epoch):
                     # data-wait vs compute split (see MultiLayerNetwork.fit)
                     if steps_per_dispatch > 1:
-                        _stepping.fit_epoch_multistep(self, epoch_stream(),
-                                                      steps_per_dispatch,
-                                                      prefetch)
+                        # plan-derived prefetcher placement (see
+                        # MultiLayerNetwork.fit)
+                        _stepping.fit_epoch_multistep(
+                            self, epoch_stream(), steps_per_dispatch,
+                            prefetch,
+                            placement=_stepping.batch_placement(self))
                     else:
                         for ds in _prof.iter_with_data_wait(epoch_stream()):
                             self._fit_one(ds)
@@ -1037,16 +1081,19 @@ class ComputationGraph:
         return self
 
     def _fit_one(self, ds):
+        if self._sharding_plan is not None:
+            self._sharding_plan.ensure_placed(self)  # GSPMD placement guard
+        stage = lambda a: _stepping.stage_batch(self, a)
         if isinstance(ds, MultiDataSet):
-            ins = {name: jnp.asarray(a)
+            ins = {name: stage(a)
                    for name, a in zip(self.conf.graph_inputs, ds.features)}
-            labels = [jnp.asarray(a) for a in ds.labels]
-            lmasks = [jnp.asarray(m) for m in ds.labels_masks] \
+            labels = [stage(a) for a in ds.labels]
+            lmasks = [stage(m) for m in ds.labels_masks] \
                 if ds.labels_masks else None
         else:
-            ins = {self.conf.graph_inputs[0]: jnp.asarray(ds.features)}
-            labels = [jnp.asarray(ds.labels)]
-            lmasks = [jnp.asarray(ds.labels_mask)] if ds.labels_mask is not None else None
+            ins = {self.conf.graph_inputs[0]: stage(ds.features)}
+            labels = [stage(ds.labels)]
+            lmasks = [stage(ds.labels_mask)] if ds.labels_mask is not None else None
         # recompile-churn seam (see MultiLayerNetwork._fit_one)
         _churn.get_churn_detector().record(
             "ComputationGraph.fit",
@@ -1112,17 +1159,20 @@ class ComputationGraph:
         if not self._initialized:
             self.init()
         self._ensure_opt_state()
+        if self._sharding_plan is not None:
+            self._sharding_plan.ensure_placed(self)  # see _fit_one
         k = mb.steps
+        stage = lambda a: _stepping.stage_batch(self, a, mega=True)
         if mb.multi:
-            ins = {name: jnp.asarray(a)
+            ins = {name: stage(a)
                    for name, a in zip(self.conf.graph_inputs, mb.features)}
-            labels = [jnp.asarray(a) for a in mb.labels]
-            lmasks = [jnp.asarray(m) for m in mb.labels_mask] \
+            labels = [stage(a) for a in mb.labels]
+            lmasks = [stage(m) for m in mb.labels_mask] \
                 if mb.labels_mask else None
         else:
-            ins = {self.conf.graph_inputs[0]: jnp.asarray(mb.features)}
-            labels = [jnp.asarray(mb.labels)]
-            lmasks = [jnp.asarray(mb.labels_mask)] \
+            ins = {self.conf.graph_inputs[0]: stage(mb.features)}
+            labels = [stage(mb.labels)]
+            lmasks = [stage(mb.labels_mask)] \
                 if mb.labels_mask is not None else None
         _churn.get_churn_detector().record(
             "ComputationGraph.megastep",
@@ -1194,9 +1244,16 @@ class ComputationGraph:
         return ev
 
     def params(self) -> jnp.ndarray:
+        # host-side gather before concat for heterogeneously-sharded
+        # GSPMD leaves — see MultiLayerNetwork.params() (device-side
+        # concatenate over mixed shardings silently misassembles on
+        # this jax version); uniform shardings keep the device path
         leaves = jax.tree_util.tree_leaves(self._params)
         if not leaves:
             return jnp.zeros((0,))
+        if len({getattr(p, "sharding", None) for p in leaves}) > 1:
+            host = jax.device_get(leaves)
+            return jnp.asarray(np.concatenate([np.ravel(p) for p in host]))
         return jnp.concatenate([jnp.ravel(p) for p in leaves])
 
     def numParams(self) -> int:

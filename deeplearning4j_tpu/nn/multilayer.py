@@ -211,6 +211,7 @@ class MultiLayerNetwork:
         self._fwd_cache = None
         self._augment = None    # DeviceAugmentation (see setDeviceAugmentation)
         self._precision = None  # PrecisionPolicy (see setPrecisionPolicy)
+        self._sharding_plan = None  # ShardedTrainingPlan (see setShardingPlan)
         self._scale_state = None  # dynamic loss scale [scale, good_steps]
         self._score = float("nan")
         self._initialized = False
@@ -474,6 +475,13 @@ class MultiLayerNetwork:
                                                  with_fmask=with_fmask,
                                                  with_lmask=with_lmask)
         loss_scale = pol.loss_scale if pol is not None else None
+        # GSPMD plan (distributed.gspmd): output sharding constraints so
+        # model-sharded params / ZeRO-sharded updater state STAY sharded
+        # across steps — (None, None) for pure replication, where the
+        # compiled program is byte-identical to the wrapper path
+        plan = self._sharding_plan
+        psh, osh = (None, None) if plan is None \
+            else plan.step_constraints(self)
 
         def step(params, states, opt_state, t, x, y, fmask, lmask):
             # per-step RNG derived ON DEVICE from the (donated) iteration
@@ -508,6 +516,8 @@ class MultiLayerNetwork:
                               for i in range(len(params))]
                 new_opt = [opt_state[i] if i in frozen else new_opt[i]
                            for i in range(len(opt_state))]
+            new_params = _stepping.constrain_tree(new_params, psh)
+            new_opt = _stepping.constrain_tree(new_opt, osh)
             return new_params, new_states, new_opt, t + 1, loss
         # donate params/states/opt_state/t: consumed and replaced each step;
         # donation also lets dependent dispatches pipeline instead of
@@ -550,6 +560,9 @@ class MultiLayerNetwork:
         seed = base.seed
         augment = self._augment
         pol = self._precision
+        plan = self._sharding_plan
+        psh, osh = (None, None) if plan is None \
+            else plan.step_constraints(self)
 
         def step(params, states, opt_state, t, scale_state, x, y, fmask,
                  lmask):
@@ -581,6 +594,8 @@ class MultiLayerNetwork:
                               for i in range(len(params))]
                 new_opt = [opt_state[i] if i in frozen else new_opt[i]
                            for i in range(len(opt_state))]
+            new_params = _stepping.constrain_tree(new_params, psh)
+            new_opt = _stepping.constrain_tree(new_opt, osh)
             return (new_params, new_states, new_opt, t + 1,
                     _dynamic_scale_next(pol, scale_state, ok), loss)
         if steps > 1:
@@ -601,12 +616,14 @@ class MultiLayerNetwork:
         fp = getattr(self, "_conf_fingerprint", None)
         if fp is None:
             fp = self._conf_fingerprint = _cc.model_fingerprint(self)
+        plan = self._sharding_plan
         return (fp,
                 pol.signature() if pol is not None else None,
                 aug.signature() if aug is not None else None,
                 tuple(sorted(getattr(self, "_frozen_layers", None) or ())),
                 steps, self._compute_layout,
-                self._fuse_epilogues)
+                self._fuse_epilogues,
+                plan.signature() if plan is not None else None)
 
     def _dynamic_scaling(self) -> bool:
         pol = self._precision
@@ -617,8 +634,11 @@ class MultiLayerNetwork:
         scaling (donated/replaced by the compiled step, persisted by
         resilience checkpoints)."""
         if self._scale_state is None:
-            self._scale_state = jnp.asarray(
+            s = jnp.asarray(
                 [float(self._precision.loss_scale_init), 0.0], jnp.float32)
+            if self._sharding_plan is not None:  # see _ensure_clock
+                s = jax.device_put(s, self._sharding_plan.mesh.replicated())
+            self._scale_state = s
         return self._scale_state
 
     def current_loss_scale(self):
@@ -642,9 +662,16 @@ class MultiLayerNetwork:
         """Device-resident iteration counter (int32 scalar). The compiled
         step donates it and returns t+1, so steady-state training uploads
         NOTHING per step — uploading a fresh host scalar each iteration
-        serializes the dispatch pipeline on high-latency device links."""
+        serializes the dispatch pipeline on high-latency device links.
+        Under a GSPMD plan the fresh clock commits replicated onto the
+        plan's mesh so the FIRST dispatch already carries the
+        steady-state signature (one compile, not compile-then-retrace
+        when the returned clock comes back committed)."""
         if self._t_dev is None:
-            self._t_dev = jnp.asarray(self._iteration, jnp.int32)
+            t = jnp.asarray(self._iteration, jnp.int32)
+            if self._sharding_plan is not None:
+                t = jax.device_put(t, self._sharding_plan.mesh.replicated())
+            self._t_dev = t
         return self._t_dev
 
     def setComputeLayout(self, fmt: str) -> "MultiLayerNetwork":
@@ -712,6 +739,29 @@ class MultiLayerNetwork:
         if not same:
             self._train_step_cache.clear()
             self._megastep_cache.clear()
+        return self
+
+    def setShardingPlan(self, plan) -> "MultiLayerNetwork":
+        """Attach (or detach with ``None``) a
+        :class:`~deeplearning4j_tpu.distributed.gspmd.
+        ShardedTrainingPlan`: params/updater state are placed per the
+        plan's NamedShardings (``plan.apply``/``ensure_placed``),
+        batches stage per its batch PartitionSpec, and the compiled
+        step pins sharded outputs with ``with_sharding_constraint`` —
+        ONE ``jax.jit`` program covering data/model/seq axes. A plan
+        with a different :meth:`~deeplearning4j_tpu.distributed.gspmd.
+        ShardedTrainingPlan.signature` invalidates the compiled step
+        caches (one recompile); re-attaching an equal plan keeps them —
+        steady state stays at zero recompiles."""
+        cur = self._sharding_plan
+        same = (plan.signature() if plan is not None else None) == \
+            (cur.signature() if cur is not None else None)
+        self._sharding_plan = plan
+        if not same:
+            self._train_step_cache.clear()
+            self._megastep_cache.clear()
+            self._fwd_cache = None
+            self._t_dev = None  # the device clock moves to the plan's mesh
         return self
 
     def setPrecisionPolicy(self, policy) -> "MultiLayerNetwork":
@@ -856,9 +906,12 @@ class MultiLayerNetwork:
                             else:        # non-sequence batch: nothing to
                                 self._fit_one(ds)     # segment (W002 case)
                     elif steps_per_dispatch > 1:
-                        _stepping.fit_epoch_multistep(self, epoch_stream(),
-                                                      steps_per_dispatch,
-                                                      prefetch)
+                        # GSPMD plan attached: the DevicePrefetcher stages
+                        # megabatches per the plan's batch PartitionSpec
+                        _stepping.fit_epoch_multistep(
+                            self, epoch_stream(), steps_per_dispatch,
+                            prefetch,
+                            placement=_stepping.batch_placement(self))
                     else:
                         for ds in _prof.iter_with_data_wait(epoch_stream()):
                             self._fit_one(ds)
@@ -874,10 +927,14 @@ class MultiLayerNetwork:
         if not self._initialized:
             self.init()
         self._ensure_opt_state()
-        x = jnp.asarray(ds.features)
-        y = jnp.asarray(ds.labels)
-        fmask = jnp.asarray(ds.features_mask) if ds.features_mask is not None else None
-        lmask = jnp.asarray(ds.labels_mask) if ds.labels_mask is not None else None
+        if self._sharding_plan is not None:
+            # GSPMD path: re-place params/updater state when they are not
+            # on the plan's mesh (fresh init or a resilience restore)
+            self._sharding_plan.ensure_placed(self)
+        x = _stepping.stage_batch(self, ds.features)
+        y = _stepping.stage_batch(self, ds.labels)
+        fmask = _stepping.stage_batch(self, ds.features_mask)
+        lmask = _stepping.stage_batch(self, ds.labels_mask)
         # recompile-churn seam: every distinct (shape, dtype) signature
         # here is one XLA compile of the train step
         _churn.get_churn_detector().record(
@@ -957,11 +1014,13 @@ class MultiLayerNetwork:
         if not self._initialized:
             self.init()
         self._ensure_opt_state()
+        if self._sharding_plan is not None:
+            self._sharding_plan.ensure_placed(self)  # see _fit_one
         k = mb.steps
-        x = jnp.asarray(mb.features)
-        y = jnp.asarray(mb.labels)
-        fmask = jnp.asarray(mb.features_mask) if mb.features_mask is not None else None
-        lmask = jnp.asarray(mb.labels_mask) if mb.labels_mask is not None else None
+        x = _stepping.stage_batch(self, mb.features, mega=True)
+        y = _stepping.stage_batch(self, mb.labels, mega=True)
+        fmask = _stepping.stage_batch(self, mb.features_mask, mega=True)
+        lmask = _stepping.stage_batch(self, mb.labels_mask, mega=True)
         _churn.get_churn_detector().record(
             "MultiLayerNetwork.megastep",
             _churn.array_fingerprint(x, y, fmask, lmask), owner=self)
@@ -1041,10 +1100,18 @@ class MultiLayerNetwork:
     # ------------------------------------------------------------ param views
     def params(self) -> jnp.ndarray:
         """The reference's single flat contiguous param vector
-        (ref: MultiLayerNetwork.params())."""
+        (ref: MultiLayerNetwork.params()). Heterogeneously-sharded
+        leaves (a GSPMD plan) are gathered to host BEFORE
+        concatenation: a device-side ``jnp.concatenate`` over
+        differently-sharded arrays silently misassembles the result on
+        this jax version (values, not just layout). Uniformly-sharded
+        leaves keep the device-side fast path."""
         leaves = jax.tree_util.tree_leaves(self._params)
         if not leaves:
             return jnp.zeros((0,))
+        if len({getattr(p, "sharding", None) for p in leaves}) > 1:
+            host = jax.device_get(leaves)
+            return jnp.asarray(np.concatenate([np.ravel(p) for p in host]))
         return jnp.concatenate([jnp.ravel(p) for p in leaves])
 
     def setParams(self, flat):

@@ -28,6 +28,53 @@ SHARD_BYTES = _prof.get_registry().counter(
     labelnames=("site",))
 
 
+def _zero_weight_mask(labels, b: int, pad: int, existing=None):
+    """A labels mask whose ``pad`` tail rows weigh zero — shape per the
+    output layer's loss contract: per-example [b] for ff labels,
+    per-timestep [b, T] for time-series labels [N, C, T]."""
+    lmask = existing
+    if lmask is None:
+        if labels is not None and labels.ndim == 3:
+            lmask = np.ones((b, labels.shape[2]), np.float32)
+        else:
+            lmask = np.ones((b,), np.float32)
+    return np.concatenate([lmask, np.zeros((pad,) + lmask.shape[1:],
+                                           lmask.dtype)])
+
+
+def pad_to_data_axis(ds, n: int):
+    """Pad a batch up to a multiple of the data-shard count ``n`` with
+    ZERO-WEIGHT examples (labels mask 0), so the padded batch's
+    gradients exactly match the unpadded one — shared by
+    ``ParallelWrapper`` and the GSPMD trainer's padding iterator.
+    Accepts a DataSet or a MultiDataSet (multi-input/-output graphs:
+    every features/labels array pads, every output gets a zero-weight
+    tail mask)."""
+    from deeplearning4j_tpu.data.dataset import MultiDataSet
+    multi = isinstance(ds, MultiDataSet)
+    b = int((ds.features[0] if multi else ds.features).shape[0])
+    if n <= 1 or b % n == 0:
+        return ds
+    pad = n - b % n
+    rep = lambda a: np.concatenate([a, np.repeat(a[-1:], pad, 0)]) \
+        if a is not None else None
+    if multi:
+        lmasks = list(ds.labels_masks) if ds.labels_masks \
+            else [None] * len(ds.labels)
+        lmasks = [_zero_weight_mask(lab, b, pad, existing=m)
+                  for lab, m in zip(ds.labels, lmasks)]
+        return MultiDataSet(
+            [rep(a) for a in ds.features],
+            [rep(a) for a in ds.labels],
+            [rep(a) for a in ds.features_masks]
+            if ds.features_masks else None,
+            lmasks)
+    return DataSet(rep(ds.features), rep(ds.labels),
+                   rep(ds.features_mask),
+                   _zero_weight_mask(ds.labels, b, pad,
+                                     existing=ds.labels_mask))
+
+
 class ShardedDataSetIterator(DataSetIterator):
     """Wrap any DataSetIterator: each process keeps its contiguous
     per-process slice of every global batch (ref: Spark repartition +

@@ -344,9 +344,14 @@ class CoordinationService:
     ``resume_barrier(participant, step)`` blocks until every participant
     has reported its last locally completed step and returns the agreed
     step — the MINIMUM across participants, i.e. the last GLOBALLY
-    completed step every survivor can restore. In-process now
-    (:class:`InProcessCoordinator`); a file- or socket-based
-    implementation slots in for real multi-host jobs.
+    completed step every survivor can restore.
+    :class:`InProcessCoordinator` serves single-process jobs; REAL
+    multi-host jobs pass ``ElasticConfig(coordinator=
+    distributed.coordinator.SocketCoordinator(...))`` (TCP rendezvous
+    with heartbeats + dead-peer detection) or ``FileCoordinator``
+    (shared-filesystem rendezvous) — both implement this same
+    two-method contract across OS processes (ISSUE 15 tier 3,
+    ``pytest -m multihost``).
     """
 
     def resume_barrier(self, participant: str, step: int,

@@ -261,28 +261,11 @@ class ParallelWrapper:
         return out
 
     def _pad(self, ds: DataSet) -> DataSet:
-        n = self.mesh.size("data")
-        b = ds.features.shape[0]
-        if b % n != 0:
-            # pad the tail batch up to the DP width with ZERO-WEIGHT examples
-            # (labels mask 0) so gradients exactly match the unpadded batch
-            pad = n - b % n
-            rep = lambda a: np.concatenate([a, np.repeat(a[-1:], pad, 0)]) \
-                if a is not None else None
-            lmask = ds.labels_mask
-            if lmask is None:
-                # shape must match what the output layer's loss expects:
-                # per-example [b] for ff labels, per-timestep [b, T] for
-                # time-series labels [N, C, T]
-                if ds.labels is not None and ds.labels.ndim == 3:
-                    lmask = np.ones((b, ds.labels.shape[2]), np.float32)
-                else:
-                    lmask = np.ones((b,), np.float32)
-            lmask = np.concatenate([lmask, np.zeros((pad,) + lmask.shape[1:],
-                                                    lmask.dtype)])
-            ds = DataSet(rep(ds.features), rep(ds.labels),
-                         rep(ds.features_mask), lmask)
-        return ds
+        # zero-weight tail padding shared with the GSPMD trainer
+        # (parallel.data.pad_to_data_axis): gradients exactly match the
+        # unpadded batch
+        from deeplearning4j_tpu.parallel.data import pad_to_data_axis
+        return pad_to_data_axis(ds, self.mesh.size("data"))
 
     def averagingFrequency(self, n):
         # API-parity shim: sync SPMD allreduces inside ONE XLA program every

@@ -132,7 +132,7 @@ class ModelRegistry:
     # ------------------------------------------------------------- loading
     def load(self, name: str, model, version: Optional[int] = None,
              shapes=None, decode=None, warm: bool = True,
-             roll: Optional[bool] = None, **server_kw) -> int:
+             roll: Optional[bool] = None, plan=None, **server_kw) -> int:
         """Load ``model`` as a new version of ``name`` and AOT-warm its
         bucket ladder while any active version keeps taking traffic.
 
@@ -142,8 +142,13 @@ class ModelRegistry:
         the route's raw-image decode preset (ingress); ``warm=False``
         skips warmup (``roll`` will then lint DL4J-W111). ``roll``
         defaults to "only when this is the first version" — an upgrade
-        stays staged until an explicit :meth:`roll`. Returns the
-        version number."""
+        stays staged until an explicit :meth:`roll`. ``plan`` (a
+        :class:`~deeplearning4j_tpu.distributed.gspmd.
+        ShardedTrainingPlan`, ISSUE 15) stages the version on a SHARDED
+        mesh: params place per the plan's NamedShardings (tensor-
+        parallel serving of a model too big to replicate) before the
+        server builds, and the plan's mesh overrides the registry's.
+        Returns the version number."""
         with self._lock:
             if self._closed:
                 raise RuntimeError("registry is closed")
@@ -169,6 +174,15 @@ class ModelRegistry:
         try:
             kw = dict(self._defaults)
             kw.update(server_kw)
+            if plan is not None:
+                # sharded-mesh staging: place params (NOT updater state
+                # — an inference-only load must not allocate optimizer
+                # moments) per the plan's NamedShardings; the forward
+                # compiles with those committed shardings (GSPMD
+                # inserts the collectives)
+                model.setShardingPlan(plan)
+                plan.place_params(model)
+                kw.setdefault("mesh", plan.mesh)
             kw.setdefault("mesh", self.mesh)
             server = ModelServer(model, name=f"{name}:v{version}", **kw)
             if warm and shapes:

@@ -58,6 +58,44 @@ TRAIN_ITERATIONS = _prof.get_registry().counter(
     "megastep advances this by K)")
 
 
+def stage_batch(model, a, mega: bool = False):
+    """Batch staging for the fit functions: plain ``jnp.asarray`` — or,
+    when a :class:`~deeplearning4j_tpu.distributed.gspmd.
+    ShardedTrainingPlan` is attached, ``device_put`` per the plan's
+    batch PartitionSpec (dim 0 — dim 1 under a ``[K, B, ...]``
+    megabatch — sharded over the plan's batch axes, replicated over
+    model/seq axes). A no-op copy-wise for arrays a DevicePrefetcher
+    already placed with the same sharding."""
+    if a is None:
+        return None
+    plan = getattr(model, "_sharding_plan", None)
+    if plan is None:
+        return jnp.asarray(a)
+    return plan.place(a, mega)
+
+
+def batch_placement(model):
+    """The DevicePrefetcher ``placement(array, mega)`` hook derived from
+    the attached sharding plan's batch PartitionSpec — ``None`` (default
+    device staging) when no plan is attached."""
+    plan = getattr(model, "_sharding_plan", None)
+    return None if plan is None else plan.place
+
+
+def constrain_tree(tree, shardings):
+    """``with_sharding_constraint`` over a whole pytree — how the GSPMD
+    step pins its outputs (params, ZeRO-sharded updater state) to the
+    plan's shardings INSIDE the one compiled program, so XLA cannot
+    silently all-gather the sharded state at the step boundary.
+    ``shardings=None`` is the identity (pure-replication plans compile
+    byte-identical programs to the wrapper path)."""
+    if shardings is None:
+        return tree
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.lax.with_sharding_constraint(x, s),
+        tree, shardings)
+
+
 def fence_generation(model):
     """Entry half of the elastic dispatch-commit fence: the generation
     observed before dispatching (None when no fence is attached —

@@ -40,6 +40,15 @@ def pytest_configure(config):
         "runs every race schedule")
     config.addinivalue_line(
         "markers",
+        "multihost: real multi-OS-process coordination tests "
+        "(`pytest -m multihost`) — 2 worker processes rendezvous over "
+        "the socket/file CoordinationService (ISSUE 15 tier 3: barrier "
+        "agreement, dead-peer detection) or a gloo-backed global mesh. "
+        "DELIBERATELY fast (<30 s for the socket tests) and NOT marked "
+        "slow, so tier-1's `-m 'not slow'` gate runs the real-process "
+        "coordination paths on every run")
+    config.addinivalue_line(
+        "markers",
         "chaos: seeded fault-injection sweeps through the resilience and "
         "elastic layers (`pytest -m chaos`). DELIBERATELY a fast marker, "
         "not a slow one: tier-1 runs `-m 'not slow'`, so every chaos "

@@ -745,11 +745,22 @@ class TestDistributionDiagnostics:
         assert "DL4J-E101" not in pw.validate(batch_size=16).codes()
 
     def test_zoo_clean_under_data8_mesh(self):
+        # zero=True: a data-parallel training plan that shards the
+        # updater state is the recommended shipping config (ISSUE 15) —
+        # without it the big Adam-state models legitimately earn W109,
+        # which TestDistributionAnalysis pins separately
         from deeplearning4j_tpu.models.zoo import all_zoo_models
         for name, net in all_zoo_models():
-            report = analyze(net, mesh="data=8")
+            report = analyze(net, mesh="data=8", zero=True)
             assert report.ok(warnings_as_errors=True), \
                 f"{name} not clean under data=8:\n{report.format()}"
+
+    def test_zoo_w109_without_zero_declaration(self):
+        # the inverse pin: at least the heavyweight zoo configs DO warn
+        # when a data=8 mesh trains with replicated optimizer state
+        from deeplearning4j_tpu.models.zoo import VGG16
+        report = analyze(VGG16().conf_builder(), mesh="data=8")
+        assert "DL4J-W109" in report.codes()
 
 
 class TestSuppressionConfig:
@@ -807,7 +818,8 @@ def _W101_FIXTURE():
 class TestCliMesh:
     def test_zoo_clean_under_mesh_flag(self, capsys):
         from deeplearning4j_tpu.analysis.__main__ import main
-        assert main(["--zoo", "--mesh", "data=8"]) == 0
+        # --zero: see test_zoo_clean_under_data8_mesh (W109 otherwise)
+        assert main(["--zoo", "--mesh", "data=8", "--zero"]) == 0
         assert "16 model(s) linted: 16 clean" in capsys.readouterr().out
 
     def test_mesh_flag_fails_bad_batch(self, capsys):
