@@ -44,7 +44,12 @@ zero-disk-miss warm pin asserted — into ``detail.cold_start``;
 ``--device-timing`` folds ``benchmarks/probe_device_timing.py`` — the
 ISSUE-14 bridge checks: non-empty per-layer device-time MFU attribution
 matching the analyzer FLOP model, fused-epilogue bit-closeness (fp32)
-and loss parity (bf16) — into ``detail.device_timing``).
+and loss parity (bf16) — into ``detail.device_timing``;
+``--obs`` folds ``benchmarks/probe_obs_overhead.py`` — the ISSUE-16
+observability-plane cost gate: tracecontext / flightrec / SLO-engine
+fit columns plus the serve-path always-on column, each asserted <5%
+over the all-off baseline (tracing-ON serve ratio report-only) — into
+``detail.obs_overhead``).
 
 BENCH_r06 (ISSUE 14): the CNN rows measure the OPTIMIZED conv path —
 ``precision: "bf16"`` (explicit PrecisionPolicy), NHWC compute layout,
@@ -643,6 +648,18 @@ def bench_cold_start(quick: bool = False):
                       ["--quick"] if quick else [], timeout=1800)
 
 
+def bench_obs(quick: bool = False):
+    """Observability-plane cost probe (benchmarks/probe_obs_overhead.py):
+    tracecontext / flightrec / SLO-engine fit columns and the serve-path
+    always-on column, each asserted <5% over the all-off baseline by the
+    probe itself (a breach surfaces here as an ``error`` entry)."""
+    return _run_probe(
+        "probe_obs_overhead.py",
+        ["--iters", "100", "--reqs", "300", "--blocks", "5"] if quick
+        else [],
+        timeout=900)
+
+
 def bench_dp_scaling_virtual():
     """GSPMD dp_scaling on the 8-virtual-device CPU mesh (ISSUE 15
     satellite — the row is no longer an empty dict). 1->2->4->8 data
@@ -869,6 +886,8 @@ def main(argv):
         detail["cold_start"] = bench_cold_start(quick)
     if "--device-timing" in argv:
         detail["device_timing"] = bench_device_timing(quick)
+    if "--obs" in argv:
+        detail["obs_overhead"] = bench_obs(quick)
 
     print(json.dumps({
         "metric": "bert_base_seq128_train_samples_per_sec_per_chip",

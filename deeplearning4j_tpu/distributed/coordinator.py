@@ -59,6 +59,8 @@ from typing import Dict, Tuple
 
 from deeplearning4j_tpu import profiler as _prof
 from deeplearning4j_tpu.parallel.elastic import CoordinationService
+from deeplearning4j_tpu.profiler import flightrec as _flightrec
+from deeplearning4j_tpu.profiler import tracecontext as _tracectx
 
 BARRIER_SECONDS = _prof.get_registry().histogram(
     "dl4j_coord_barrier_seconds",
@@ -103,12 +105,13 @@ class SocketCoordinatorServer:
         self.participants = int(participants)
         self.heartbeat_timeout = float(heartbeat_timeout)
         self.plan = plan
-        self._cond = threading.Condition()
+        self._cond = _prof.InstrumentedCondition("coord:server")
         self._generation = 0
         self._round: Dict[str, int] = {}
         self._results: Dict[int, int] = {}
         self._failures: Dict[int, Dict] = {}
         self._last_seen: Dict[str, float] = {}
+        self._meta: Dict[str, Dict] = {}    # hello-advertised, per peer
         self._closed = False
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -156,8 +159,14 @@ class SocketCoordinatorServer:
                 participant = str(msg.get("participant", ""))
                 if op == "hello":
                     self._touch(participant)
+                    meta = msg.get("meta")
                     with self._cond:
                         gen = self._generation
+                        if isinstance(meta, dict):
+                            # hello-advertised metadata (e.g. a
+                            # metrics_url) — what FleetScraper reads
+                            # off members() to build scrape targets
+                            self._meta[participant] = dict(meta)
                     self._reply(f, {"ok": True, "generation": gen})
                 elif op == "heartbeat":
                     self._touch(participant)
@@ -165,7 +174,8 @@ class SocketCoordinatorServer:
                 elif op == "barrier":
                     self._reply(f, self._barrier(
                         participant, int(msg.get("step", 0)),
-                        float(msg.get("timeout", 60.0))))
+                        float(msg.get("timeout", 60.0)),
+                        trace=msg.get("trace")))
                 else:
                     self._reply(f, {"ok": False, "error": "bad_op",
                                     "op": op})
@@ -209,8 +219,40 @@ class SocketCoordinatorServer:
         dead = getattr(plan, "coord_peer_dead", None)
         return bool(dead and dead(participant, self._generation))
 
+    def members(self, fresh_within: float = None) -> Dict[str, Dict]:
+        """Membership snapshot: participant -> {"age": seconds since
+        last contact, "meta": hello-advertised dict}. ``fresh_within``
+        filters to peers heard from that recently (default: the
+        heartbeat timeout) — dead hosts fall out of the view, and so
+        out of any scrape-target list built from it."""
+        bound = (self.heartbeat_timeout if fresh_within is None
+                 else float(fresh_within))
+        now = time.monotonic()
+        with self._cond:
+            return {p: {"age": now - seen,
+                        "meta": dict(self._meta.get(p, {}))}
+                    for p, seen in self._last_seen.items()
+                    if now - seen <= bound}
+
     # ---------------------------------------------------------- barrier
-    def _barrier(self, participant: str, step: int, timeout: float) -> Dict:
+    def _barrier(self, participant: str, step: int, timeout: float,
+                 trace=None) -> Dict:
+        """One participant's barrier arrival. ``trace`` is the client's
+        traceparent riding the wire: the server-side round span becomes
+        its child, so a multi-process barrier stitches into one trace."""
+        ctx = _tracectx.TraceContext.from_traceparent(trace)
+        t0_us = _prof.now_us()
+        reply = self._barrier_inner(participant, step, timeout)
+        _tracectx.record_span(
+            "coord:round", ctx.child() if ctx is not None else None,
+            t0_us, _prof.now_us() - t0_us,
+            args={"participant": participant, "step": int(step),
+                  "ok": bool(reply.get("ok")),
+                  "generation": reply.get("generation")})
+        return reply
+
+    def _barrier_inner(self, participant: str, step: int,
+                       timeout: float) -> Dict:
         t0 = time.perf_counter()
         with self._cond:
             if not self._peer_planned_dead(participant):
@@ -249,6 +291,7 @@ class SocketCoordinatorServer:
         round for all waiters."""
         while not self._is_closed():
             time.sleep(min(self.heartbeat_timeout / 4.0, 0.25))
+            died = None
             with self._cond:
                 if not self._round:
                     continue
@@ -267,7 +310,15 @@ class SocketCoordinatorServer:
                         self._prune(gen)
                         DEAD_PEERS.inc()
                         self._cond.notify_all()
+                        died = (peer, gen, now - seen)
                         break
+            if died is not None:
+                # outside the lock: the dump walks the metrics registry
+                # and writes files — never under the barrier condvar
+                rec = _flightrec.get_flight_recorder()
+                rec.record("coord:dead_peer", peer=died[0],
+                           generation=died[1], stale_seconds=died[2])
+                rec.dump("dead_peer")
 
     def close(self):
         with self._cond:
@@ -358,41 +409,74 @@ class SocketCoordinator(CoordinationService):
             except BarrierProtocolError:
                 continue
 
-    def hello(self, timeout: float = 5.0) -> int:
+    def hello(self, timeout: float = 5.0, meta: Dict = None) -> int:
         """Register with the server (so dead-peer detection covers this
         participant even before its first barrier); returns the
-        server's current barrier generation."""
-        reply = self._request({"op": "hello",
-                               "participant": self.participant}, timeout)
+        server's current barrier generation. ``meta`` advertises
+        participant metadata — e.g. ``{"metrics_url": "http://..."}``
+        — that the server exposes through ``members()`` (what
+        ``FleetScraper`` builds scrape targets from)."""
+        payload = {"op": "hello", "participant": self.participant}
+        if meta:
+            payload["meta"] = dict(meta)
+        reply = self._request(payload, timeout)
         return int(reply.get("generation", 0))
 
     # ---------------------------------------------------------- contract
     def resume_barrier(self, participant: str, step: int,
                        timeout: float = 60.0) -> int:
         t0 = time.perf_counter()
+        t0_us = _prof.now_us()
         name = str(participant or self.participant)
+        # the barrier rides the ambient trace when one is in scope
+        # (e.g. a fit_elastic run span) so the server's coord:round span
+        # stitches into the same flow; otherwise mint only if tracing —
+        # an untraced barrier should not grow the wire payload
+        ambient = _tracectx.current()
+        wire_ctx = (ambient.child() if ambient is not None
+                    else (_tracectx.TraceContext.new()
+                          if _prof.tracing_enabled() else None))
+        payload = {"op": "barrier", "participant": name,
+                   "step": int(step), "timeout": float(timeout)}
+        if wire_ctx is not None:
+            payload["trace"] = wire_ctx.to_traceparent()
+
+        def _span(**args):
+            _tracectx.record_span(
+                "coord:barrier", wire_ctx, t0_us,
+                _prof.now_us() - t0_us,
+                args=dict(args, participant=name, step=int(step)))
+
         try:
-            reply = self._request(
-                {"op": "barrier", "participant": name, "step": int(step),
-                 "timeout": float(timeout)},
-                timeout=timeout + self.connect_timeout)
+            reply = self._request(payload,
+                                  timeout=timeout + self.connect_timeout)
         except socket.timeout as e:
+            _span(error="TimeoutError")
             raise TimeoutError(
                 f"resume barrier: no reply from coordinator "
                 f"{self.host}:{self.port} within {timeout}s") from e
         if reply.get("ok"):
             BARRIER_SECONDS.labels(impl="socket").observe(
                 time.perf_counter() - t0)
+            _span(ok=True, generation=reply.get("generation"))
             return int(reply["step"])
         err = reply.get("error")
         if err == "dead_peer":
+            _span(error="DeadPeerError", peer=reply.get("peer"))
+            rec = _flightrec.get_flight_recorder()
+            rec.record("coord:dead_peer", peer=reply.get("peer", "?"),
+                       generation=reply.get("generation", -1),
+                       participant=name)
+            rec.dump("dead_peer")
             raise DeadPeerError(reply.get("peer", "?"),
                                 reply.get("generation", -1))
         if err == "timeout":
+            _span(error="TimeoutError", arrived=reply.get("arrived"))
             raise TimeoutError(
                 f"resume barrier: only {reply.get('arrived')}/"
                 f"{reply.get('expected')} participants arrived within "
                 f"{timeout}s")
+        _span(error="BarrierProtocolError")
         raise BarrierProtocolError(f"coordinator error: {reply}")
 
     def close(self):
@@ -559,6 +643,10 @@ class FileCoordinator(CoordinationService):
                 if mtime >= self._t0:
                     self._generation += 1
                     DEAD_PEERS.inc()
+                    rec = _flightrec.get_flight_recorder()
+                    rec.record("coord:dead_peer", peer=peer,
+                               generation=gen, impl="file")
+                    rec.dump("dead_peer")
                     raise DeadPeerError(peer, gen)
             if time.monotonic() > deadline:
                 raise TimeoutError(

@@ -13,7 +13,6 @@ libnd4j, per SURVEY.md).
 
 from __future__ import annotations
 
-import threading
 from typing import Optional
 
 import jax
@@ -22,6 +21,7 @@ import numpy as np
 
 from deeplearning4j_tpu.linalg.dtypes import DataType
 from deeplearning4j_tpu.linalg.ndarray import NDArray, _unwrap
+from deeplearning4j_tpu.profiler.locks import InstrumentedLock
 
 
 class Random:
@@ -30,7 +30,7 @@ class Random:
     def __init__(self, seed: int = 0):
         self._seed = int(seed)
         self._counter = 0
-        self._lock = threading.Lock()
+        self._lock = InstrumentedLock("linalg:random")
 
     def setSeed(self, seed: int) -> None:
         with self._lock:

@@ -217,7 +217,7 @@ class DispatchFence:
     checkpointing a stale step)."""
 
     def __init__(self):
-        self.lock = threading.Lock()
+        self.lock = _prof.InstrumentedLock("elastic:fence")
         self.generation = 0
 
 
@@ -365,7 +365,7 @@ class InProcessCoordinator(CoordinationService):
 
     def __init__(self, participants: int = 1):
         self.participants = int(participants)
-        self._cond = threading.Condition()
+        self._cond = _prof.InstrumentedCondition("elastic:coordinator")
         self._round: Dict[str, int] = {}
         self._results: Dict[int, int] = {}
         self._generation = 0

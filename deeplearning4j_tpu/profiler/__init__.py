@@ -32,17 +32,34 @@ pipeline (``dl4j_{async_iterator,prefetch}_queue_depth``,
 h2d_bytes_total}``), and the listener bus (``MetricsListener``,
 ``PerformanceListener``).
 
+The fleet observability plane (ISSUE 16) adds four more modules:
+:mod:`tracecontext` (W3C-traceparent distributed tracing — request
+flows stitch across ingress, coalesced dispatch, and the coordination
+wire), :mod:`aggregate` (cross-host metric federation behind
+``GET /v1/fleet/metrics``), :mod:`slo` (declarative SLOs with
+multi-window burn-rate gates, ``dl4j_slo_burn_rate``), and
+:mod:`flightrec` (always-on crash flight recorder dumping debug
+bundles on NonfiniteAttributionError / dispatch timeout / dead peer).
+
 Everything is near-zero-cost when disabled: one module-level flag / enum
 read before any span or sample is allocated.
 """
 
 import time as _time
 
+from deeplearning4j_tpu.profiler.aggregate import (FleetScraper,
+                                                   HistogramSnapshot,
+                                                   MetricsAggregator,
+                                                   members_from_coordinator,
+                                                   parse_exposition)
+from deeplearning4j_tpu.profiler.flightrec import (FlightRecorder,
+                                                   get_flight_recorder)
 from deeplearning4j_tpu.profiler.locks import (InstrumentedCondition,
                                                InstrumentedLock,
                                                InstrumentedQueue,
                                                InstrumentedRLock,
                                                LockOrderInversionError,
+                                               WitnessedLock,
                                                disable_lock_order_witness,
                                                enable_lock_order_witness,
                                                lock_order_edges)
@@ -52,6 +69,15 @@ from deeplearning4j_tpu.profiler.metrics import (Counter, Gauge, Histogram,
 from deeplearning4j_tpu.profiler.modes import (ProfilingMode,
                                                get_profiling_mode,
                                                set_profiling_mode)
+from deeplearning4j_tpu.profiler.slo import (SLOEngine, SLOGate, SLOSpec,
+                                             SLOVerdict)
+from deeplearning4j_tpu.profiler.tracecontext import (TraceContext,
+                                                      current as
+                                                      current_trace,
+                                                      merge_chrome_traces,
+                                                      record_span, run_span,
+                                                      span,
+                                                      spans_for_trace)
 from deeplearning4j_tpu.profiler.tracer import (SpanTracer, disable_tracing,
                                                 enable_tracing, get_tracer,
                                                 now_us, trace_span,
@@ -64,8 +90,14 @@ __all__ = [
     "disable_tracing", "tracing_enabled", "instrumentation_active",
     "now_us", "observe_region", "timed_region", "iter_with_data_wait",
     "data_overlap_ratio",
+    "TraceContext", "current_trace", "record_span", "span", "run_span",
+    "merge_chrome_traces", "spans_for_trace",
+    "MetricsAggregator", "HistogramSnapshot", "FleetScraper",
+    "parse_exposition", "members_from_coordinator",
+    "SLOSpec", "SLOEngine", "SLOGate", "SLOVerdict",
+    "FlightRecorder", "get_flight_recorder",
     "InstrumentedLock", "InstrumentedRLock", "InstrumentedCondition",
-    "InstrumentedQueue", "LockOrderInversionError",
+    "InstrumentedQueue", "WitnessedLock", "LockOrderInversionError",
     "enable_lock_order_witness", "disable_lock_order_witness",
     "lock_order_edges",
 ]

@@ -309,6 +309,54 @@ class InstrumentedCondition(threading.Condition):
                          else InstrumentedRLock(name))
 
 
+class WitnessedLock:
+    """Witness-only ``threading.Lock`` shim for hot or short-lived
+    locks (e.g. one per :class:`~deeplearning4j_tpu.serving.server.
+    ServingRequest`): participates in the lock-order witness under its
+    role name but records NO wait/hold metrics and allocates no
+    per-instance thread-local — construction is a raw Lock plus two
+    slots, and the disabled-witness fast path is one flag read. Use
+    :class:`InstrumentedLock` wherever the wait/hold series matter;
+    use this where only deadlock ordering does."""
+
+    __slots__ = ("name", "_raw")
+
+    def __init__(self, name: str):
+        self.name = str(name)
+        self._raw = threading.Lock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._raw.acquire(blocking, timeout)
+        if got and _WITNESS.enabled:
+            try:
+                _WITNESS.on_acquired(self.name)
+            except BaseException:
+                # witness raised (inversion): the lock IS held — release
+                # so the failure does not strand waiters
+                self._raw.release()
+                raise
+        return got
+
+    def release(self) -> None:
+        # unconditional pop (cheap no-op when nothing was pushed): see
+        # InstrumentedLock.release for why
+        _WITNESS.on_released(self.name)
+        self._raw.release()
+
+    def locked(self) -> bool:
+        return self._raw.locked()
+
+    def __enter__(self) -> "WitnessedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self):
+        return f"WitnessedLock({self.name!r})"
+
+
 class InstrumentedQueue(_queue.Queue):
     """``queue.Queue`` whose internal mutex (and the three conditions
     built on it) is an :class:`InstrumentedRLock` — every put/get

@@ -121,10 +121,12 @@ class _Metric:
     def _samples(self) -> List[Tuple[str, Dict[str, str], float]]:
         """[(suffix, extra_labels, value)] for one (child) metric — a
         consistent snapshot taken under the metric's own lock (a scrape
-        racing observe() must never emit non-monotone histogram buckets)."""
+        racing observe() must never emit non-monotone histogram buckets).
+        Histograms may append a 4th element: an ``(exemplar_id, value)``
+        pair rendered as an OpenMetrics exemplar when negotiated."""
         raise NotImplementedError
 
-    def expose(self) -> str:
+    def expose(self, openmetrics: bool = False) -> str:
         lines = [f"# HELP {self.name} {self.help}",
                  f"# TYPE {self.name} {self.typ}"]
         with self._lock:
@@ -133,12 +135,20 @@ class _Metric:
         # child _samples() acquire their own lock — called OUTSIDE the
         # family lock above (for an unlabelled family, child IS self)
         for lvals, child in items:
-            for suffix, extra, value in child._samples():
+            for sample in child._samples():
+                suffix, extra, value = sample[0], sample[1], sample[2]
                 names = list(self.labelnames) + list(extra)
                 vals = list(lvals) + [extra[k] for k in extra]
-                lines.append(f"{self.name}{suffix}"
-                             f"{_labels_str(names, vals)} "
-                             f"{_format_value(value)}")
+                line = (f"{self.name}{suffix}"
+                        f"{_labels_str(names, vals)} "
+                        f"{_format_value(value)}")
+                if openmetrics and len(sample) > 3 and sample[3] is not None:
+                    # OpenMetrics exemplar: ties this bucket back to one
+                    # concrete trace (tier-1 <-> tier-2 correlation)
+                    ex_id, ex_val = sample[3]
+                    line += (f' # {{trace_id="{_escape_label(ex_id)}"}} '
+                             f"{_format_value(ex_val)}")
+                lines.append(line)
         return "\n".join(lines)
 
 
@@ -217,11 +227,21 @@ class Histogram(_Metric):
         self._counts = [0] * (len(self.buckets) + 1)   # last = +Inf
         self._sum = 0.0
         self._count = 0
+        # one exemplar per bucket (the latest observation that carried
+        # one) — bounded by construction: len(buckets)+1 slots, ever
+        self._exemplars: List[Optional[Tuple[str, float]]] = \
+            [None] * (len(self.buckets) + 1)
 
     def _child(self):
         return Histogram(self.name, self.help, (), self.buckets)
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float,
+                exemplar: Optional[str] = None) -> None:
+        """Record one observation. ``exemplar`` (typically a trace_id)
+        is retained per owning bucket — latest wins, so retention is
+        bounded at one exemplar per bucket — and rendered as an
+        OpenMetrics ``# {trace_id="..."}`` annotation when the scrape
+        negotiates the OpenMetrics exposition."""
         self._check_unlabelled()
         value = float(value)
         idx = bisect_left(self.buckets, value)
@@ -229,6 +249,8 @@ class Histogram(_Metric):
             self._counts[idx] += 1
             self._sum += value
             self._count += 1
+            if exemplar is not None:
+                self._exemplars[idx] = (str(exemplar)[:128], value)
 
     @property
     def count(self) -> int:
@@ -272,12 +294,14 @@ class Histogram(_Metric):
         with self._lock:
             counts = list(self._counts)
             total, s = self._count, self._sum
+            exemplars = list(self._exemplars)
         out = []
         cum = 0
-        for bound, c in zip(self.buckets, counts):
+        for i, (bound, c) in enumerate(zip(self.buckets, counts)):
             cum += c
-            out.append(("_bucket", {"le": _format_value(bound)}, cum))
-        out.append(("_bucket", {"le": "+Inf"}, total))
+            out.append(("_bucket", {"le": _format_value(bound)}, cum,
+                        exemplars[i]))
+        out.append(("_bucket", {"le": "+Inf"}, total, exemplars[-1]))
         out.append(("_sum", {}, s))
         out.append(("_count", {}, total))
         return out
@@ -334,11 +358,19 @@ class MetricsRegistry:
         with self._lock:
             self._metrics.pop(name, None)
 
-    def exposition(self) -> str:
-        """Prometheus text exposition format 0.0.4 (what /metrics serves)."""
+    def exposition(self, openmetrics: bool = False) -> str:
+        """Prometheus text exposition format 0.0.4 (what /metrics
+        serves). ``openmetrics=True`` renders the OpenMetrics dialect
+        instead — histogram bucket lines carry their retained
+        ``# {trace_id="..."}`` exemplars and the body ends with
+        ``# EOF`` — for scrapers that negotiate it via ``Accept:
+        application/openmetrics-text``."""
         with self._lock:
             metrics = [self._metrics[n] for n in sorted(self._metrics)]
-        return "\n".join(m.expose() for m in metrics) + "\n" if metrics else ""
+        if not metrics:
+            return "# EOF\n" if openmetrics else ""
+        body = "\n".join(m.expose(openmetrics=openmetrics) for m in metrics)
+        return body + ("\n# EOF\n" if openmetrics else "\n")
 
 
 _REGISTRY = MetricsRegistry()

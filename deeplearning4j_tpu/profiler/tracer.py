@@ -53,6 +53,20 @@ _EPOCH_NS = time.perf_counter_ns()
 # cleanly by stop_stream; a killed process loses at most one buffer)
 _STREAM_FLUSH_EVERY = 256
 
+# ambient trace-context stamp (set by profiler.tracecontext on import):
+# returns a small dict of args (e.g. {"trace_id": ...}) merged into every
+# recorded span that does not already carry them — how ordinary op/fit
+# spans correlate with the distributed request/run trace they ran under
+_CTX_ARGS_FN = None
+
+
+def set_context_args_fn(fn) -> None:
+    """Install the ambient-context stamper (``None`` uninstalls). The
+    callable must be cheap (one contextvar read) and return a dict of
+    span args or None."""
+    global _CTX_ARGS_FN
+    _CTX_ARGS_FN = fn
+
 
 def enable_tracing() -> None:
     """Turn span recording on (module-level flag)."""
@@ -89,6 +103,7 @@ class SpanTracer:
         self._stream = None             # open file: see stream_to()
         self._stream_path: Optional[str] = None
         self._stream_count = 0
+        self._stream_flush_every = _STREAM_FLUSH_EVERY
         self._stream_tids: set = set()  # every (pid, tid) EVER streamed —
         # the ring may have evicted a thread's spans by stop_stream time,
         # but its thread_name metadata must still land in the file
@@ -123,6 +138,12 @@ class SpanTracer:
             ev["args"] = {k: _jsonable(v) for k, v in args.items()}
         if depth:
             ev.setdefault("args", {})["depth"] = depth
+        if _CTX_ARGS_FN is not None:
+            extra = _CTX_ARGS_FN()
+            if extra:
+                a = ev.setdefault("args", {})
+                for k, v in extra.items():
+                    a.setdefault(k, v)
         with self._lock:
             self._events.append(ev)
             if self._stream is not None:
@@ -133,7 +154,7 @@ class SpanTracer:
                     self._stream.write(prefix + json.dumps(ev))
                     self._stream_count += 1
                     self._stream_tids.add((ev["pid"], ev["tid"]))
-                    if self._stream_count % _STREAM_FLUSH_EVERY == 0:
+                    if self._stream_count % self._stream_flush_every == 0:
                         self._stream.flush()
                 except OSError as e:
                     stream, self._stream = self._stream, None
@@ -187,7 +208,8 @@ class SpanTracer:
         return doc
 
     # ------------------------------------------------------------- streaming
-    def stream_to(self, path: str) -> "SpanTracer":
+    def stream_to(self, path: str,
+                  flush_every: int = _STREAM_FLUSH_EVERY) -> "SpanTracer":
         """Append every completed span to ``path`` as it is recorded —
         the disk-resident escape hatch from the ring buffer's horizon: a
         long fit's early spans survive on disk after the ring evicted
@@ -195,7 +217,9 @@ class SpanTracer:
         (Perfetto loads a truncated array from a killed process too);
         :meth:`stop_stream` terminates it properly with the thread-name
         metadata. Idempotent per path; a second call with a different
-        path closes the first stream."""
+        path closes the first stream. ``flush_every`` tunes the flush
+        cadence — a crash-forensics stream (the flight recorder's) sets
+        1 so a killed process loses nothing buffered."""
         with self._lock:
             if self._stream is not None:
                 if self._stream_path == path:
@@ -206,6 +230,7 @@ class SpanTracer:
             self._stream = f
             self._stream_path = path
             self._stream_count = 0
+            self._stream_flush_every = max(int(flush_every), 1)
             self._stream_tids = set()
         return self
 

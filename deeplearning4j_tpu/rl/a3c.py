@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu.profiler.locks import InstrumentedLock
 from deeplearning4j_tpu.rl.dqn import _mlp_init
 from deeplearning4j_tpu.rl.mdp import MDP
 
@@ -158,7 +159,7 @@ class A3CDiscreteDense:
         self._step_fn = self._make_step()
         self._pi_fn = jax.jit(self._logits)
         self.episode_rewards: List[float] = []
-        self._lock = threading.Lock()
+        self._lock = InstrumentedLock("rl:a3c")
 
     # ---------------------------------------------------------- networks
     def _trunk(self, params, x):

@@ -18,6 +18,17 @@ Endpoints::
     GET  /v1/load                       autoscaling / LB hints
     GET  /healthz                       process + breaker liveness
     GET  /readyz                        warmed & admitting (LB rotation)
+    GET  /metrics                       this process's registry (OpenMetrics
+                                        with exemplars when negotiated)
+    GET  /v1/fleet/metrics              merged cross-host exposition
+    GET  /v1/fleet/load                 merged autoscaling hints
+    GET  /v1/slo                        SLO burn-rate verdict
+
+Tracing: predict requests honor an incoming W3C ``traceparent`` header
+(else mint a fresh trace); responses — success and error alike — carry
+``trace_id`` in the JSON and a ``traceparent`` response header, and the
+flow records ``ingress:request`` / ``serve:*`` / ``ingress:respond``
+spans when tracing is enabled (see ``profiler.tracecontext``).
 
 Predict bodies (Content-Type):
 
@@ -70,6 +81,7 @@ from urllib.parse import parse_qs, urlparse
 import numpy as np
 
 from deeplearning4j_tpu import profiler as _prof
+from deeplearning4j_tpu.profiler import tracecontext as _tracectx
 from deeplearning4j_tpu.serving.errors import ServingError
 
 logger = logging.getLogger("deeplearning4j_tpu")
@@ -178,9 +190,9 @@ class _SingleModelRouter:
         self._server = server
         self._decode = decode
 
-    def submit(self, name, x, deadline=None, version=None):
+    def submit(self, name, x, deadline=None, version=None, trace=None):
         self._resolve(name, version)
-        return self._server.submit(x, deadline=deadline)
+        return self._server.submit(x, deadline=deadline, trace=trace)
 
     def _resolve(self, name, version):
         from deeplearning4j_tpu.serving.registry import ModelNotFoundError
@@ -257,13 +269,26 @@ class _IngressHandler(BaseHTTPRequestHandler):
         pass
 
     # --------------------------------------------------------- plumbing
+    # per-request trace context, stamped by _predict; None for the GET
+    # surface (reset per request: a keep-alive connection reuses the
+    # handler instance and must not leak one request's trace to the next)
+    _trace: Optional[_tracectx.TraceContext] = None
+
     def _respond(self, code: int, payload: dict,
                  retry_after: Optional[float] = None):
+        trace = self._trace
+        if trace is not None and isinstance(payload, dict):
+            # every response in a traced flow — success OR error —
+            # reports its trace_id, so clients/logs can correlate
+            payload.setdefault("trace_id", trace.trace_id)
         body = json.dumps(payload).encode()
+        t0_us = _prof.now_us()
         try:
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            if trace is not None:
+                self.send_header("traceparent", trace.to_traceparent())
             if retry_after is not None:
                 self.send_header("Retry-After", f"{max(retry_after, 0.0):g}")
             if self.close_connection:
@@ -276,6 +301,25 @@ class _IngressHandler(BaseHTTPRequestHandler):
         except (BrokenPipeError, ConnectionResetError, OSError):
             # the client hung up mid-response: nothing to answer, but
             # the server must not care (wire-chaos pin)
+            INGRESS_DISCONNECTS.inc()
+            self.close_connection = True
+        _tracectx.record_span(
+            "ingress:respond",
+            trace.child() if trace is not None else None,
+            t0_us, _prof.now_us() - t0_us,
+            args={"code": code, "bytes": len(body)})
+        INGRESS_REQUESTS.labels(code=str(code)).inc()
+
+    def _respond_text(self, code: int, text: str, content_type: str):
+        """Non-JSON response (the metrics expositions)."""
+        body = text.encode("utf-8")
+        try:
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError, OSError):
             INGRESS_DISCONNECTS.inc()
             self.close_connection = True
         INGRESS_REQUESTS.labels(code=str(code)).inc()
@@ -392,6 +436,7 @@ class _IngressHandler(BaseHTTPRequestHandler):
 
     # ------------------------------------------------------------ routes
     def do_POST(self):
+        self._trace = None
         url = urlparse(self.path)
         path = url.path
         if path.startswith("/v1/models/") and path.endswith(":predict"):
@@ -408,6 +453,32 @@ class _IngressHandler(BaseHTTPRequestHandler):
         self._error(404, f"no such endpoint: POST {path}")
 
     def _predict(self, name: str, version: Optional[int]):
+        # trace context for the whole request: honor an incoming W3C
+        # traceparent header (this hop becomes its child), else mint a
+        # fresh root — IDs are always minted so even untraced runs
+        # return a trace_id; recording stays gated on tracing_enabled
+        incoming = _tracectx.TraceContext.from_traceparent(
+            self.headers.get("traceparent"))
+        ctx = (incoming.child() if incoming is not None
+               else _tracectx.TraceContext.new())
+        self._trace = ctx
+        t0_us = _prof.now_us()
+        err = None
+        try:
+            with _tracectx.use(ctx):
+                self._predict_inner(name, version, ctx)
+        except BaseException as e:
+            err = type(e).__name__
+            raise
+        finally:
+            args = {"model": name, "path": self.path}
+            if err is not None:
+                args["error"] = err
+            _tracectx.record_span("ingress:request", ctx, t0_us,
+                                  _prof.now_us() - t0_us, args=args)
+
+    def _predict_inner(self, name: str, version: Optional[int],
+                       ctx: _tracectx.TraceContext):
         import time as _time
         from deeplearning4j_tpu.serving.registry import ModelNotFoundError
         data = self._read_body()
@@ -426,7 +497,7 @@ class _IngressHandler(BaseHTTPRequestHandler):
         try:
             req = self.ingress.router.submit(name, feats,
                                              deadline=deadline_s,
-                                             version=version)
+                                             version=version, trace=ctx)
         except ModelNotFoundError as e:
             return self._error(404, str(e.args[0]) if e.args else str(e))
         except ServingError as e:
@@ -471,15 +542,56 @@ class _IngressHandler(BaseHTTPRequestHandler):
             "predictions": _jsonable(result),
             "latency_ms": round(stamped, 3),
         })
-        INGRESS_LATENCY.observe(_time.perf_counter() - t0)
+        INGRESS_LATENCY.observe(_time.perf_counter() - t0,
+                                exemplar=ctx.trace_id)
 
     def do_GET(self):
         from deeplearning4j_tpu.serving.registry import ModelNotFoundError
+        self._trace = None
         url = urlparse(self.path)
         path = url.path
         router = self.ingress.router
         if path == "/v1/load":
             return self._respond(200, router.load_hints())
+        if path == "/metrics":
+            # this process's registry on the serving port (the UIServer
+            # may not be running next to an ingress) — the scrape
+            # surface FleetScraper pulls. OpenMetrics (with histogram
+            # exemplars) when the client negotiates it.
+            om = ("application/openmetrics-text"
+                  in (self.headers.get("Accept") or ""))
+            return self._respond_text(
+                200, _prof.get_registry().exposition(openmetrics=om),
+                ("application/openmetrics-text; version=1.0.0; "
+                 "charset=utf-8") if om
+                else "text/plain; version=0.0.4; charset=utf-8")
+        if path == "/v1/fleet/metrics":
+            agg = self.ingress.fleet
+            if agg is None:
+                return self._error(
+                    404, "no fleet aggregator attached — "
+                         "HttpIngress(..., fleet=MetricsAggregator()) "
+                         "or ingress.attach_fleet(agg)")
+            return self._respond_text(
+                200, agg.exposition(),
+                "text/plain; version=0.0.4; charset=utf-8")
+        if path == "/v1/fleet/load":
+            agg = self.ingress.fleet
+            if agg is None:
+                return self._error(404, "no fleet aggregator attached")
+            return self._respond(200, agg.fleet_load())
+        if path == "/v1/slo":
+            gate = self.ingress.slo
+            if gate is None:
+                return self._error(
+                    404, "no SLO gate attached — HttpIngress(..., "
+                         "slo=SLOGate(engine)) or ingress.attach_slo()")
+            verdict = gate()
+            # failing SLOs answer 200, not 5xx: the endpoint reports
+            # budget state; /healthz and /readyz own liveness semantics
+            return self._respond(200, {"passing": verdict.passing,
+                                       "failing": verdict.failures,
+                                       **verdict.detail})
         if path == "/v1/models":
             return self._respond(200, {"models": router.models()})
         if path.startswith("/v1/models/"):
@@ -514,16 +626,41 @@ class HttpIngress:
     def __init__(self, target, port: int = 8500, host: str = "127.0.0.1",
                  default_timeout: float = 30.0, deadline_grace: float = 5.0,
                  max_body_mb: float = 64.0,
-                 decode: Optional[DecodePreset] = None):
+                 decode: Optional[DecodePreset] = None,
+                 fleet=None, slo=None):
         self.router = _as_router(target, decode=decode)
         self.host = host
         self.port = int(port)
         self.default_timeout = float(default_timeout)
         self.deadline_grace = float(deadline_grace)
         self.max_body = int(max_body_mb * 1024 * 1024)
+        self.fleet = None
+        self.slo = None
+        if fleet is not None:
+            self.attach_fleet(fleet)
+        if slo is not None:
+            self.attach_slo(slo)
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
-        self._lifecycle = threading.Lock()
+        self._lifecycle = _prof.InstrumentedLock("ingress:lifecycle")
+
+    def attach_fleet(self, aggregator) -> "HttpIngress":
+        """Serve ``aggregator``'s merged fleet view at
+        ``GET /v1/fleet/metrics`` and ``GET /v1/fleet/load`` (a
+        :class:`~deeplearning4j_tpu.profiler.aggregate.
+        MetricsAggregator`, typically fed by a ``FleetScraper``)."""
+        self.fleet = aggregator
+        return self
+
+    def attach_slo(self, gate) -> "HttpIngress":
+        """Serve ``gate``'s verdict at ``GET /v1/slo``. Accepts an
+        :class:`~deeplearning4j_tpu.profiler.slo.SLOGate` or a bare
+        ``SLOEngine`` (wrapped)."""
+        if not callable(gate):          # an engine: wrap it in a gate
+            from deeplearning4j_tpu.profiler.slo import SLOGate
+            gate = SLOGate(gate)
+        self.slo = gate
+        return self
 
     def start(self) -> "HttpIngress":
         with self._lifecycle:

@@ -51,6 +51,8 @@ from typing import Dict, Optional
 
 from deeplearning4j_tpu import profiler as _prof
 from deeplearning4j_tpu.parallel.mesh import DeviceMesh
+from deeplearning4j_tpu.profiler import flightrec as _flightrec
+from deeplearning4j_tpu.profiler import tracecontext as _tracectx
 from deeplearning4j_tpu.serving.server import ModelServer
 
 logger = logging.getLogger("deeplearning4j_tpu")
@@ -241,14 +243,24 @@ class ModelRegistry:
             return self._route(name).decode
 
     def submit(self, name: str, x, deadline: Optional[float] = None,
-               version: Optional[int] = None):
+               version: Optional[int] = None, trace=None):
         """Route one request: a locked pointer read picks the server,
         the admission itself runs outside the registry lock. The
         returned :class:`ServingRequest` is owned by exactly that
         server (``req.server`` says which ``name:vN``), so a roll
-        racing this submit can never double-resolve or drop it."""
+        racing this submit can never double-resolve or drop it.
+        ``trace`` propagates the caller's trace context; the route
+        decision records a ``serve:route`` span whose ``server`` arg
+        makes a hot-swap re-route visible as a version change."""
+        t0_us = _prof.now_us()
+        ctx = (trace if trace is not None
+               else _tracectx.TraceContext.new())
         server = self._version(name, version).server
-        return server.submit(x, deadline=deadline)
+        _tracectx.record_span(
+            "serve:route", ctx.child(), t0_us, _prof.now_us() - t0_us,
+            args={"model": name, "server": server.name,
+                  "pinned_version": version})
+        return server.submit(x, deadline=deadline, trace=ctx)
 
     def output(self, name: str, x, timeout: float = 30.0,
                deadline: Optional[float] = None,
@@ -318,6 +330,8 @@ class ModelRegistry:
             route.active = version
             self._gauges(route)
         ROLLS.labels(model=name).inc()
+        _flightrec.get_flight_recorder().record(
+            "registry:roll", model=name, previous=prev, active=version)
         logger.info("registry: rolled %s v%s -> v%d", name, prev, version)
         return prev
 
@@ -338,6 +352,8 @@ class ModelRegistry:
             route.active = prev
             self._gauges(route)
         ROLLS.labels(model=name).inc()
+        _flightrec.get_flight_recorder().record(
+            "registry:rollback", model=name, active=prev)
         logger.info("registry: rolled back %s -> v%d", name, prev)
         return prev
 

@@ -89,11 +89,14 @@ from deeplearning4j_tpu.parallel.elastic import (DispatchTimeoutError,
                                                  DispatchWatchdog,
                                                  shrink_mesh_on_dead)
 from deeplearning4j_tpu.parallel.mesh import DeviceMesh
+from deeplearning4j_tpu.profiler import flightrec as _flightrec
+from deeplearning4j_tpu.profiler import tracecontext as _tracectx
 from deeplearning4j_tpu.serving.errors import (DeadlineExceededError,
                                                ServerClosedError,
                                                ServerDrainingError,
                                                ServerOverloadedError,
-                                               ServerUnhealthyError)
+                                               ServerUnhealthyError,
+                                               ServingError)
 
 logger = logging.getLogger("deeplearning4j_tpu")
 
@@ -282,11 +285,12 @@ class ServingRequest:
     """
 
     __slots__ = ("features", "n", "deadline", "enqueued_at", "resolved_at",
-                 "resolutions", "server", "_event", "_lock", "_resolved",
-                 "_result", "_error")
+                 "resolutions", "server", "trace", "_t0_us", "_event",
+                 "_lock", "_resolved", "_result", "_error")
 
     def __init__(self, features: np.ndarray, deadline: Optional[float],
-                 enqueued_at: float):
+                 enqueued_at: float,
+                 trace: Optional[_tracectx.TraceContext] = None):
         self.features = features
         self.n = int(features.shape[0])
         self.server: Optional[str] = None  # stamped at admission: which
@@ -295,8 +299,17 @@ class ServingRequest:
         self.enqueued_at = enqueued_at
         self.resolved_at: Optional[float] = None   # monotonic, set once
         self.resolutions = 0
+        # every request carries a trace context even with tracing off
+        # (IDs are cheap; span RECORDING stays gated) so responses can
+        # always report their trace_id
+        self.trace = (trace if trace is not None
+                      else _tracectx.TraceContext.new())
+        self._t0_us = _prof.now_us()
         self._event = threading.Event()
-        self._lock = threading.Lock()
+        # WitnessedLock, not InstrumentedLock: the exactly-once gate is
+        # per-request hot path — witness coverage without the per-lock
+        # metrics/TLS overhead
+        self._lock = _prof.WitnessedLock("serving:request")
         self._resolved = False
         self._result = None
         self._error: Optional[BaseException] = None
@@ -315,6 +328,15 @@ class ServingRequest:
             self._result = result
             self._error = error
         self._event.set()
+        # the request's terminal span: exactly one per request (this
+        # call won), spanning admission -> resolution, outcome carried
+        # as an arg — what the chaos sweep asserts every request has
+        _tracectx.record_span(
+            "serve:terminal", self.trace, self._t0_us,
+            _prof.now_us() - self._t0_us,
+            args={"outcome": ("completed" if error is None
+                              else type(error).__name__),
+                  "server": self.server})
         return True
 
     def done(self) -> bool:
@@ -557,11 +579,17 @@ class ModelServer:
         return self.buckets()[-1]
 
     # ----------------------------------------------------------- admission
-    def submit(self, x, deadline: Optional[float] = None) -> ServingRequest:
+    def submit(self, x, deadline: Optional[float] = None,
+               trace: Optional[_tracectx.TraceContext] = None
+               ) -> ServingRequest:
         """Queue one request. ``x``: [n, ...features] with n <=
         ``batch_limit``; ``deadline``: seconds from now (overrides
-        ``default_deadline``). Raises the structured admission errors
-        instead of ever blocking the caller."""
+        ``default_deadline``); ``trace``: the caller's
+        :class:`~deeplearning4j_tpu.profiler.tracecontext.TraceContext`
+        (the ingress passes the request's — minted fresh when absent).
+        Raises the structured admission errors instead of ever blocking
+        the caller; rejections carry a ``trace_id`` attribute and a
+        terminal span."""
         x = np.asarray(x, dtype=self.input_dtype)
         if x.ndim < 1:
             raise ValueError("request features need a leading batch dim")
@@ -583,26 +611,46 @@ class ModelServer:
                     "warmup([shape]) before serving it")
         now = time.monotonic()
         dl = self.default_deadline if deadline is None else deadline
-        req = ServingRequest(x, now + dl if dl is not None else None, now)
+        req = ServingRequest(x, now + dl if dl is not None else None, now,
+                             trace=trace)
         req.server = self.name
-        with self._cond:
-            if self._closed:
-                self._count("rejected_closed")
-                raise ServerClosedError()
-            if self._draining or self._drain_requested.is_set():
-                self._count("shed_draining")
-                raise ServerDrainingError()
-            if not self.breaker.admit():
-                self._count("rejected_unhealthy")
-                raise ServerUnhealthyError(
-                    self.breaker.consecutive_failures,
-                    retry_after=self.breaker.retry_after())
-            if len(self._dq) >= self.max_queue:
-                self._count("shed_overload")
-                raise ServerOverloadedError(len(self._dq), self.max_queue)
-            self._dq.append(req)
-            self._queue_gauge.set(len(self._dq))
-            self._cond.notify()
+        try:
+            with self._cond:
+                if self._closed:
+                    self._count("rejected_closed")
+                    raise ServerClosedError()
+                if self._draining or self._drain_requested.is_set():
+                    self._count("shed_draining")
+                    raise ServerDrainingError()
+                if not self.breaker.admit():
+                    self._count("rejected_unhealthy")
+                    raise ServerUnhealthyError(
+                        self.breaker.consecutive_failures,
+                        retry_after=self.breaker.retry_after())
+                if len(self._dq) >= self.max_queue:
+                    self._count("shed_overload")
+                    raise ServerOverloadedError(len(self._dq),
+                                                self.max_queue)
+                self._dq.append(req)
+                self._queue_gauge.set(len(self._dq))
+                self._cond.notify()
+        except ServingError as e:
+            # an admission rejection IS the request's terminal outcome:
+            # resolve it (emits the serve:terminal span with the error
+            # type) and stamp the trace id on the error so the caller
+            # can correlate logs/exemplars without the request object
+            e.trace_id = req.trace.trace_id
+            req._resolve(error=e)
+            _tracectx.record_span(
+                "serve:admission", req.trace.child(), req._t0_us,
+                _prof.now_us() - req._t0_us,
+                args={"outcome": type(e).__name__, "server": self.name})
+            raise
+        _tracectx.record_span(
+            "serve:admission", req.trace.child(), req._t0_us,
+            _prof.now_us() - req._t0_us,
+            args={"outcome": "admitted", "server": self.name,
+                  "rows": req.n})
         return req
 
     def output(self, x, timeout: float = 30.0,
@@ -801,12 +849,23 @@ class ModelServer:
                     self._shed_expired()
                     time.sleep(0.005)
                     continue
+                t0_us = _prof.now_us()
                 batch = self._build_batch()
                 if batch:
+                    # the coalesce wait, attributed to the batch's trace
+                    _tracectx.record_span(
+                        "serve:coalesce", batch[0].trace.child(), t0_us,
+                        _prof.now_us() - t0_us,
+                        args={"requests": len(batch), "server": self.name})
                     self._dispatch(batch)
-        except BaseException:
+        except BaseException as e:
             with self._cond:
                 self._died = True
+            # the serve loop dying is exactly the incident the flight
+            # recorder exists for: capture the ring + trace + metrics
+            # before the queued-request failures scroll everything away
+            _flightrec.get_flight_recorder().dump("serve_loop_death",
+                                                  exc=e)
             logger.exception("serving loop died — failing queued requests")
             raise
         finally:
@@ -886,12 +945,31 @@ class ModelServer:
     def _dispatch(self, batch: list):
         total = sum(r.n for r in batch)
         bucket = self._bucket_for(total)
+        t0_us = _prof.now_us()
+        if _prof.tracing_enabled():
+            # per-request queue-wait spans: enqueue -> popped into this
+            # batch (each under its own request's trace)
+            for req in batch:
+                _tracectx.record_span("serve:queue", req.trace.child(),
+                                      req._t0_us, t0_us - req._t0_us,
+                                      args={"rows": req.n})
+        # ONE dispatch span serves the whole coalesced batch: it lives
+        # in batch[0]'s trace and links to EVERY member request's root
+        # span — the fan-in edge Perfetto renders as N flows joining
+        batch_ctx = batch[0].trace.child()
+        _flightrec.get_flight_recorder().record(
+            "serving:dispatch", server=self.name, rows=total,
+            bucket=bucket, requests=len(batch),
+            trace_id=batch_ctx.trace_id)
+        err: Optional[BaseException] = None
         try:
             # inside the try: ANY failure building or running the batch
             # must resolve its requests, never kill the serve loop
             feats = np.concatenate([r.features for r in batch], axis=0)
-            out = self._forward(feats)
+            with _tracectx.use(batch_ctx):
+                out = self._forward(feats)
         except Exception as e:
+            err = e
             self.breaker.record_failure()
             for req in batch:
                 if req._resolve(error=e):
@@ -902,9 +980,19 @@ class ModelServer:
             pos = 0
             for req in batch:
                 if req._resolve(result=_slice_rows(out, pos, pos + req.n)):
-                    LATENCY.observe(now - req.enqueued_at)
+                    # exemplar: ties this latency bucket back to one
+                    # concrete trace on the OpenMetrics exposition
+                    LATENCY.observe(now - req.enqueued_at,
+                                    exemplar=req.trace.trace_id)
                     self._count("completed")
                 pos += req.n
+        _tracectx.record_span(
+            "serve:dispatch", batch_ctx, t0_us, _prof.now_us() - t0_us,
+            args={"server": self.name, "rows": total, "bucket": bucket,
+                  "requests": len(batch),
+                  "outcome": ("completed" if err is None
+                              else type(err).__name__)},
+            links=[r.trace for r in batch])
         OCCUPANCY.observe(total / float(bucket))
         with self._cond:    # stats() readers race this increment (E202)
             self._batches += 1
@@ -925,8 +1013,10 @@ class ModelServer:
         total = int(feats.shape[0])
         last = None
         attempts = 0
+        ctx = _tracectx.current()   # the dispatch span's context
         for _ in range(self.max_retries + 1):
             attempts += 1
+            t_attempt = _prof.now_us()
             if not self._warmed:
                 # pre-warmup traffic legitimately compiles; the
                 # zero-leniency steady-state watchdog must not read the
@@ -946,6 +1036,21 @@ class ModelServer:
             except (Exception, DispatchTimeoutError) as e:
                 last = e
                 REPLICA_FAILURES.inc()
+                rec = _flightrec.get_flight_recorder()
+                rec.record("serving:dispatch_failure", server=self.name,
+                           attempt=attempts, error=type(e).__name__,
+                           detail=str(e)[:256])
+                if isinstance(e, DispatchTimeoutError):
+                    # a hung replica is a prime flight-recorder trigger:
+                    # dump while the pre-timeout evidence is still hot
+                    # (rate-limited — a retry storm makes one bundle)
+                    rec.dump("dispatch_timeout", exc=e)
+                _tracectx.record_span(
+                    "serve:retry",
+                    ctx.child() if ctx is not None else None,
+                    t_attempt, _prof.now_us() - t_attempt,
+                    args={"attempt": attempts,
+                          "error": type(e).__name__})
                 warnings.warn(
                     f"serving dispatch failure (attempt {attempts}): "
                     f"{type(e).__name__}: {e} — probing devices and "
@@ -965,6 +1070,9 @@ class ModelServer:
         fp = (tuple(d.id for d in self.mesh.devices),
               _churn.array_fingerprint(feats))
         self._churn.record("serving:forward", fp, owner=self)
+        _flightrec.get_flight_recorder().record(
+            "serving:forward", server=self.name, devices=list(fp[0]),
+            signature=str(fp[1]))
         with self.mesh:
             x = jax.device_put(feats, self.mesh.batch_sharding(feats.ndim))
             out = _normalize_out(self._fwd(x))

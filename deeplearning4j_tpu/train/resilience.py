@@ -1022,13 +1022,30 @@ def fit_scope(session: Optional["TrainingSession"], model, epochs: int):
     the session (restoring signal handlers) on every exit path. Used by
     MultiLayerNetwork.fit, ComputationGraph.fit, and ParallelWrapper.fit
     so the recovery protocol cannot drift between the three loops."""
+    from deeplearning4j_tpu.profiler import flightrec as _flightrec
+    from deeplearning4j_tpu.profiler import tracecontext as _tracectx
     n_epochs = max(epoch_target(session, model, epochs) - model._epoch, 0)
     try:
-        yield n_epochs
+        # the run's root span: its trace_id doubles as the run_id, and
+        # every step/op span recorded inside the fit inherits it via the
+        # ambient context — how a training dispatch correlates with the
+        # run that issued it
+        with _tracectx.run_span("train:run",
+                                model=type(model).__name__,
+                                epochs=n_epochs):
+            yield n_epochs
     except PreemptionRequested:
         if session is None:
             raise
         session.on_preempt()
+    except BaseException as e:
+        # any other crash unwinding a fit — NonfiniteAttributionError,
+        # a dead-device dispatch, an OOM — triggers the flight recorder
+        # while the evidence (recent spans, metric state, dispatch
+        # signatures) is still in the ring
+        _flightrec.get_flight_recorder().dump(
+            f"fit:{type(e).__name__}", exc=e)
+        raise
     finally:
         if session is not None:
             # surface a failed async checkpoint write at fit exit — unless

@@ -22,9 +22,12 @@ perf PR cites — see ``deeplearning4j_tpu.profiler``):
 - ``GET /metrics``  -> Prometheus text exposition (v0.0.4) of the global
   metrics registry: op-dispatch counters, compile-cache hits/misses,
   H2D/D2H bytes, train step / data-wait histograms, throughput gauges,
-  serving counters. Served regardless of whether a StatsStorage is
-  attached — ``detach()`` removes the dashboard's storage but keeps the
-  scrape endpoint (and the server) alive.
+  serving counters. Clients that send ``Accept:
+  application/openmetrics-text`` get the OpenMetrics dialect instead
+  (trace-id exemplars on histogram buckets, ``# EOF`` terminator).
+  Served regardless of whether a StatsStorage is attached — ``detach()``
+  removes the dashboard's storage but keeps the scrape endpoint (and
+  the server) alive.
 - ``GET /trace``    -> Chrome Trace Event Format JSON of the global span
   tracer (open in ui.perfetto.dev or chrome://tracing).
 
@@ -215,9 +218,14 @@ class _Handler(BaseHTTPRequestHandler):
                 import deeplearning4j_tpu.native.runtime  # noqa: F401
             except Exception:
                 pass
+            accept = self.headers.get("Accept", "")
+            om = "application/openmetrics-text" in accept
+            ctype = ("application/openmetrics-text; version=1.0.0; "
+                     "charset=utf-8" if om else
+                     "text/plain; version=0.0.4; charset=utf-8")
             return self._body(
-                _prof.get_registry().exposition().encode(),
-                "text/plain; version=0.0.4; charset=utf-8")
+                _prof.get_registry().exposition(openmetrics=om).encode(),
+                ctype)
         if url.path == "/trace":
             return self._body(
                 _prof.get_tracer().export_chrome_trace().encode(),
@@ -296,7 +304,7 @@ class UIServer:
     # class-level twin of the instance _lifecycle lock: two threads
     # racing getInstance() must not both construct (and later bind) a
     # server for the same port
-    _instance_lock = threading.Lock()
+    _instance_lock = _prof.InstrumentedLock("ui:instance")
 
     def __init__(self, port: int = 9000):
         self.port = port
@@ -305,7 +313,7 @@ class UIServer:
         # serializes start/stop: attach()/attach_serving() from two
         # threads must not both observe _httpd None and double-bind the
         # port (DL4J-W213), and stop() must not race a concurrent start
-        self._lifecycle = threading.Lock()
+        self._lifecycle = _prof.InstrumentedLock("ui:lifecycle")
 
     @classmethod
     def getInstance(cls, port: int = 9000) -> "UIServer":

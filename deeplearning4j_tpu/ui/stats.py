@@ -16,9 +16,10 @@ from __future__ import annotations
 
 import json
 import os
-import threading
 import time
 from typing import Callable, Dict, List, Optional
+
+from deeplearning4j_tpu.profiler.locks import InstrumentedLock
 
 
 class StatsStorageEvent:
@@ -35,7 +36,7 @@ class StatsStorage:
 
     def __init__(self):
         self._listeners: List[Callable[[StatsStorageEvent], None]] = []
-        self._lock = threading.Lock()
+        self._lock = InstrumentedLock("ui:stats")
 
     # ---------------------------------------------------------------- write
     def putStaticInfo(self, record: Dict):
