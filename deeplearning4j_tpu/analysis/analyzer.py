@@ -210,9 +210,11 @@ def _analyze_multilayer(conf, batch_size, data_devices,
     else:
         _propagate_multilayer(conf, layers, preprocessors, report)
 
-    report.extend(_layout.lint_layers(
-        ((_layer_loc(i, l), l) for i, l in enumerate(layers)),
-        compute_layout=getattr(conf.base, "compute_layout", "NCHW")))
+    located = [(_layer_loc(i, l), l) for i, l in enumerate(layers)]
+    layout_fmt = getattr(conf.base, "compute_layout", "NCHW")
+    report.extend(_layout.lint_layers(located, compute_layout=layout_fmt))
+    report.extend(_layout.lint_conv_stack(located,
+                                          compute_layout=layout_fmt))
     report.extend(_layout.lint_dtype(
         getattr(conf.base, "dtype", None)))
     if mesh is not None:
@@ -455,9 +457,11 @@ def _analyze_graph(conf, batch_size, data_devices,
             all(i in input_types for i in inputs):
         _propagate_graph(topo, input_types, preprocessors, report)
 
-    report.extend(_layout.lint_layers(
-        ((_node_loc(n), n.obj) for n in nodes if n.kind == "layer"),
-        compute_layout=getattr(conf.base, "compute_layout", "NCHW")))
+    located = [(_node_loc(n), n.obj) for n in nodes if n.kind == "layer"]
+    layout_fmt = getattr(conf.base, "compute_layout", "NCHW")
+    report.extend(_layout.lint_layers(located, compute_layout=layout_fmt))
+    report.extend(_layout.lint_conv_stack(located,
+                                          compute_layout=layout_fmt))
     report.extend(_layout.lint_dtype(getattr(conf.base, "dtype", None)))
     if mesh is not None:
         report.extend(_dist.lint_graph(conf, mesh, batch_size))

@@ -111,6 +111,69 @@ def lint_layers(located_layers,
     return diags
 
 
+#: Backends whose matmul unit wants channels on the minor-most (lane)
+#: axis — where an NCHW conv stack predictably pays relayout overhead.
+#: CPU is excluded: oneDNN re-layouts internally either way, so the
+#: NCHW default is not a predictable loss there.
+TPU_LIKE_BACKENDS = frozenset({"tpu"})
+
+#: Minimum run of NCHW convs before the stack lint fires — a single
+#: conv's relayout cost is dispatch noise; a stack compounds it.
+MIN_CONV_STACK = 2
+
+
+def _default_backend() -> Optional[str]:
+    """The active jax backend WITHOUT importing jax (this module stays
+    jax-free): only an ALREADY-imported jax is consulted, so analyzing a
+    config in a jax-less tool process never drags the runtime in."""
+    import sys
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    try:
+        return str(jax.default_backend())
+    except Exception:
+        return None
+
+
+def lint_conv_stack(located_layers, compute_layout: str = "NCHW",
+                    backend: Optional[str] = None) -> List[Diagnostic]:
+    """Proactive W101 (ISSUE 17): an NCHW conv STACK headed for a
+    TPU-like backend is flagged BEFORE any training step runs — the
+    per-layer lane-dim lint only fires on padding waste, but a stack of
+    NCHW convs on the MXU loses to relayout overhead even with perfectly
+    aligned channels.  ``backend`` defaults to the live jax backend (via
+    ``_default_backend``; None/cpu disables the lint).  Layers carrying
+    an NHWC ``data_format`` instance stamp (the ``setComputeLayout``
+    seam — exactly what an applied tuning plan sets) don't count, so the
+    autotuner's winning plan gets a clean bill through ``validate()``."""
+    backend = backend if backend is not None else _default_backend()
+    if backend is None or str(backend).lower() not in TPU_LIKE_BACKENDS:
+        return []
+    convs = []
+    for location, layer in located_layers:
+        if not _is_conv(layer):
+            continue
+        fmt = getattr(layer, "__dict__", {}).get("data_format") \
+            or compute_layout
+        if fmt != "NHWC":
+            convs.append(location)
+    if len(convs) < MIN_CONV_STACK:
+        return []
+    first, last = convs[0], convs[-1]
+    return [Diagnostic(
+        "DL4J-W101", Severity.WARNING, first,
+        f"{len(convs)} conv layers ({first} .. {last}) run in the NCHW "
+        f"compute layout on the '{backend}' backend — every conv pays "
+        f"transpose/relayout overhead instead of keeping channels on the "
+        f"MXU lane axis",
+        fix_hint='enable the NHWC compute seam before training: '
+                 'setComputeLayout("NHWC") (or builder '
+                 '.computeLayout("NHWC")); `python -m '
+                 'deeplearning4j_tpu.tune <model>` finds and persists '
+                 'this plan automatically')]
+
+
 def lint_dtype(dtype, location: str = "config") -> List[Diagnostic]:
     """W102 for dtypes the MXU cannot execute natively."""
     if dtype is None:

@@ -396,34 +396,64 @@ class DiskCompileCache:
     #: writer and swept by _evict (a live write takes milliseconds)
     _TMP_MAX_AGE_S = 3600.0
 
+    #: entries younger than this are NEVER evicted, whatever the entry
+    #: count (the multi-host grace window): on a fleet-shared directory
+    #: another host may have just written an entry it has not dispatched
+    #: yet — its mtime is its only defense against a neighbor's LRU
+    #: pass, and an autotuning sweep multiplying entries must not let
+    #: host A's churn delete host B's seconds-old executable
+    _EVICT_GRACE_S = 300.0
+
     def _evict(self) -> None:
+        """Best-effort LRU over the shared directory — correct under
+        concurrent multi-host writers WITHOUT any cross-host lock.
+
+        Scoring is mtime-based: ``get()`` touches entries on every hit
+        (the LRU clock), so the oldest mtime is the coldest entry on
+        ANY host.  Every filesystem call tolerates losing a race — a
+        file another evictor removed first, an entry vanishing between
+        ``listdir`` and ``getmtime`` — by skipping, never by aborting
+        the sweep; and entries inside the grace window are left alone
+        even when the directory is over capacity (capacity recovers on
+        a later pass once they age; deleting fresh entries would break
+        the writer that has not loaded them yet)."""
         try:
             names = os.listdir(self.dir)
-            # wall clock on purpose: it is compared against file MTIMES,
-            # which are wall-clock too (monotonic would be wrong here)
-            now = time.time()
-            for n in names:
-                if n.startswith(".tmp_cc_"):
-                    p = os.path.join(self.dir, n)
-                    try:
-                        age = now - os.path.getmtime(p)  # dl4j: noqa=W210
-                        if age > self._TMP_MAX_AGE_S:
-                            os.remove(p)    # a crashed writer's orphan
-                    except OSError:
-                        pass
-            entries = [(os.path.getmtime(os.path.join(self.dir, n)), n)
-                       for n in names
-                       if n.startswith("cc_") and n.endswith(".bin")]
         except OSError:
             return
-        entries.sort()
-        while len(entries) > max(1, self.max_entries):
-            _, name = entries.pop(0)
+        # wall clock on purpose: it is compared against file MTIMES,
+        # which are wall-clock too (monotonic would be wrong here)
+        now = time.time()
+        entries = []
+        for n in names:
+            p = os.path.join(self.dir, n)
+            if n.startswith(".tmp_cc_"):
+                try:
+                    age = now - os.path.getmtime(p)  # dl4j: noqa=W210
+                    if age > self._TMP_MAX_AGE_S:
+                        os.remove(p)    # a crashed writer's orphan
+                except OSError:
+                    pass
+                continue
+            if n.startswith("cc_") and n.endswith(".bin"):
+                try:
+                    entries.append((os.path.getmtime(p), n))
+                except OSError:
+                    continue        # concurrently evicted/quarantined
+        entries.sort()              # oldest mtime (coldest) first
+        excess = len(entries) - max(1, self.max_entries)
+        for mtime, name in entries:
+            if excess <= 0:
+                break
+            if now - mtime < self._EVICT_GRACE_S:  # dl4j: noqa=W210
+                break       # sorted: everything after is younger still
             try:
                 os.remove(os.path.join(self.dir, name))
                 _EVICT_DISK.inc()
             except OSError:
-                pass                    # a concurrent evictor got it first
+                pass        # a concurrent evictor got it first — the
+                            # entry is gone either way, count it
+            excess -= 1
 
     def _quarantine(self, path: str, reason: str) -> None:
         dst = os.path.join(os.path.dirname(path),
@@ -635,7 +665,7 @@ def _zeros(shape, dtype):
 
 def warmup(target, shapes, *, mesh=None, policy=None,
            steps_per_dispatch: int = 1, dtype=None, label_dtype=None,
-           strict: bool = False, placement=None):
+           strict: bool = False, placement=None, tuned: bool = False):
     """Unified AOT warmup for fit, resume, shrink, and serving.
 
     ``target`` is a :class:`~deeplearning4j_tpu.serving.server.
@@ -656,15 +686,30 @@ def warmup(target, shapes, *, mesh=None, policy=None,
     optional callable staging warm arrays the way the dispatch path
     stages real ones (the elastic wrapper's sharded megabatch layout);
     ``policy`` attaches a PrecisionPolicy first (same as
-    ``fit(precision=...)``). Nothing executes: warmup populates the
-    compile caches — and, when the persistent cache is configured, the
-    on-disk store — without touching model/optimizer state."""
+    ``fit(precision=...)``). ``tuned=True`` consults the autotuner
+    record store (ISSUE 17) and applies the winning plan for this
+    (model, mesh, backend) BEFORE compiling, so the warmed programs are
+    the ones the tuned fit/serve path will dispatch — the plan's
+    ``steps_per_dispatch`` also takes over when the caller left the
+    default.  Nothing executes: warmup populates the compile caches —
+    and, when the persistent cache is configured, the on-disk store —
+    without touching model/optimizer state."""
     import numpy as np
     if hasattr(target, "buckets") and hasattr(target, "submit"):
         # a ModelServer: its ladder warmup is already the serving-side
         # entry point (and records the zero-recompile churn baseline)
+        if tuned:
+            from deeplearning4j_tpu.tune import records as _trecords
+            m = getattr(target, "model", None)
+            if m is not None:
+                _trecords.auto_apply(m, mesh=mesh, context="warmup")
         return target.warmup(shapes, strict=strict)
     model = target
+    if tuned:
+        from deeplearning4j_tpu.tune import records as _trecords
+        plan = _trecords.auto_apply(model, mesh=mesh, context="warmup")
+        if plan is not None and steps_per_dispatch == 1:
+            steps_per_dispatch = plan.steps_per_dispatch
     if policy is not None:
         model.setPrecisionPolicy(policy)
     if not model._initialized:

@@ -420,6 +420,7 @@ class ComputationGraph:
         self._compute_layout = "NCHW"
         self._fuse_epilogues = False
         self._epilogue_plan = None
+        self._epilogue_shared = None
         fmt = getattr(conf.base, "compute_layout", None)
         if fmt and fmt != "NCHW":
             self.setComputeLayout(fmt)
@@ -469,12 +470,26 @@ class ComputationGraph:
         plan = self._ensure_epilogue_plan() if self._fuse_epilogues else {}
         fused_act = {act: bn for bn, (act, _c, _a) in plan.items()}
         fused_conv = {c for _a, c, _al in plan.values() if c}
+        shared = self._epilogue_shared if self._fuse_epilogues else set()
         env = {k: (v.astype(jnp.float32)
                    if cdt is None and getattr(v, "dtype", None) == jnp.uint8
                    else v)
                for k, v in inputs.items()}   # on-device image-byte cast
         fmt = {k: False for k in env}        # node name -> output is NHWC
         pending_bias: Dict[str, Any] = {}    # fused conv name -> cast bias
+        # shared folded convs: env[] holds the BIAS-LESS output (what the
+        # fused BN wants); every other consumer reads this re-biased copy
+        # (bit-identical to the unfused conv, see L.conv_bias_add)
+        biased: Dict[str, Any] = {}
+
+        def read(name, consumer=None):
+            if name in biased:
+                if consumer is not None and consumer in plan \
+                        and plan[consumer][1] == name:
+                    return env[name]     # the anchor BN folds the bias
+                return biased[name]
+            return env[name]
+
         new_states = {}
         for ti, node in enumerate(self.conf.topo):
             if node.name in fused_act:
@@ -487,7 +502,7 @@ class ComputationGraph:
                 continue
             scope = _devicetime.scope_name(ti, node.name)
             if node.kind == "layer":
-                x = env[node.inputs[0]]
+                x = read(node.inputs[0], node.name)
                 cur_nhwc = fmt[node.inputs[0]]
                 if node.name in self.conf.preprocessors:
                     if cur_nhwc:
@@ -508,6 +523,9 @@ class ComputationGraph:
                         out, ns = node.obj.apply(p, states[node.name], x,
                                                  train, sub, skip_bias=True)
                         pending_bias[node.name] = p.get("b")
+                        if node.name in shared:
+                            biased[node.name] = L.conv_bias_add(
+                                node.obj, out, p.get("b"))
                     elif isinstance(node.obj, _MASK_AWARE):
                         out, ns = node.obj.apply(p, states[node.name],
                                                  x, train, sub, mask=fmask)
@@ -517,7 +535,7 @@ class ComputationGraph:
                 new_states[node.name] = ns
                 fmt[node.name] = cur_nhwc and getattr(out, "ndim", 0) == 4
             else:
-                xs = [env[i] for i in node.inputs]
+                xs = [read(i) for i in node.inputs]
                 in_fmts = [fmt[i] for i in node.inputs]
                 transparent = isinstance(node.obj, (ElementWiseVertex,
                                                     ScaleVertex, ShiftVertex))
@@ -539,7 +557,7 @@ class ComputationGraph:
                     out = node.obj.apply(*xs)
                 fmt[node.name] = out_nhwc and getattr(out, "ndim", 0) == 4
             env[node.name] = out
-        return [L.to_nchw(env[o]) if fmt.get(o) else env[o]
+        return [L.to_nchw(read(o)) if fmt.get(o) else read(o)
                 for o in self.conf.graph_outputs], new_states
 
     def _as_input_dict(self, inputs) -> Dict[str, jnp.ndarray]:
@@ -895,21 +913,30 @@ class ComputationGraph:
         ``scale_shift_act`` dispatch — see
         ``MultiLayerNetwork.setEpilogueFusion``. On a graph, a fusion
         anchors at a BatchNormalization node whose ONLY consumer is a
-        relu/leaky ActivationLayer node (the folded conv additionally
-        requires the BN to be the conv's only consumer)."""
+        relu/leaky ActivationLayer node.  A conv whose output feeds
+        MORE consumers than the BN still folds: the BN takes the
+        bias-less output (bias rides in its shift) and the other
+        consumers read a bit-identical re-biased copy, so residual
+        taps off a conv no longer block the fold."""
         enabled = bool(enabled)
         if enabled != self._fuse_epilogues:
             self._train_step_cache.clear()
             self._megastep_cache.clear()
             self._fwd_cache = None
             self._epilogue_plan = None
+            self._epilogue_shared = None
         self._fuse_epilogues = enabled
         return self
 
     def _ensure_epilogue_plan(self):
         """{bn_node: (act_node, folded_conv_node|None, alpha)} — static,
-        built once per fusion toggle from the graph topology."""
-        if self._epilogue_plan is not None:
+        built once per fusion toggle from the graph topology.  Also
+        builds ``self._epilogue_shared``: folded convs whose output has
+        consumers BESIDES the anchoring BN — ``_forward`` materializes a
+        bit-identical re-biased copy for those readers (the fold itself
+        still skips the bias and rides it in the BN shift)."""
+        if (self._epilogue_plan is not None
+                and getattr(self, "_epilogue_shared", None) is not None):
             return self._epilogue_plan
         conf = self.conf
         consumers: Dict[str, List[str]] = {}
@@ -919,6 +946,8 @@ class ComputationGraph:
         for out in conf.graph_outputs:
             consumers.setdefault(out, []).append("__output__")
         plan: Dict[str, tuple] = {}
+        folded: set = set()          # convs already claimed by an earlier BN
+        shared: set = set()          # folded convs with extra consumers
         by_name = conf.node_by_name
         for node in conf.topo:
             if node.kind != "layer" or not L.fusable_bn(node.obj):
@@ -935,13 +964,20 @@ class ComputationGraph:
                 continue
             conv_name = None
             src = by_name.get(node.inputs[0]) if node.inputs else None
+            # a conv feeding >1 consumer no longer blocks the fold; it
+            # folds into AT MOST one BN (first in topo order), and any
+            # other consumer reads the re-biased copy
             if (src is not None and src.kind == "layer"
                     and L.fusable_conv(src.obj) and src.obj.has_bias
-                    and len(consumers.get(src.name, [])) == 1
+                    and src.name not in folded
                     and node.name not in conf.preprocessors):
                 conv_name = src.name
+                folded.add(src.name)
+                if len(consumers.get(src.name, [])) > 1:
+                    shared.add(src.name)
             plan[node.name] = (act_node.name, conv_name, alpha)
         self._epilogue_plan = plan
+        self._epilogue_shared = shared
         return plan
 
     def setDeviceAugmentation(self, augment) -> "ComputationGraph":
@@ -1005,10 +1041,13 @@ class ComputationGraph:
     def fit(self, data, labels=None, epochs: int = 1,
             steps_per_dispatch: int = 1, prefetch: int = 2,
             checkpoint=None, nan_policy=None, faults=None, augment=None,
-            precision=None):
+            precision=None, tune=None):
         """Accepts a DataSetIterator, DataSet, MultiDataSet, or arrays.
         ``precision=`` attaches a mixed-precision policy (see
         :meth:`setPrecisionPolicy`).
+        ``tune="auto"`` applies the autotuner record store's winning
+        plan for this (model, mesh, backend) — see MultiLayerNetwork.fit
+        and ``tune/``; a ``TuningPlan`` instance applies directly.
         ``steps_per_dispatch=K`` runs K update steps per compiled dispatch
         with double-buffered device prefetch (``prefetch=0`` = synchronous
         consumption on the calling thread) — see MultiLayerNetwork.fit.
@@ -1021,6 +1060,9 @@ class ComputationGraph:
         if not self._initialized:
             self.init()
         self._ensure_opt_state()
+        if tune is not None:
+            steps_per_dispatch, prefetch = _stepping.apply_tuned_plan(
+                self, tune, steps_per_dispatch, prefetch)
         if augment is not None:
             self.setDeviceAugmentation(augment)
         if precision is not None:

@@ -1763,6 +1763,18 @@ def fused_bn_act(bn, params, state, x, train, alpha: float, bias=None):
     return out, new_state
 
 
+def conv_bias_add(layer, out, b):
+    """Re-attach a conv bias to a ``skip_bias=True`` conv output,
+    bit-identical to the unfused path: ``conv_ops.conv2d`` applies its
+    bias as this exact broadcast add AFTER the conv and the output dtype
+    cast (and a fusable conv's activation is identity), so
+    ``conv_bias_add(layer, conv_no_bias, b) == conv2d(..., b=b)`` to the
+    bit.  Used when a folded conv's output also feeds consumers OUTSIDE
+    its fused BN epilogue: they read the re-biased tensor while the BN
+    consumes the bias-less one (the bias rides in its shift)."""
+    return out + conv_ops._bias_reshape(b, 2, layer.data_format)
+
+
 def build_epilogue_plan(layers, preprocessors=()) -> Dict[int, Tuple[int, bool, float]]:
     """Static fusion plan over a sequential layer list:
     ``{start_index: (n_layers_consumed, conv_leads, alpha)}`` —

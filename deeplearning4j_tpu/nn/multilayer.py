@@ -795,9 +795,18 @@ class MultiLayerNetwork:
     def fit(self, data, labels=None, epochs: int = 1,
             steps_per_dispatch: int = 1, prefetch: int = 2,
             checkpoint=None, nan_policy=None, faults=None, augment=None,
-            precision=None):
+            precision=None, tune=None):
         """ref: MultiLayerNetwork.fit(DataSetIterator) — accepts an
         iterator, a DataSet, or (features, labels) arrays.
+
+        ``tune="auto"`` consults the autotuner record store
+        (``tune.records``) for this (model, mesh, backend, jax version)
+        and applies the winning :class:`~deeplearning4j_tpu.tune.space.
+        TuningPlan` — layout/fusion/precision seams plus the plan's
+        ``steps_per_dispatch``/``prefetch`` wherever the caller left the
+        defaults (explicit arguments, including ``precision=``, win).
+        No record -> one warning, defaults stand.  A ``TuningPlan``
+        instance applies directly, bypassing the store.
 
         ``precision=PrecisionPolicy("bfloat16")`` (or just ``"bf16"``)
         attaches the mixed-precision policy for this and later fits —
@@ -848,6 +857,9 @@ class MultiLayerNetwork:
         if not self._initialized:
             self.init()
         self._ensure_opt_state()
+        if tune is not None:
+            steps_per_dispatch, prefetch = _stepping.apply_tuned_plan(
+                self, tune, steps_per_dispatch, prefetch)
         if augment is not None:
             self.setDeviceAugmentation(augment)
         if precision is not None:

@@ -134,7 +134,8 @@ class ModelRegistry:
     # ------------------------------------------------------------- loading
     def load(self, name: str, model, version: Optional[int] = None,
              shapes=None, decode=None, warm: bool = True,
-             roll: Optional[bool] = None, plan=None, **server_kw) -> int:
+             roll: Optional[bool] = None, plan=None, tuned: bool = False,
+             **server_kw) -> int:
         """Load ``model`` as a new version of ``name`` and AOT-warm its
         bucket ladder while any active version keeps taking traffic.
 
@@ -150,7 +151,11 @@ class ModelRegistry:
         mesh: params place per the plan's NamedShardings (tensor-
         parallel serving of a model too big to replicate) before the
         server builds, and the plan's mesh overrides the registry's.
-        Returns the version number."""
+        ``tuned=True`` consults the autotuner record store (ISSUE 17)
+        and applies the winning plan's model seams (layout/fusion/
+        precision) before the server builds and warms — the staged
+        version serves the TUNED forward; no record -> one warning and
+        defaults stand. Returns the version number."""
         with self._lock:
             if self._closed:
                 raise RuntimeError("registry is closed")
@@ -186,6 +191,13 @@ class ModelRegistry:
                 plan.place_params(model)
                 kw.setdefault("mesh", plan.mesh)
             kw.setdefault("mesh", self.mesh)
+            if tuned:
+                # tuned-plan application BEFORE the server builds (and
+                # outside the registry lock, like warmup): the bucket
+                # ladder compiles the tuned forward, not the default one
+                from deeplearning4j_tpu.tune import records as _trecords
+                _trecords.auto_apply(model, mesh=kw.get("mesh"),
+                                     context="registry.load")
             server = ModelServer(model, name=f"{name}:v{version}", **kw)
             if warm and shapes:
                 # the expensive step, deliberately OUTSIDE the registry

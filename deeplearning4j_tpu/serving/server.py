@@ -488,6 +488,11 @@ class ModelServer:
         dispatch: ``"argmax"``, ``"softmax"``, ``"top_k"``/``"top_k:k"``
         (-> ``(values, indices)``), or any callable on the logits —
         D2H then moves the head's output, not the logits.
+    tuned : consult the autotuner record store (``tune/``, ISSUE 17)
+        and apply the winning plan's model seams (compute layout, fused
+        epilogues, precision) before the forward resolves — the bucket
+        ladder then warms the TUNED program. No record: one warning,
+        defaults stand.
     """
 
     def __init__(self, model, mesh: DeviceMesh = None, batch_limit: int = 32,
@@ -499,8 +504,16 @@ class ModelServer:
                  drain_timeout: float = 30.0, input_dtype=np.float32,
                  preemption=None, faults=None, rewarm_on_shrink: bool = True,
                  name: Optional[str] = None, forward=None, head=None,
-                 _breaker_clock=time.monotonic):
+                 tuned: bool = False, _breaker_clock=time.monotonic):
         self.model = model
+        if tuned and hasattr(model, "setComputeLayout"):
+            # autotuner record store (ISSUE 17): apply the winning plan's
+            # model seams BEFORE the forward resolves/compiles, so the
+            # bucket ladder warms the tuned program (no record -> one
+            # warning, defaults stand)
+            from deeplearning4j_tpu.tune import records as _tune_records
+            _tune_records.auto_apply(model, mesh=mesh,
+                                     context="ModelServer")
         self._fwd = forward if forward is not None else resolve_forward(model)
         self.head = head
         self._head_fn = _make_head(head)

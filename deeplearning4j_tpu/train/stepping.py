@@ -323,3 +323,32 @@ def fit_epoch_multistep(model, batches: Iterable, steps: int,
     else:
         drive(stage_item(item, placement)
               for item in group_into_megabatches(batches, steps))
+
+
+def apply_tuned_plan(model, tune, steps_per_dispatch: int, prefetch: int):
+    """Resolve ``fit(tune=...)`` (ISSUE 17): ``"auto"`` consults the
+    autotuner record store for this (model, mesh, backend, jax version)
+    key; a :class:`~deeplearning4j_tpu.tune.space.TuningPlan` instance
+    applies directly.  The plan's model-level seams (layout, fusion,
+    precision) apply through the model's own signature-keyed setters —
+    re-applying an equal plan keeps every compiled-step cache — and the
+    plan's fit-level knobs take over only where the caller left the
+    defaults.  Returns the effective ``(steps_per_dispatch, prefetch)``."""
+    from deeplearning4j_tpu.tune import records as _trecords
+    from deeplearning4j_tpu.tune.space import TuningPlan
+    if isinstance(tune, TuningPlan):
+        plan = tune
+        plan.apply(model)
+    elif tune == "auto":
+        plan = _trecords.auto_apply(
+            model, mesh=getattr(model, "_sharding_plan", None),
+            context="fit")
+    else:
+        raise ValueError(
+            f'tune= expects "auto" or a TuningPlan, got {tune!r}')
+    if plan is not None:
+        if steps_per_dispatch == 1:
+            steps_per_dispatch = plan.steps_per_dispatch
+        if prefetch == 2:
+            prefetch = plan.prefetch
+    return steps_per_dispatch, prefetch

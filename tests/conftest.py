@@ -54,6 +54,11 @@ def pytest_configure(config):
         "not a slow one: tier-1 runs `-m 'not slow'`, so every chaos "
         "sweep — including the elastic device-loss/hung-dispatch sweeps — "
         "is part of the default gate")
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running acceptance runs EXCLUDED from tier-1's "
+        "`-m 'not slow'` gate (e.g. the ISSUE-17 "
+        "`tune resnet50 --budget 20` step-time-reduction pin)")
 
 
 @pytest.fixture(scope="session")

@@ -154,6 +154,72 @@ class TestDiskStore:
         store.put(keys[0], b"x")              # refresh + trigger evict
         assert store.entry_count() == 3
 
+    def test_eviction_grace_window(self, tmp_path):
+        """ISSUE 17 satellite: entries younger than the grace window are
+        never evicted even over capacity — a concurrent multi-host
+        writer may not have loaded its own fresh entry yet."""
+        store = cc.DiskCompileCache(str(tmp_path), max_entries=2)
+        keys = [cc.content_key("t", f"g{i}".encode(), ()) for i in range(4)]
+        for k in keys:
+            store.put(k, b"x")
+        # every put triggered _evict, but all 4 entries are fresh
+        assert store.entry_count() == 4
+        for k in keys[:2]:                    # age the two oldest
+            os.utime(store._path(k), (1000, 1000))
+        store._evict()
+        assert store.entry_count() == 2
+        assert store.get(keys[3]) == b"x"     # fresh survivors intact
+        assert store.get(keys[2]) == b"x"
+        assert store.get(keys[0]) is None
+
+    def test_eviction_survives_vanishing_entry(self, tmp_path, monkeypatch):
+        """An entry vanishing between listdir and getmtime (another
+        host's evictor won the race) is skipped — the sweep still
+        removes the remaining cold excess instead of aborting."""
+        store = cc.DiskCompileCache(str(tmp_path), max_entries=1)
+        keys = [cc.content_key("t", f"v{i}".encode(), ()) for i in range(3)]
+        for k in keys:                        # all fresh: grace-protected
+            store.put(k, b"x")
+        for i, k in enumerate(keys):          # now age them together
+            os.utime(store._path(k), (1000 + i, 1000 + i))
+        ghost = store._path(keys[1])
+        real_getmtime = os.path.getmtime
+
+        def getmtime(p):
+            if p == ghost:
+                raise OSError("vanished")
+            return real_getmtime(p)
+        monkeypatch.setattr(cc.os.path, "getmtime", getmtime)
+        store._evict()                        # sees 2 entries, excess 1
+        monkeypatch.undo()
+        assert store.entry_count() == 2       # oldest visible one removed
+        assert store.get(keys[0]) is None
+
+    def test_eviction_survives_concurrent_remove(self, tmp_path,
+                                                 monkeypatch):
+        """os.remove losing a race with another evictor (entry already
+        gone) still counts toward the excess and the sweep continues."""
+        store = cc.DiskCompileCache(str(tmp_path), max_entries=1)
+        keys = [cc.content_key("t", f"r{i}".encode(), ()) for i in range(3)]
+        for k in keys:                        # all fresh: grace-protected
+            store.put(k, b"x")
+        for i, k in enumerate(keys):          # now age them together
+            os.utime(store._path(k), (1000 + i, 1000 + i))
+        real_remove = os.remove
+        raced = []
+
+        def remove(p):
+            real_remove(p)                    # the "other evictor" won...
+            if not raced:
+                raced.append(p)
+                raise OSError("already gone")  # ...so ours sees ENOENT
+        monkeypatch.setattr(cc.os, "remove", remove)
+        store._evict()
+        monkeypatch.undo()
+        assert raced                          # the race actually happened
+        assert store.entry_count() == 1
+        assert store.get(keys[2]) == b"x"
+
     def test_concurrent_put_same_key_atomic(self, tmp_path):
         store = cc.DiskCompileCache(str(tmp_path))
         key = cc.content_key("t", b"race", ())

@@ -509,6 +509,90 @@ class TestFusedEpilogue:
         b.fit(ds)
         assert abs(a.score() - b.score()) < 1e-5
 
+    def test_multi_consumer_conv_fold_bit_exact(self):
+        """ISSUE 17 satellite (PR-14 carry): a conv output feeding >1
+        consumer no longer blocks the bias fold — the anchor BN takes
+        the bias-less output, every OTHER consumer (here a residual Add
+        and a graph output tap) reads a re-biased copy that must be
+        BIT-IDENTICAL to the unfused conv."""
+        from deeplearning4j_tpu.nn.graph import (ComputationGraph,
+                                                 ElementWiseVertex)
+
+        def build(tap=False):
+            g = (NeuralNetConfiguration.Builder().seed(3).weightInit("relu")
+                 .graphBuilder().addInputs("in")
+                 .setInputTypes(InputType.convolutional(8, 8, 3)))
+            g.addLayer("c1", ConvolutionLayer(kernelSize=(3, 3),
+                                              padding=(1, 1), nOut=8,
+                                              activation="identity"), "in")
+            g.addLayer("bn1", BatchNormalization(), "c1")
+            g.addLayer("r1", ActivationLayer("relu"), "bn1")
+            g.addVertex("add", ElementWiseVertex("Add"), "c1", "r1")
+            g.addLayer("gp", GlobalPoolingLayer("avg"), "add")
+            g.addLayer("out", OutputLayer(nOut=3, lossFunction="mcxent",
+                                          activation="softmax"), "gp")
+            g.setOutputs(*(("out", "c1") if tap else ("out",)))
+            return ComputationGraph(g.build()).init()
+
+        x = np.random.RandomState(0).randn(4, 3, 8, 8).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[
+            np.random.RandomState(0).randint(0, 3, 4)]
+        # c1 has THREE consumers (bn1, add, the output tap) and still
+        # folds; the tapped conv output is bit-exact vs the unfused net
+        a, b = build(tap=True), build(tap=True).setEpilogueFusion(True)
+        plan = b._ensure_epilogue_plan()
+        assert plan["bn1"][1] == "c1"
+        assert "c1" in b._epilogue_shared
+        oa, ob = a.output(x), b.output(x)
+        assert np.array_equal(np.asarray(oa[1]), np.asarray(ob[1]))
+        assert np.abs(np.asarray(oa[0]) - np.asarray(ob[0])).max() < 1e-5
+        # train-path loss parity through the residual reader
+        a, b = build(), build().setEpilogueFusion(True)
+        ds = DataSet(x, y)
+        la, lb = [], []
+        for _ in range(4):
+            a.fit(ds)
+            la.append(a.score())
+            b.fit(ds)
+            lb.append(b.score())
+        scale = max(abs(la[0]), 1e-6)
+        assert max(abs(p - q) / scale for p, q in zip(la, lb)) < 0.10
+
+    def test_conv_folds_into_one_bn_only(self):
+        """A conv feeding TWO fusable BN+relu chains folds into exactly
+        one (first in topo order); the other BN reads the re-biased
+        conv output so its statistics match the unfused net."""
+        from deeplearning4j_tpu.nn.graph import (ComputationGraph,
+                                                 ElementWiseVertex)
+
+        def build():
+            g = (NeuralNetConfiguration.Builder().seed(5).weightInit("relu")
+                 .graphBuilder().addInputs("in")
+                 .setInputTypes(InputType.convolutional(8, 8, 3)))
+            g.addLayer("c1", ConvolutionLayer(kernelSize=(3, 3),
+                                              padding=(1, 1), nOut=8,
+                                              activation="identity"), "in")
+            g.addLayer("bnA", BatchNormalization(), "c1")
+            g.addLayer("rA", ActivationLayer("relu"), "bnA")
+            g.addLayer("bnB", BatchNormalization(), "c1")
+            g.addLayer("rB", ActivationLayer("relu"), "bnB")
+            g.addVertex("add", ElementWiseVertex("Add"), "rA", "rB")
+            g.addLayer("gp", GlobalPoolingLayer("avg"), "add")
+            g.addLayer("out", OutputLayer(nOut=3, lossFunction="mcxent",
+                                          activation="softmax"), "gp")
+            g.setOutputs("out")
+            return ComputationGraph(g.build()).init()
+
+        b = build().setEpilogueFusion(True)
+        plan = b._ensure_epilogue_plan()
+        folded = [c for _a, c, _al in plan.values() if c]
+        assert folded == ["c1"]          # exactly one BN claimed the conv
+        assert "c1" in b._epilogue_shared
+        x = np.random.RandomState(1).randn(4, 3, 8, 8).astype(np.float32)
+        a = build()
+        assert np.abs(np.asarray(a.output(x))
+                      - np.asarray(b.output(x))).max() < 1e-5
+
 
 # --------------------------------------------------- augment device kernels
 class TestAugmentKernels:
