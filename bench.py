@@ -752,6 +752,65 @@ def bench_serving(quick: bool = False):
         timeout=900)
 
 
+def bench_imported(quick: bool = False):
+    """Imported-model serving row (ISSUE 18 satellite): an in-process
+    ONNX fixture (conv -> pool -> gemm) through importOnnxModel ->
+    samediff_forward -> ModelServer warmup, timing each border crossing.
+    The lint counts come from the same analyzer pass warmup runs — a
+    nonzero error count here means the import gate would have rejected
+    the model before traffic."""
+    import numpy as np
+    from deeplearning4j_tpu.modelimport import onnx_proto as P
+    from deeplearning4j_tpu.modelimport.onnx import OnnxGraphImport
+    from deeplearning4j_tpu.serving.server import (ModelServer,
+                                                   samediff_forward)
+    rng = np.random.RandomState(7)
+    nodes = [
+        P.encode_node("Conv", ["x", "cw", "cb"], ["c1"], name="conv1",
+                      strides=[2, 2], pads=[1, 1, 1, 1],
+                      kernel_shape=[3, 3]),
+        P.encode_node("Relu", ["c1"], ["r1"], name="relu1"),
+        P.encode_node("GlobalAveragePool", ["r1"], ["gap"], name="gap"),
+        P.encode_node("Flatten", ["gap"], ["fl"], name="flat", axis=1),
+        P.encode_node("Gemm", ["fl", "fw", "fb"], ["out"], name="fc",
+                      transB=1),
+    ]
+    inits = [
+        P.encode_tensor("cw", rng.randn(32, 3, 3, 3).astype(np.float32)),
+        P.encode_tensor("cb", np.zeros(32, np.float32)),
+        P.encode_tensor("fw", rng.randn(16, 32).astype(np.float32)),
+        P.encode_tensor("fb", np.zeros(16, np.float32)),
+    ]
+    model = P.encode_model(
+        nodes,
+        inputs=[P.encode_value_info("x", np.float32, (None, 3, 32, 32))],
+        outputs=[P.encode_value_info("out", np.float32, (None, 16))],
+        initializers=inits)
+
+    t0 = time.perf_counter()
+    sd = OnnxGraphImport.importOnnxModel(model)
+    import_s = time.perf_counter() - t0
+    server = ModelServer(samediff_forward(sd, ["out"]), batch_limit=8)
+    t0 = time.perf_counter()
+    report = server.validate(shapes=[(3, 32, 32)])
+    server.warmup([(3, 32, 32)])
+    warmup_s = time.perf_counter() - t0
+    n = 20 if quick else 100
+    feats = rng.rand(4, 3, 32, 32).astype(np.float32)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        server.submit(feats).get(30.0)
+    serve_s = time.perf_counter() - t0
+    server.close()
+    return {
+        "import_seconds": round(import_s, 4),
+        "warmup_seconds": round(warmup_s, 4),
+        "img_per_sec": round(n * feats.shape[0] / serve_s, 2),
+        "lint_errors": len(report.errors()),
+        "lint_warnings": len(report.warnings()),
+    }
+
+
 def bench_device_timing(quick: bool = False):
     """Device-timing probe (benchmarks/probe_device_timing.py): asserts
     the devicetime bridge produces a non-empty per-layer attribution
@@ -1010,6 +1069,9 @@ def main(argv):
             virtual="--virtual-mesh" in argv)
     if "--serving" in argv:
         detail["serving"] = bench_serving(quick)
+    if "--skip-imported" not in argv:
+        detail["imported_onnx"] = _with_retries(
+            lambda: bench_imported(quick), "imported_onnx")
     if "--cold-start" in argv:
         detail["cold_start"] = bench_cold_start(quick)
     if "--device-timing" in argv:

@@ -41,6 +41,21 @@ validation is the same shape of tool):
   propagation over ``_Node`` graphs plus ``E151`` undefined input,
   ``E152`` shape conflict, ``E153`` bad loss variable, ``W151`` dangling
   placeholder, ``W152`` unused variable, ``W153`` no training op.
+- :mod:`graphir` — jax-free analysis IR (typed tensor facts: shape,
+  dtype, param-vs-activation, per-op FLOPs, producer/consumer edges)
+  with two lowerings: :func:`~graphir.from_samediff` (recorded ``_Node``
+  graphs, including imported ones) and :func:`~graphir.from_multilayer`
+  (native configs — the parity proof). The layout / distribution /
+  numerics families run over the IR, so ``sd.validate(mesh=...,
+  policy=..., data_range=...)`` emits the same codes native configs get.
+- :mod:`imports` — import-time lints shared by the Keras/ONNX/TF
+  importers (each attaches a ``ValidationReport`` as ``import_report``
+  on the returned model; ``analyze()`` folds it in): ``E161`` unmapped
+  op, ``E162`` unhonored attribute semantics, ``E163`` lossy dtype
+  narrowing, ``W161`` dynamic-dim placeholder recompile churn, ``W162``
+  frozen-graph variable trained as constant, ``W163`` import-time
+  const-folding overflow. ``tools/lint.py`` re-imports the TF fixture
+  corpus against these codes (``[tool.dl4j.imports]`` suppressions).
 - :mod:`concurrency` — AST-level thread-safety lints over source files
   or modules (:func:`analyze_concurrency`, ``--concurrency`` on the
   CLI, and the ``tools/lint.py`` self-lint gate): ``E201`` unguarded
@@ -74,6 +89,15 @@ from deeplearning4j_tpu.analysis.diagnostics import (DIAGNOSTIC_CODES,
                                                      ValidationReport,
                                                      normalize_code)
 from deeplearning4j_tpu.analysis.distribution import MeshSpec, PipelineSpec
+from deeplearning4j_tpu.analysis.graphir import (GraphIR, from_multilayer,
+                                                 from_samediff,
+                                                 lint_ir_distribution,
+                                                 lint_ir_layout,
+                                                 lint_ir_numerics)
+from deeplearning4j_tpu.analysis.imports import (lint_narrowed_array,
+                                                 lint_onnx_model,
+                                                 lint_placeholder_shape,
+                                                 samediff_import_report)
 from deeplearning4j_tpu.analysis.numerics import DataRangeSpec, lint_numerics
 from deeplearning4j_tpu.analysis.pipeline import (InputPipelineSpec,
                                                   lint_input_pipeline)
@@ -91,4 +115,8 @@ __all__ = [
     "normalize_code", "RecompileChurnDetector",
     "get_churn_detector", "array_fingerprint", "lint_serving",
     "lint_registry_roll", "lint_compile_cache",
+    "GraphIR", "from_samediff", "from_multilayer", "lint_ir_layout",
+    "lint_ir_distribution", "lint_ir_numerics",
+    "lint_onnx_model", "lint_narrowed_array", "lint_placeholder_shape",
+    "samediff_import_report",
 ]

@@ -6,6 +6,8 @@ Usage::
     python -m deeplearning4j_tpu.analysis LeNet ResNet50   # named zoo models
     python -m deeplearning4j_tpu.analysis my.module        # module attrs
     python -m deeplearning4j_tpu.analysis my.module:build  # one attribute
+    python -m deeplearning4j_tpu.analysis --samediff my.module:sd
+    python -m deeplearning4j_tpu.analysis --onnx model.onnx
 
 A module target is scanned for ZooModel subclasses, configurations, and
 networks; a ``module:attr`` target names one such object (callables are
@@ -80,6 +82,22 @@ def _resolve(target: str) -> List[Tuple[str, object]]:
     return found
 
 
+def _resolve_onnx(path: str):
+    """An .onnx target: SameDiff when every op imports, otherwise the
+    jax-free E161 pre-scan report (importing would just raise)."""
+    from deeplearning4j_tpu.analysis import imports as _imp
+    from deeplearning4j_tpu.modelimport import onnx_proto as op_
+    try:
+        model = op_.load_model(path)
+    except (OSError, ValueError) as e:
+        raise SystemExit(f"--onnx {path}: {e}")
+    pre = _imp.lint_onnx_model(model)
+    if any(d.code == "DL4J-E161" for d in pre.diagnostics):
+        return pre
+    from deeplearning4j_tpu.modelimport.onnx import OnnxGraphImport
+    return OnnxGraphImport.importOnnxModel(model)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m deeplearning4j_tpu.analysis",
@@ -91,6 +109,18 @@ def main(argv=None) -> int:
                          "module:attr")
     ap.add_argument("--zoo", action="store_true",
                     help="lint every model-zoo architecture")
+    ap.add_argument("--samediff", action="append", default=[],
+                    metavar="MODULE:ATTR",
+                    help="lint a recorded SameDiff graph: module:attr "
+                         "naming a SameDiff (or a no-arg callable "
+                         "returning one) — runs the full layout/"
+                         "distribution/numerics parity passes plus any "
+                         "attached import_report (repeatable)")
+    ap.add_argument("--onnx", action="append", default=[], metavar="PATH",
+                    help="lint an .onnx file: the jax-free E16x/W16x "
+                         "pre-scan, then (when every op imports) the "
+                         "full analyzer over the imported graph "
+                         "(repeatable)")
     ap.add_argument("--concurrency", metavar="PATH_OR_MODULE",
                     action="append", default=[],
                     help="run the E2xx/W21x thread-safety lints over a "
@@ -236,6 +266,10 @@ def main(argv=None) -> int:
                        for name, cls in _zoo_registry().items())
     for t in args.targets:
         targets.extend(_resolve(t))
+    for t in args.samediff:
+        targets.extend(_resolve(t))
+    for path in args.onnx:
+        targets.append((path, _resolve_onnx(path)))
     if not targets:
         ap.print_usage()
         print("nothing to lint: pass --zoo and/or target names")
@@ -244,13 +278,17 @@ def main(argv=None) -> int:
     failed = 0
     total = ValidationReport()
     for name, obj in targets:
-        report = analyze(obj, batch_size=args.batch_size,
-                         data_devices=args.devices, mesh=args.mesh,
-                         hbm_gb=args.hbm_gb,
-                         zero=True if args.zero else None,
-                         input_pipeline=pipeline_spec,
-                         policy=policy_spec, data_range=range_spec,
-                         suppress=suppress, severity_overrides=overrides)
+        if isinstance(obj, ValidationReport):   # unimportable .onnx: the
+            report = obj.apply_config(suppress, overrides)   # pre-scan IS
+        else:                                                # the report
+            report = analyze(obj, batch_size=args.batch_size,
+                             data_devices=args.devices, mesh=args.mesh,
+                             hbm_gb=args.hbm_gb,
+                             zero=True if args.zero else None,
+                             input_pipeline=pipeline_spec,
+                             policy=policy_spec, data_range=range_spec,
+                             suppress=suppress,
+                             severity_overrides=overrides)
         report.subject = name
         total.extend(report.diagnostics)
         print(report.format())

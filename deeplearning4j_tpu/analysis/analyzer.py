@@ -70,23 +70,14 @@ def analyze(target, batch_size: Optional[int] = None,
     conf = getattr(target, "conf", target)
     mesh_spec = _mesh_spec(mesh, sharding, pipeline, hbm_gb, zero)
     if hasattr(conf, "_nodes") and hasattr(conf, "_placeholders"):
-        if mesh_spec is not None:
-            raise ValueError(
-                "the distribution lints (mesh=/sharding=/pipeline=/"
-                "hbm_gb=) apply to layer configurations, not SameDiff "
-                "graphs — recorded op graphs carry no per-layer shard "
-                "declaration to check yet")
         if input_pipeline is not None:
             raise ValueError(
                 "the input-pipeline lint (input_pipeline=) applies to "
                 "layer configurations, not SameDiff graphs")
-        if policy is not None or data_range is not None:
-            raise ValueError(
-                "the numerics lints (policy=/data_range=) apply to "
-                "layer configurations, not SameDiff graphs — recorded "
-                "op graphs carry no per-layer dtype rule to check yet")
         from deeplearning4j_tpu.analysis.samediff import analyze_samediff
         report = analyze_samediff(conf, batch_size=batch_size or 1)
+        report.extend(_samediff_lints(conf, batch_size, data_devices,
+                                      mesh_spec, policy, data_range))
     elif hasattr(conf, "graph_inputs") and hasattr(conf, "nodes"):
         report = _analyze_graph(conf, batch_size, data_devices, mesh_spec)
     elif hasattr(conf, "layers") and hasattr(conf, "base"):
@@ -106,7 +97,33 @@ def analyze(target, batch_size: Optional[int] = None,
             model=target if target is not conf else None))
     if target is not conf:                       # a network: add model-level
         report.extend(_model_checks(target))
+    for holder in (target, conf):       # importer-attached findings (E16x)
+        imported = getattr(holder, "import_report", None)
+        if imported is not None:
+            report.extend(imported.diagnostics)
+            break
     return report.apply_config(suppress, severity_overrides)
+
+
+def _samediff_lints(sd, batch_size, data_devices, mesh_spec, policy,
+                    data_range) -> List[Diagnostic]:
+    """Full lint parity for recorded graphs: lower the SameDiff to the
+    analysis IR (:mod:`~deeplearning4j_tpu.analysis.graphir`) and run the
+    same layout/distribution/numerics families native configs get, plus
+    the W162 frozen-weight check."""
+    from deeplearning4j_tpu.analysis import graphir as _gir
+    from deeplearning4j_tpu.analysis import imports as _imports
+    ir = _gir.from_samediff(sd, batch_size=batch_size or 1)
+    diags: List[Diagnostic] = []
+    diags.extend(_gir.lint_ir_layout(
+        ir, batch_size,
+        data_devices if mesh_spec is None else None))
+    if mesh_spec is not None:
+        diags.extend(_gir.lint_ir_distribution(ir, mesh_spec, batch_size))
+    diags.extend(_gir.lint_ir_numerics(ir, policy=policy,
+                                       data_range=data_range))
+    diags.extend(_imports.lint_frozen_constants(sd))
+    return diags
 
 
 def _mesh_spec(mesh, sharding, pipeline, hbm_gb,

@@ -187,6 +187,27 @@ DIAGNOSTIC_CODES = {
                  "depends on (it gets zero gradient every step)",
     "DL4J-W153": "no training op: a TrainingConfig is set but no loss "
                  "variables are marked, so fit() has nothing to minimize",
+    # E16x/W16x import-time lints (analysis/imports.py, emitted by the
+    # Keras/ONNX/TF importers into the returned model's import_report).
+    "DL4J-E161": "unmapped import op: the source graph uses an op the "
+                 "importer has no builder for — the import raises (or "
+                 "the pre-scan reports every such op up front)",
+    "DL4J-E162": "unhonored import semantics: an attribute/opset detail "
+                 "the builder cannot reproduce exactly (ceil_mode pools, "
+                 "SAME_LOWER asymmetric padding, ...) — results will "
+                 "differ from the source framework",
+    "DL4J-E163": "lossy import narrowing: an initializer or input dtype "
+                 "is narrowed at import (fp64 weights -> fp32, int64 "
+                 "indices -> int32) and large values would truncate",
+    "DL4J-W161": "dynamic-dim placeholder: a non-batch dimension is "
+                 "unknown at import, so every distinct shape fed at "
+                 "runtime compiles a fresh executable (recompile churn)",
+    "DL4J-W162": "frozen variable: a source-graph variable imported as a "
+                 "constant while a TrainingConfig exists — fit() will "
+                 "never update it",
+    "DL4J-W163": "import const-folding overflow: folding constant "
+                 "subgraphs at import produced nonfinite floats or "
+                 "values past the target integer range",
 }
 
 

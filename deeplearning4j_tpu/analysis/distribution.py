@@ -342,10 +342,13 @@ def _param_facts(entries, mesh: MeshSpec, itemsize: int) -> List[_ParamFact]:
     for idx, (loc, layer, _it, _out) in enumerate(entries):
         shapes = getattr(layer, "param_shapes", lambda: {})()
         lname = getattr(layer, "name", None) or type(layer).__name__
+        qualified = getattr(layer, "qualified_params", False)
         for pname, shape in shapes.items():
             if not shape or any(not d or d < 0 for d in shape):
                 continue                       # unresolved nIn/nOut: skip
-            full = f"{lname}/{pname}"
+            # graphir's fact bundles carry already-qualified tensor names
+            # (the sharding regexes must see the graph's own names)
+            full = pname if qualified else f"{lname}/{pname}"
             spec = _spec_for(rules, full, len(shape))
             facts.append(_ParamFact(idx, loc, full, shape, spec, itemsize,
                                     mesh))
@@ -374,6 +377,12 @@ def _approx_flops(layer, it, out_it) -> int:
     the W105 stage-balance lint undercounts it (the PR-4 carried
     follow-up; same for conv-LSTM, whose gate convs now come from
     ``ConvLSTM2D.param_shapes``)."""
+    hook = getattr(layer, "approx_flops", None)
+    if hook is not None:     # declared-fact hook (graphir's IR entries)
+        try:
+            return int(hook())
+        except Exception:
+            return 0
     shapes = getattr(layer, "param_shapes", lambda: {})()
     w = sum(_prod(s) for s in shapes.values() if len(s) >= 2)
     mult = 1
@@ -463,15 +472,88 @@ def lint_multilayer(conf, mesh: MeshSpec,
 
 def lint_graph(conf, mesh: MeshSpec,
                batch_size: Optional[int]) -> List[Diagnostic]:
-    """Graph configs get every per-tensor/mesh check; the pipeline checks
-    are sequential-only (a DAG has no single stage order to split)."""
+    """Graph configs get every per-tensor/mesh check. InputTypes
+    propagate through vertices (PR-4 carried follow-up), so the
+    type-dependent checks (W105 stage balance from real per-layer FLOPs,
+    W106 geometry, W107 collectives) see the same facts the sequential
+    path does; the pipeline pass runs over the topological layer order —
+    the one linearization a DAG stage split could use."""
     from deeplearning4j_tpu.analysis.analyzer import _node_loc
-    entries = [(_node_loc(n), n.obj, None, None)
-               for n in conf.nodes if n.kind == "layer"]
-    return lint_entries(entries, mesh, batch_size,
-                        getattr(getattr(conf, "base", None), "dtype", None),
-                        updater=getattr(getattr(conf, "base", None),
-                                        "updater", None))
+    types = _propagate_graph_types(conf)
+    entries = []
+    for n in _graph_layer_order(conf):
+        it, out = types.get(n.name, (None, None))
+        entries.append((_node_loc(n), n.obj, it, out))
+    diags = lint_entries(entries, mesh, batch_size,
+                         getattr(getattr(conf, "base", None), "dtype", None),
+                         updater=getattr(getattr(conf, "base", None),
+                                         "updater", None))
+    diags.extend(_lint_pipeline(entries, mesh))
+    return diags
+
+
+def _graph_layer_order(conf) -> List:
+    """Layer nodes in topological order (declaration order breaks ties /
+    cycles — the structural analyzer owns reporting those)."""
+    return [n for n in _graph_order_all(conf, list(conf.nodes))
+            if n.kind == "layer"]
+
+
+def _propagate_graph_types(conf) -> Dict[str, Tuple]:
+    """Best-effort (in_type, out_type) per graph node, propagated through
+    layer nodes AND vertices in topological order. Unknown inputs or a
+    failing hook stop that path only — downstream nodes get (None, None)
+    and the checks degrade exactly as they always did."""
+    out: Dict[str, Tuple] = {}
+    input_types = dict(getattr(conf, "input_types", {}) or {})
+    if not input_types:
+        return out
+    try:
+        from deeplearning4j_tpu.nn import preprocessors as pp
+    except ImportError:      # jax-blocked environment: skip refinement
+        return out
+    preprocessors = dict(getattr(conf, "preprocessors", {}) or {})
+    types = dict(input_types)
+    nodes = list(conf.nodes)
+    for n in _graph_order_all(conf, nodes):
+        in_types = [types.get(r) for r in n.inputs]
+        if any(t is None for t in in_types) or not in_types:
+            continue
+        try:
+            if n.kind == "layer":
+                it = in_types[0]
+                pre = preprocessors.get(n.name)
+                if pre is None:
+                    pre = pp.preprocessor_for(it, n.obj)
+                if pre is not None:
+                    it = pre.output_type(it)
+                nxt = n.obj.output_type(it)
+                out[n.name] = (it, nxt)
+                types[n.name] = nxt
+            else:
+                types[n.name] = n.obj.output_type(*in_types)
+        except Exception:
+            continue          # structural analyzer reports this path
+    return out
+
+
+def _graph_order_all(conf, nodes) -> List:
+    """All nodes (layers + vertices) topologically, same tie-breaking as
+    :func:`_graph_layer_order`."""
+    seen = set(getattr(conf, "graph_inputs", ()) or ())
+    names = {n.name for n in nodes}
+    order, remaining = [], list(nodes)
+    progressed = True
+    while remaining and progressed:
+        progressed = False
+        for n in list(remaining):
+            if all(r in seen or r not in names for r in n.inputs):
+                order.append(n)
+                seen.add(n.name)
+                remaining.remove(n)
+                progressed = True
+    order.extend(remaining)
+    return order
 
 
 def lint_entries(entries, mesh: MeshSpec, batch_size: Optional[int],

@@ -863,7 +863,10 @@ def _input_type_from_batch_shape(shape: List) -> InputType:
     if len(dims) == 3:    # keras NHWC -> our convolutional(h, w, c)
         return InputType.convolutional(dims[0], dims[1], dims[2])
     if len(dims) == 2:    # keras [T, C] -> our recurrent(C, T)
-        return InputType.recurrent(dims[1], dims[0])
+        # a free time dim is Keras's variable-length convention; ours
+        # is -1 (the W161 import lint flags the recompile cost)
+        return InputType.recurrent(dims[1],
+                                   -1 if dims[0] is None else dims[0])
     if len(dims) == 1:
         return InputType.feedForward(dims[0])
     raise KerasImportError(f"unsupported input rank {len(dims) + 1}")
@@ -928,6 +931,8 @@ class KerasModelImport:
 
     @staticmethod
     def _import_functional(path: str) -> ComputationGraph:
+        from deeplearning4j_tpu.analysis import imports as _imp
+        report = _imp.ValidationReport(subject="Keras import")
         archive = Hdf5Archive(path)
         try:
             cfg = archive.model_config()["config"]
@@ -946,6 +951,8 @@ class KerasModelImport:
                 inbound = [alias.get(n, n) for n in _inbound_names(entry)]
                 if cls == "InputLayer":
                     shape = lcfg.get("batch_shape") or lcfg.get("batch_input_shape")
+                    report.extend(_imp.lint_placeholder_shape(
+                        shape, f"input '{name}'"))
                     input_types[name] = _input_type_from_batch_shape(shape)
                     alias[name] = name
                     continue
@@ -1011,18 +1018,24 @@ class KerasModelImport:
                 node = node_by_name[imp.kname]
                 src = node.inputs[0]
                 pre_it = types.get(src, input_types.get(src))
+                for wname, arr in kw.items():
+                    report.extend(_imp.lint_narrowed_array(
+                        arr, f"layer '{imp.kname}' weight '{wname}'"))
                 params, state = imp.fill(kw, pre_it)
                 target = net._params[imp.kname]
                 _check_shapes(target, params, f"layer {imp.kname}")
                 net._params[imp.kname] = {**target, **params}
                 if state:
                     net._states[imp.kname] = {**net._states[imp.kname], **state}
+            net.import_report = report
             return net
         finally:
             archive.close()
 
     @staticmethod
     def importKerasSequentialModelAndWeights(path: str) -> MultiLayerNetwork:
+        from deeplearning4j_tpu.analysis import imports as _imp
+        report = _imp.ValidationReport(subject="Keras import")
         archive = Hdf5Archive(path)
         try:
             cfg = archive.model_config()
@@ -1051,6 +1064,15 @@ class KerasModelImport:
                 imported.append(_MAPPERS[cls](lcfg))
             if input_type is None:
                 raise KerasImportError("model config declares no input shape")
+            shape = None
+            for entry in entries:
+                _c, lcfg = _layer_config(entry)
+                shape = (lcfg.get("batch_shape")
+                         or lcfg.get("batch_input_shape"))
+                if shape is not None:
+                    break
+            if shape is not None:
+                report.extend(_imp.lint_placeholder_shape(shape, "input"))
 
             b = NeuralNetConfiguration.Builder().list()
             for imp in imported:
@@ -1067,8 +1089,12 @@ class KerasModelImport:
                 kw = archive.layer_weights(imp.kname)
                 if not kw:
                     raise KerasImportError(f"no weights for layer '{imp.kname}'")
+                for wname, arr in kw.items():
+                    report.extend(_imp.lint_narrowed_array(
+                        arr, f"layer '{imp.kname}' weight '{wname}'"))
                 params, state = imp.fill(kw, pre_types[i])
                 _assign(net, i, imp.layer, params, state)
+            net.import_report = report
             return net
         finally:
             archive.close()

@@ -391,10 +391,13 @@ class OnnxGraphImport:
     @staticmethod
     def importOnnxModel(src) -> SameDiff:
         """.onnx path / bytes / parsed ModelProto -> SameDiff."""
+        from deeplearning4j_tpu.analysis import imports as _imp
         model = src if isinstance(src, ModelProto) else op_.load_model(src)
         g = model.graph
         if g is None:
             raise OnnxImportError("model has no graph")
+        report = _imp.lint_onnx_model(model, supported_ops=set(_BUILDERS)
+                                      | {"Constant"})
         sd = SameDiff.create()
         consts: Dict[str, np.ndarray] = {}
         for t in g.initializers:
@@ -408,11 +411,13 @@ class OnnxGraphImport:
             sd.placeHolder(vi.name, shape=shape,
                            dtype=op_.np_dtype(vi.elem_type))
         for node in g.nodes:
-            _import_node(sd, consts, node)
+            _import_node(sd, consts, node, report)
+        sd.import_report = report
         return sd
 
 
-def _import_node(sd: SameDiff, consts: Dict[str, np.ndarray], node: NodeProto):
+def _import_node(sd: SameDiff, consts: Dict[str, np.ndarray],
+                 node: NodeProto, report=None):
     op = node.op_type
     if op == "Constant":
         t = node.attr("value")
@@ -454,6 +459,11 @@ def _import_node(sd: SameDiff, consts: Dict[str, np.ndarray], node: NodeProto):
             outs = res if n_out > 1 else (res,)
             total = sum(int(np.asarray(r).size) for r in outs)
             if total <= _FOLD_LIMIT:
+                if report is not None:
+                    from deeplearning4j_tpu.analysis import imports as _imp
+                    report.extend(_imp.fold_overflow_diags(
+                        op, node.outputs[0],
+                        [np.asarray(r) for r in outs]))
                 for name, r in zip(node.outputs, outs):
                     arr = np.asarray(r)
                     consts[name] = arr

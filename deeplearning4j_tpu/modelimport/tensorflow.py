@@ -584,6 +584,8 @@ class _Ctx:
         # FunctionDefs by name (graph_def.library) — the bodies of
         # StatelessWhile/StatelessIf/PartitionedCall nodes
         self.library: Dict[str, Any] = library or {}
+        self.report = None      # import-time lint sink (E16x/W16x), set
+        #                         by importGraphDef; None inside functions
 
     def const_of(self, name: str) -> np.ndarray:
         if name not in self.consts:
@@ -1324,11 +1326,13 @@ class TFGraphImport:
                 gd.ParseFromString(f.read())
             graph_def = gd
 
+        from deeplearning4j_tpu.analysis import imports as _imp
         sd = SameDiff.create()
         library = {f.signature.name: f
                    for f in graph_def.library.function} \
             if graph_def.HasField("library") else {}
         ctx = _Ctx(sd, library)
+        ctx.report = _imp.ValidationReport(subject="TF import")
         nodes = list(graph_def.node)
         if any(n.op in _V1_CF_OPS for n in nodes):
             nodes = _topo_sort(nodes)
@@ -1343,6 +1347,11 @@ class TFGraphImport:
         else:
             for node in nodes:
                 _import_one(ctx, node, _var_name)
+        # W161 from the recorded placeholders, then the findings the
+        # import loop itself collected (E163 consts, W163 folds)
+        report = _imp.samediff_import_report(sd)
+        report.extend(ctx.report.diagnostics)
+        sd.import_report = report
         return sd
 
 
@@ -1353,6 +1362,10 @@ def _import_one(ctx: _Ctx, node, resolver):
     data_ins = [resolver(i) for i in node.input if not i.startswith("^")]
     if node.op == "Const":
         val = _tensor_value(node)
+        if ctx.report is not None:
+            from deeplearning4j_tpu.analysis import imports as _imp
+            ctx.report.extend(_imp.lint_narrowed_array(
+                val, f"const '{node.name}'"))
         ctx.consts[node.name] = val
         ctx.sd.constant(val, name=node.name)
     elif node.op == "Placeholder":
@@ -1754,6 +1767,10 @@ def _record_tf_node(ctx: _Ctx, node, params: dict, used: List[str],
             _fold_output_size_ok(fn, [ctx.consts[u] for u in used]):
         res = fn(*[ctx.consts[u] for u in used])
         outs = res if n_out > 1 else (res,)
+        if ctx.report is not None:
+            from deeplearning4j_tpu.analysis import imports as _imp
+            ctx.report.extend(_imp.fold_overflow_diags(
+                node.op, node.name, [np.asarray(r) for r in outs]))
         for i, r in enumerate(outs):
             name = node.name if (i == 0 and n_out == 1) else f"{node.name}:{i}"
             arr = np.asarray(r)

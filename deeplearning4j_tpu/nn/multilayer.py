@@ -1321,6 +1321,12 @@ class MultiLayerNetwork:
         if sig not in self._tbptt_step_cache:
             self._tbptt_step_cache[sig] = self._make_tbptt_step(sig)
         step = self._tbptt_step_cache[sig]
+        # provenance (profiler.sanitizer): the segment dispatch retains
+        # its carried RNN state so a nonfinite loss attributes to the
+        # (layer, op, step) — including a poisoned carry crossing the
+        # segment boundary
+        tok = _sanitizer.snapshot(self, "tbptt", x=x, y=y, lmask=lmask,
+                                  seg_states=seg_states)
         for lst in self._listeners:
             if hasattr(lst, "onIterationStart"):
                 lst.onIterationStart(self, self._iteration + 1)
@@ -1329,8 +1335,8 @@ class MultiLayerNetwork:
             self._ensure_clock(), x, y,
             lmask if lmask is not None else jnp.zeros((1,)), seg_states)
         self._score = loss  # on-device; score() converts lazily
-        _environment.panic_check(
-            loss, f"tBPTT loss at iteration {self._iteration}")
+        _sanitizer.check(self, tok, loss,
+                         context=f"tBPTT loss at iteration {self._iteration}")
         self._iteration += 1
         return new_seg
 

@@ -44,8 +44,11 @@ modes always paid + one fused copy dispatch every ``snapshot_every``
 steps (``benchmarks/probe_numerics_overhead.py`` pins provenance at
 < 5% over the legacy panic gate; measured ~1%); the roll-forward /
 eager replay and range walks run only on failure / sampled steps.
-TBPTT fits keep the plain loss-level panic (segment-state replay is
-not wired; ``environment.panic_check``).
+TBPTT fits attribute through the same window (kind ``"tbptt"``): each
+segment dispatch retains its carried RNN state, the replay rolls the
+segment steps through the compiled TBPTT body, and the eager walk names
+the (layer, op, step) — including a poisoned carried state crossing a
+segment boundary (``carried-state``).
 
 Like the rest of ``profiler/``, module scope imports no jax — jax
 enters lazily on the first active snapshot.
@@ -167,7 +170,7 @@ class _Token:
         self.ring_index = ring_index
         self.step0 = step0          # 0-based iteration count before dispatch
         self.batch = batch          # dict of arrays the step consumed
-        self.kind = kind            # "single" | "mega" | "graph" | "graph_mega"
+        self.kind = kind   # "single" | "mega" | "tbptt" | "graph" | "graph_mega"
 
 
 _STATES: "weakref.WeakKeyDictionary" = None  # created on first use
@@ -319,6 +322,24 @@ def _roll_dispatch(model, kind: str, batch: dict, start_it: int,
             params, states, opt, _, _ = step(params, states, opt, *args)
     if n_steps <= 0:
         return params, states, opt, scale
+    if kind == "tbptt":
+        # segment step: donates (params, opt, t), threads the RECORDED
+        # carried RNN state — each ring entry holds the seg_states it was
+        # actually dispatched with, so entries never thread state between
+        # replays. No dynamic-scale variant (fitTBPTT pre-dates it).
+        b = batch
+        sig = b.get("lmask") is not None
+        if sig not in model._tbptt_step_cache:
+            model._tbptt_step_cache[sig] = model._make_tbptt_step(sig)
+        step = model._tbptt_step_cache[sig]
+        dummy = jnp.zeros((1,))
+        for i in range(n_steps):
+            params, opt, _, _, _ = step(
+                params, states, opt, jnp.asarray(start_it + i, jnp.int32),
+                b["x"], b["y"],
+                b["lmask"] if b.get("lmask") is not None else dummy,
+                b["seg_states"])
+        return params, states, opt, scale
     if kind in ("single", "mega"):
         mega = kind == "mega"
         b = batch
@@ -375,6 +396,10 @@ def _attribute(model, token: _Token, j: int) -> Tuple[str, str]:
         scale)
     t = token.step0 + j
     b = token.batch
+    if token.kind == "tbptt":
+        return _attribute_tbptt(
+            model, params, states, opt, t, b["x"], b["y"],
+            b.get("lmask"), b["seg_states"])
     if token.kind in ("single", "mega"):
         idx = (lambda a: a[j]) if token.kind == "mega" else (lambda a: a)
         return _attribute_multilayer(
@@ -565,6 +590,82 @@ def _attribute_multilayer(model, params, states, opt, t, x, y, fmask,
         return head_name, f"loss:{getattr(model.layers[-1], 'loss_fn', '?')}"
     return _grad_site_mln(model, params, states, opt, t, x_step, y, fmask,
                           lmask, scale_state=scale_state)
+
+
+def _attribute_tbptt(model, params, states, opt, t, x, y, lmask,
+                     seg_states) -> Tuple[str, str]:
+    """First-nonfinite site over an eager mirror of the compiled TBPTT
+    segment body (``_make_tbptt_step.loss_fn``): same preprocessors,
+    same RNG stream, same carried-state threading — so the attributed
+    (layer, op, step) names the segment step that actually went bad,
+    including a poisoned carried RNN state crossing a segment boundary."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.nn.multilayer import _MASK_AWARE
+    bad = _bad_fn()
+    cur = jnp.asarray(x)
+    if bad(cur):
+        return "<input>", "batch"
+    key = jax.random.fold_in(jax.random.PRNGKey(model.conf.base.seed),
+                             jnp.asarray(t, jnp.int32))
+    for i, layer in enumerate(model.layers):
+        name = f"{i}:{layer.name}"
+        if i in model.conf.preprocessors:
+            cur = model.conf.preprocessors[i](cur)
+        if _tree_bad(params[i], bad):
+            return name, "params"
+        if seg_states[i] is not None and _tree_bad(seg_states[i], bad):
+            return name, "carried-state"
+        key, sub = jax.random.split(key)
+        if hasattr(layer, "apply_with_state"):
+            cur, _ = layer.apply_with_state(params[i], cur, seg_states[i])
+        elif isinstance(layer, _MASK_AWARE):
+            cur, _ = layer.apply(params[i], states[i], cur, True, sub,
+                                 mask=None)
+        else:
+            cur, _ = layer.apply(params[i], states[i], cur, True, sub)
+        if bad(cur):
+            return name, f"forward:{type(layer).__name__}"
+    head = len(model.layers) - 1
+    head_name = f"{head}:{model.layers[head].name}"
+    loss = model.layers[-1].compute_loss(jnp.asarray(y), cur, mask=lmask)
+    if bad(loss):
+        return head_name, f"loss:{getattr(model.layers[-1], 'loss_fn', '?')}"
+    return _grad_site_tbptt(model, params, states, opt, t, x, y, lmask,
+                            seg_states)
+
+
+def _grad_site_tbptt(model, params, states, opt, t, x, y, lmask,
+                     seg_states) -> Tuple[str, str]:
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.nn.multilayer import _MASK_AWARE
+    bad = _bad_fn()
+    seed = model.conf.base.seed
+    x_j, y_j = jnp.asarray(x), jnp.asarray(y)
+
+    def loss_fn(p):
+        cur = x_j
+        key = jax.random.fold_in(jax.random.PRNGKey(seed),
+                                 jnp.asarray(t, jnp.int32))
+        for i, layer in enumerate(model.layers):
+            if i in model.conf.preprocessors:
+                cur = model.conf.preprocessors[i](cur)
+            key, sub = jax.random.split(key)
+            if hasattr(layer, "apply_with_state"):
+                cur, _ = layer.apply_with_state(p[i], cur, seg_states[i])
+            elif isinstance(layer, _MASK_AWARE):
+                cur, _ = layer.apply(p[i], states[i], cur, True, sub,
+                                     mask=None)
+            else:
+                cur, _ = layer.apply(p[i], states[i], cur, True, sub)
+        return model.layers[-1].compute_loss(y_j, cur, mask=lmask)
+    grads = jax.grad(loss_fn)(params)
+    names = [f"{i}:{l.name}" for i, l in enumerate(model.layers)]
+    hit = _first_bad_leaf(grads, names, bad)
+    if hit is not None:
+        return hit, "backward"
+    return _updater_site(model, params, grads, opt, t, names, bad)
 
 
 def _attribute_graph(model, params, states, opt, t, ins, labels,

@@ -183,6 +183,10 @@ def samediff_forward(sd, outputs, input_name=None):
         if len(names) == 1:
             return out[names[0]]
         return tuple(out[n] for n in names)
+    # ModelServer.validate/warmup detect the stamp and fold the full
+    # SameDiff analyzer report (graph lints + import_report) into the
+    # serving lint, so warmup(strict=True) gates imported models too
+    forward._samediff = sd
     return forward
 
 
@@ -741,11 +745,16 @@ class ModelServer:
         ``check_cache=True`` (what ``warmup`` passes) adds the DL4J-W112
         persistent-compile-cache check."""
         from deeplearning4j_tpu.analysis.serving import lint_serving
-        return lint_serving(self.model, self.buckets(), mesh=self.mesh,
-                            shapes=shapes, hbm_gb=hbm_gb,
-                            input_dtype=self.input_dtype,
-                            check_cache=check_cache,
-                            extra=self._churn.diagnostics_for(owner=self))
+        report = lint_serving(self.model, self.buckets(), mesh=self.mesh,
+                              shapes=shapes, hbm_gb=hbm_gb,
+                              input_dtype=self.input_dtype,
+                              check_cache=check_cache,
+                              extra=self._churn.diagnostics_for(owner=self))
+        sd = getattr(self.model, "_samediff", None)
+        if sd is not None:      # samediff_forward stamp: run the full
+            from deeplearning4j_tpu.analysis import analyze   # graph lints
+            report.extend(analyze(sd).diagnostics)
+        return report
 
     # ------------------------------------------------------- health surface
     @property

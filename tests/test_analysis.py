@@ -1033,7 +1033,9 @@ class TestPureStaticDistribution:
         for code in ("DL4J-E101", "DL4J-E102", "DL4J-E103", "DL4J-E104",
                      "DL4J-W104", "DL4J-W105", "DL4J-W106", "DL4J-W107",
                      "DL4J-E151", "DL4J-E152", "DL4J-E153", "DL4J-W151",
-                     "DL4J-W152", "DL4J-W153"):
+                     "DL4J-W152", "DL4J-W153",
+                     "DL4J-E161", "DL4J-E162", "DL4J-E163", "DL4J-W161",
+                     "DL4J-W162", "DL4J-W163"):
             assert code in DIAGNOSTIC_CODES
 
 
@@ -1085,12 +1087,19 @@ class TestReviewRegressions:
         with pytest.raises(ValueError, match="mesh"):
             _mlp_conf().validate(hbm_gb=0.001)
 
-    def test_samediff_rejects_mesh_kwargs(self):
+    def test_samediff_mesh_kwargs_run_distribution_lints(self):
+        # ISSUE 18 flipped this pin: mesh= on a recorded graph now runs
+        # the distribution family instead of raising
         from deeplearning4j_tpu.autodiff.samediff import SameDiff
         sd = SameDiff.create()
-        sd.var("v", np.zeros((2, 2)))
-        with pytest.raises(ValueError, match="SameDiff"):
-            sd.validate(mesh="data=8")
+        x = sd.placeHolder("x", shape=(None, 4))
+        w = sd.var("w", np.zeros((4, 2), np.float32))
+        x.mmul(w)
+        report = sd.validate(batch_size=12, mesh="data=8")
+        assert "DL4J-E101" in report.codes(), report.format()
+        # input_pipeline stays native-config-only
+        with pytest.raises(ValueError, match="input_pipeline"):
+            sd.validate(input_pipeline="workers=8,batch=256")
 
     def test_cli_rejects_unknown_codes_cleanly(self, capsys):
         from deeplearning4j_tpu.analysis.__main__ import main
@@ -1975,11 +1984,17 @@ class TestNumericsDiagnostics:
                 assert not bad, (name, pol,
                                  [d.format() for d in bad])
 
-    def test_samediff_rejects_numerics_kwargs(self):
+    def test_samediff_numerics_kwargs_run_numerics_lints(self):
+        # ISSUE 18 flipped this pin: policy=/data_range= on a recorded
+        # graph now run the numerics family instead of raising
         from deeplearning4j_tpu.autodiff.samediff import SameDiff
         sd = SameDiff.create()
-        with pytest.raises(ValueError, match="numerics"):
-            analyze(sd, policy="bf16")
+        x = sd.placeHolder("x", shape=(None, 4))
+        w = sd.var("w", np.zeros((4, 2), np.float32))
+        x.mmul(w)
+        report = analyze(sd, batch_size=8, policy="bf16",
+                         data_range="0..255")
+        assert "DL4J-W303" in report.codes(), report.format()
 
 
 class TestNumericsCli:
@@ -2294,3 +2309,210 @@ class TestFlopModelExtensions:
         r = _lint_src(tmp_path, src)
         assert not [c for c in r.codes() if c.startswith("DL4J-E20")], \
             r.format()
+
+
+# --------------------------------------------- ISSUE 18: import lints
+class TestGraphVertexPropagation:
+    """Satellite: per-vertex sharding/type propagation — graph configs
+    get the same W105/W106 pipeline findings multilayer configs do."""
+
+    def test_w105_fires_on_graph_pipeline_imbalance(self):
+        conf = (_graph_builder()
+                .setInputTypes(InputType.feedForward(64))
+                .addLayer("a", DenseLayer(nOut=4096), "in")
+                .addLayer("b", DenseLayer(nOut=4096), "a")
+                .addLayer("c", DenseLayer(nOut=16), "b")
+                .addLayer("out", OutputLayer(nOut=4), "c")
+                .setOutputs("out").build())
+        report = analyze(conf, batch_size=32, mesh="data=2,pipe=2",
+                         pipeline=2)
+        assert "DL4J-W105" in report.codes(), report.format()
+
+    def test_types_propagate_through_merge_vertex(self):
+        from deeplearning4j_tpu.analysis.distribution import \
+            _propagate_graph_types
+        conf = (_graph_builder()
+                .addLayer("a", DenseLayer(nOut=32), "in")
+                .addLayer("b", DenseLayer(nOut=32), "in")
+                .addVertex("m", MergeVertex(), "a", "b")
+                .addLayer("c", DenseLayer(nOut=16), "m")
+                .addLayer("out", OutputLayer(nOut=4), "c")
+                .setOutputs("out").build())
+        types = _propagate_graph_types(conf)
+        in_t, out_t = types["c"]
+        assert in_t.size == 64          # 32 + 32 through the MergeVertex
+        assert out_t.size == 16
+        # and the linted graph stays clean under a plain data mesh
+        assert analyze(conf, batch_size=32, mesh={"data": 2}).ok()
+
+    def test_balanced_graph_pipeline_clean(self):
+        conf = (_graph_builder()
+                .setInputTypes(InputType.feedForward(64))
+                .addLayer("a", DenseLayer(nOut=256), "in")
+                .addLayer("b", DenseLayer(nOut=256), "a")
+                .addLayer("c", DenseLayer(nOut=256), "b")
+                .addLayer("out", OutputLayer(nOut=256), "c")
+                .setOutputs("out").build())
+        report = analyze(conf, batch_size=32, mesh="data=2,pipe=2",
+                         pipeline=2)
+        assert "DL4J-W105" not in report.codes(), report.format()
+
+
+class TestPureStaticImports:
+    """The graph IR and the import lints run with jax BLOCKED — both
+    operate on declared shapes and numpy arrays only (ISSUE 18
+    acceptance)."""
+
+    def test_graphir_and_imports_run_with_jax_blocked(self):
+        code = (
+            "import sys\n"
+            "sys.modules['jax'] = None\n"
+            "sys.modules['jax.numpy'] = None\n"
+            "import numpy as np\n"
+            "from types import SimpleNamespace as NS\n"
+            "from deeplearning4j_tpu.analysis import MeshSpec\n"
+            "from deeplearning4j_tpu.analysis import graphir, "
+            "imports as imp\n"
+            "class Arr:\n"
+            "    def __init__(self, shape, dtype='float32'):\n"
+            "        self.shape, self.dtype = shape, dtype\n"
+            "class Node:\n"
+            "    def __init__(self, op, ins, outs):\n"
+            "        self.op, self.inputs, self.outputs = op, ins, outs\n"
+            "        self.attrs = {}\n"
+            "sd = NS(_nodes=[Node('matmul', ['x', 'w'], ['y'])],\n"
+            "        _placeholders={'x': ((None, 4096), 'float32')},\n"
+            "        _constants={},\n"
+            "        _variables={'w': Arr((4096, 260))},\n"
+            "        _loss_variables=[], training_config=None)\n"
+            "ir = graphir.from_samediff(sd, batch_size=12)\n"
+            "lay = {d.code for d in graphir.lint_ir_layout(ir, 12, 8)}\n"
+            "assert 'DL4J-W101' in lay, lay\n"
+            "mesh = MeshSpec({'data': 8})\n"
+            "dist = {d.code for d in\n"
+            "        graphir.lint_ir_distribution(ir, mesh, 12)}\n"
+            "assert 'DL4J-E101' in dist, dist\n"
+            "num = {d.code for d in graphir.lint_ir_numerics(\n"
+            "    ir, policy='bf16', data_range='0..255')}\n"
+            "assert 'DL4J-W303' in num, num\n"
+            "assert imp.lint_placeholder_shape((None, None, 3), 'x')\n"
+            "assert imp.lint_narrowed_array(\n"
+            "    np.eye(2, dtype=np.float64), 'w')\n"
+            "assert imp.fold_overflow_diags(\n"
+            "    'Add', 's', [np.asarray([np.inf], np.float32)])\n"
+            "print('PURE-STATIC-IMPORTS-OK')\n")
+        proc = subprocess.run([sys.executable, "-c", code], cwd=str(REPO),
+                              capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stderr
+        assert "PURE-STATIC-IMPORTS-OK" in proc.stdout
+
+
+class TestGraphIRParity:
+    """from_multilayer is the parity proof: lowering a NATIVE config to
+    the IR and linting the IR yields the same distribution codes the
+    native pass emits."""
+
+    DIST = {"DL4J-E101", "DL4J-E102", "DL4J-E103", "DL4J-E104",
+            "DL4J-W104", "DL4J-W105", "DL4J-W106", "DL4J-W107"}
+
+    def test_from_multilayer_distribution_parity(self):
+        from deeplearning4j_tpu.analysis import graphir
+        conf = _wide_mlp()
+        mesh = MeshSpec({"data": 8, "model": 2}, hbm_gb=0.05)
+        native = {d.code
+                  for d in analyze(conf, batch_size=6, mesh=mesh)} & self.DIST
+        ir = graphir.from_multilayer(conf, batch_size=6)
+        lowered = {d.code for d in graphir.lint_ir_distribution(
+            ir, mesh, 6)} & self.DIST
+        assert native == lowered, (native, lowered)
+        assert "DL4J-E101" in lowered    # the set is non-trivial
+
+    def test_onnx_dtype_names_pinned_to_proto(self):
+        from deeplearning4j_tpu.analysis import graphir
+        from deeplearning4j_tpu.modelimport import onnx_proto as P
+        want = {P.DT_FLOAT: "float32", P.DT_UINT8: "uint8",
+                P.DT_INT8: "int8", P.DT_UINT16: "uint16",
+                P.DT_INT16: "int16", P.DT_INT32: "int32",
+                P.DT_INT64: "int64", P.DT_BOOL: "bool",
+                P.DT_FLOAT16: "float16", P.DT_DOUBLE: "float64",
+                P.DT_UINT32: "uint32", P.DT_UINT64: "uint64",
+                P.DT_BFLOAT16: "bfloat16"}
+        assert graphir.ONNX_DTYPE_NAMES == want
+
+
+class TestImportReportMerge:
+    """analyze() folds an attached import_report into the validation
+    report — import-time findings surface at validate() time."""
+
+    def test_import_report_diags_surface_in_analyze(self):
+        from deeplearning4j_tpu.analysis import ValidationReport
+        from deeplearning4j_tpu.autodiff.samediff import SameDiff
+        sd = SameDiff.create()
+        x = sd.placeHolder("x", shape=(None, 4))
+        w = sd.var("w", np.ones((4, 2), np.float32))
+        (x.mmul(w)).rename("y")
+        sd.import_report = ValidationReport(
+            [Diagnostic("DL4J-W161", Severity.WARNING, "input 'x'",
+                        "seeded import finding")], subject="import")
+        report = analyze(sd, batch_size=8)
+        assert "DL4J-W161" in report.codes(), report.format()
+        # suppress= reaches merged import findings too
+        quiet = analyze(sd, batch_size=8, suppress=["W161"])
+        assert "DL4J-W161" not in quiet.codes()
+
+
+class TestImportsSelfLint:
+    """The imported-fixture gate (tools/lint.py run_imports): the shipped
+    TF conformance corpus lints clean with ZERO suppressions."""
+
+    def _lint_mod(self):
+        spec = importlib.util.spec_from_file_location(
+            "repo_lint", REPO / "tools" / "lint.py")
+        lint = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(lint)
+        return lint
+
+    def test_fixture_corpus_lints_clean(self, capsys):
+        lint = self._lint_mod()
+        assert lint._pyproject_imports_suppress() == [], \
+            "the corpus must stay clean with zero suppressions"
+        rc = lint.run_imports()
+        out = capsys.readouterr().out
+        assert rc == 0, f"imported-fixture gate found issues:\n{out}"
+
+    def test_missing_corpus_skips_clean(self, tmp_path, capsys):
+        lint = self._lint_mod()
+        assert lint.run_imports(tmp_path / "nope") == 0
+        assert "skipped" in capsys.readouterr().out
+
+    def test_pyproject_imports_suppress_parse(self, tmp_path):
+        lint = self._lint_mod()
+        (tmp_path / "pyproject.toml").write_text(
+            "[tool.dl4j.imports]\n"
+            'suppress = ["W161"]\n'
+            "[tool.other]\n"
+            'suppress = ["W999"]\n')
+        old = lint.REPO
+        try:
+            lint.REPO = tmp_path
+            assert lint._pyproject_imports_suppress() == ["W161"]
+            assert lint._pyproject_concurrency_suppress() == []
+        finally:
+            lint.REPO = old
+
+
+class TestCliSameDiff:
+    def test_samediff_flag_lints_recorded_graph(self, tmp_path,
+                                                monkeypatch, capsys):
+        mod = tmp_path / "sdmodel.py"
+        mod.write_text(
+            "import numpy as np\n"
+            "from deeplearning4j_tpu.autodiff.samediff import SameDiff\n"
+            "sd = SameDiff.create()\n"
+            "x = sd.placeHolder('x', shape=(None, 4))\n"
+            "w = sd.var('w', np.ones((4, 2), np.float32))\n"
+            "y = x.mmul(w)\n")
+        monkeypatch.syspath_prepend(str(tmp_path))
+        from deeplearning4j_tpu.analysis.__main__ import main
+        assert main(["--samediff", "sdmodel:sd"]) == 0
+        assert "clean" in capsys.readouterr().out

@@ -584,3 +584,34 @@ class TestR5Mappers:
         net = importKerasSequentialModelAndWeights(_save(tmp_path, m))
         got = np.asarray(net.output(np.transpose(x, (0, 4, 1, 2, 3))))
         np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+class TestKerasImportReport:
+    """ISSUE 18: the Keras importer attaches an import_report (the
+    DL4J-W16x/E16x import lints) to the returned network."""
+
+    def test_clean_model_attaches_empty_report(self, tmp_path):
+        m = keras.Sequential([
+            keras.Input(shape=(6,)),
+            KL.Dense(4, activation="relu", name="d1"),
+        ])
+        net = importKerasSequentialModelAndWeights(_save(tmp_path, m))
+        assert hasattr(net, "import_report")
+        assert not net.import_report.diagnostics, \
+            net.import_report.format()
+
+    def test_w161_on_dynamic_sequence_length(self, tmp_path):
+        m = keras.Sequential([
+            keras.Input(shape=(None, 6)),      # free time dim
+            KL.LSTM(4, name="l1"),
+        ])
+        net = importKerasSequentialModelAndWeights(_save(tmp_path, m))
+        codes = [d.code for d in net.import_report]
+        assert "DL4J-W161" in codes, net.import_report.format()
+
+    def test_functional_import_attaches_report(self, tmp_path):
+        inp = keras.Input(shape=(6,))
+        out = KL.Dense(3, name="d")(inp)
+        m = keras.Model(inp, out)
+        net = importKerasModelAndWeights(_save(tmp_path, m))
+        assert hasattr(net, "import_report")

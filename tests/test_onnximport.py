@@ -10,6 +10,7 @@ goldens are computed with numpy.
 import numpy as np
 import pytest
 
+from deeplearning4j_tpu.analysis import imports as IMP
 from deeplearning4j_tpu.modelimport import onnx_proto as P
 from deeplearning4j_tpu.modelimport.onnx import (OnnxImportError,
                                                  importOnnxModel)
@@ -252,3 +253,217 @@ class TestHalfPrecisionIntData:
         assert t.array.dtype == ml_dtypes.bfloat16
         np.testing.assert_array_equal(t.array.astype(np.float32),
                                       vals.astype(np.float32))
+
+
+class TestImportLints:
+    """DL4J-E16x/W16x import-time lints (ISSUE 18): the jax-free ONNX
+    pre-scan, the report the importer attaches as ``import_report``, and
+    full lint parity through ``sd.validate()`` on an imported graph."""
+
+    def _codes(self, diags):
+        return [d.code for d in diags]
+
+    # ---- E161: unmapped op (pre-scan reports ALL, importer raises) ----
+
+    def test_e161_prescan_reports_every_unmapped_op(self):
+        blob = _model(
+            nodes=[P.encode_node("NonMaxSuppression", ["x"], ["y"]),
+                   P.encode_node("StringNormalizer", ["y"], ["z"])],
+            inputs=[("x", np.float32, [4])],
+            outputs=[("z", np.float32, [4])])
+        report = IMP.lint_onnx_model(P.load_model(blob))
+        codes = self._codes(report)
+        assert codes.count("DL4J-E161") == 2, report.format()
+        text = report.format()
+        assert "NonMaxSuppression" in text and "StringNormalizer" in text
+
+    def test_supported_ops_pin_matches_importer(self):
+        from deeplearning4j_tpu.modelimport.onnx import _BUILDERS
+        assert IMP.SUPPORTED_ONNX_OPS == frozenset(_BUILDERS) | {"Constant"}
+
+    # ---- E162: attribute semantics the lowering does not honor ----
+
+    def test_e162_ceil_mode_pool(self):
+        blob = _model(
+            nodes=[P.encode_node("MaxPool", ["x"], ["y"],
+                                 kernel_shape=[2, 2], strides=[2, 2],
+                                 ceil_mode=1)],
+            inputs=[("x", np.float32, [1, 3, 5, 5])],
+            outputs=[("y", np.float32, [1, 3, 3, 3])])
+        report = IMP.lint_onnx_model(P.load_model(blob))
+        assert "DL4J-E161" not in self._codes(report)
+        assert "DL4J-E162" in self._codes(report), report.format()
+        assert "ceil_mode" in report.format()
+
+    def test_e162_same_lower_conv(self):
+        w = np.zeros((4, 3, 3, 3), np.float32)
+        blob = _model(
+            nodes=[P.encode_node("Conv", ["x", "w"], ["y"],
+                                 kernel_shape=[3, 3],
+                                 auto_pad="SAME_LOWER")],
+            inputs=[("x", np.float32, [1, 3, 8, 8])],
+            outputs=[("y", np.float32, [1, 4, 8, 8])],
+            initializers=[("w", w)])
+        report = IMP.lint_onnx_model(P.load_model(blob))
+        assert "DL4J-E162" in self._codes(report), report.format()
+
+    def test_e162_clean_pool_has_no_findings(self):
+        blob = _model(
+            nodes=[P.encode_node("MaxPool", ["x"], ["y"],
+                                 kernel_shape=[2, 2], strides=[2, 2])],
+            inputs=[("x", np.float32, [None, 3, 8, 8])],
+            outputs=[("y", np.float32, [None, 3, 4, 4])])
+        report = IMP.lint_onnx_model(P.load_model(blob))
+        assert not report.diagnostics, report.format()
+
+    # ---- E163: lossy dtype narrowing ----
+
+    def test_e163_float64_initializer(self):
+        w = np.eye(3, dtype=np.float64)
+        blob = _model(
+            nodes=[P.encode_node("MatMul", ["x", "w"], ["y"])],
+            inputs=[("x", np.float32, [None, 3])],
+            outputs=[("y", np.float32, [None, 3])],
+            initializers=[("w", w)])
+        report = IMP.lint_onnx_model(P.load_model(blob))
+        assert "DL4J-E163" in self._codes(report), report.format()
+        assert "float64" in report.format()
+
+    def test_e163_int64_only_when_out_of_int32_range(self):
+        big = np.asarray([2 ** 40], np.int64)
+        small = np.asarray([1, 2, 3], np.int64)
+        for arr, expect in ((big, True), (small, False)):
+            diags = IMP.lint_narrowed_array(arr, "initializer 'ax'")
+            has = "DL4J-E163" in self._codes(diags)
+            assert has is expect, (arr, [str(d) for d in diags])
+
+    # ---- W161: dynamic-dim placeholders ----
+
+    def test_w161_dynamic_non_batch_dim(self):
+        diags = IMP.lint_placeholder_shape((None, None, 224), "input 'x'")
+        assert self._codes(diags) == ["DL4J-W161"]
+        # a dynamic BATCH dim alone is the normal serving contract
+        assert not IMP.lint_placeholder_shape((None, 3, 224), "input 'x'")
+
+    def test_w161_fully_dynamic_graph_input(self):
+        blob = _model(
+            nodes=[P.encode_node("Relu", ["x"], ["y"])],
+            inputs=[("x", np.float32, [None, None, None])],
+            outputs=[("y", np.float32, [None, None, None])])
+        report = IMP.lint_onnx_model(P.load_model(blob))
+        assert "DL4J-W161" in self._codes(report), report.format()
+        # rank-unknown (no shape recorded at all) is the worst case
+        assert IMP.lint_placeholder_shape(None, "input 'x'")
+
+    # ---- W162: frozen-graph constants under a TrainingConfig ----
+
+    def test_w162_frozen_weight_with_training_config(self):
+        from deeplearning4j_tpu.autodiff.samediff import TrainingConfig
+        w = np.ones((4, 3), np.float32)
+        blob = _model(
+            nodes=[P.encode_node("MatMul", ["x", "w"], ["y"])],
+            inputs=[("x", np.float32, [None, 4])],
+            outputs=[("y", np.float32, [None, 3])],
+            initializers=[("w", w)])
+        sd = importOnnxModel(blob)
+        assert not IMP.lint_frozen_constants(sd)   # no config, no finding
+        sd.setTrainingConfig(TrainingConfig())
+        diags = IMP.lint_frozen_constants(sd)
+        assert self._codes(diags) == ["DL4J-W162"], [str(d) for d in diags]
+        sd.convertToVariables("w")
+        assert not IMP.lint_frozen_constants(sd)
+
+    # ---- W163: const-folding overflow ----
+
+    def test_w163_folded_inf(self):
+        a = np.asarray([3.0e38], np.float32)
+        blob = _model(
+            nodes=[P.encode_node("Add", ["a", "a"], ["s"]),
+                   P.encode_node("Add", ["x", "s"], ["y"])],
+            inputs=[("x", np.float32, [None, 1])],
+            outputs=[("y", np.float32, [None, 1])],
+            initializers=[("a", a)])
+        sd = importOnnxModel(blob)
+        codes = self._codes(sd.import_report)
+        assert "DL4J-W163" in codes, sd.import_report.format()
+
+    def test_fold_overflow_direct(self):
+        assert IMP.fold_overflow_diags(
+            "Add", "s", [np.asarray([np.inf], np.float32)])
+        assert IMP.fold_overflow_diags(
+            "Mul", "s", [np.asarray([2 ** 40], np.int64)])
+        assert not IMP.fold_overflow_diags(
+            "Add", "s", [np.asarray([1.0], np.float32)])
+
+    # ---- report plumbing + full-parity acceptance ----
+
+    def test_clean_import_attaches_empty_report(self):
+        w = np.ones((4, 4), np.float32)
+        blob = _model(
+            nodes=[P.encode_node("MatMul", ["x", "w"], ["y"])],
+            inputs=[("x", np.float32, [None, 4])],
+            outputs=[("y", np.float32, [None, 4])],
+            initializers=[("w", w)])
+        sd = importOnnxModel(blob)
+        assert hasattr(sd, "import_report")
+        assert not sd.import_report.diagnostics, sd.import_report.format()
+
+    def _resnet_ish(self, classes=260):
+        """Conv stem -> GAP -> classifier, ONNX-exporter shaped."""
+        rng = np.random.RandomState(0)
+        w = rng.randn(32, 3, 3, 3).astype(np.float32) * 0.1
+        fcw = rng.randn(32, classes).astype(np.float32) * 0.1
+        fcb = np.zeros((classes,), np.float32)
+        return _model(
+            nodes=[
+                P.encode_node("Conv", ["x", "w"], ["c"],
+                              kernel_shape=[3, 3], strides=[2, 2],
+                              pads=[1, 1, 1, 1]),
+                P.encode_node("Relu", ["c"], ["r"]),
+                P.encode_node("GlobalAveragePool", ["r"], ["g"]),
+                P.encode_node("Flatten", ["g"], ["f"]),
+                P.encode_node("Gemm", ["f", "fcw", "fcb"], ["y"],
+                              transB=0),
+            ],
+            inputs=[("x", np.float32, [None, 3, 32, 32])],
+            outputs=[("y", np.float32, [None, classes])],
+            initializers=[("w", w), ("fcw", fcw), ("fcb", fcb)])
+
+    def test_full_lint_parity_on_imported_model(self):
+        """ISSUE 18 acceptance: sd.validate(mesh=..., policy='bf16',
+        data_range='0..255') on an imported graph emits layout +
+        distribution + numerics codes — the exact codes a native config
+        would get."""
+        sd = importOnnxModel(self._resnet_ish(classes=260))
+        report = sd.validate(batch_size=12, mesh={"data": 8},
+                             policy="bf16", data_range="0..255")
+        codes = set(report.codes())
+        assert "DL4J-W101" in codes, report.format()   # layout: 260 lanes
+        assert "DL4J-E101" in codes, report.format()   # dist: 12 % 8 != 0
+        assert "DL4J-W303" in codes, report.format()   # numerics: 0..255
+        # and the well-configured spelling is fully clean
+        sd2 = importOnnxModel(self._resnet_ish(classes=256))
+        clean = sd2.validate(batch_size=16, mesh={"data": 8},
+                             policy="bf16", data_range="0..1,normalized")
+        assert clean.ok(warnings_as_errors=True), clean.format()
+
+    def test_cli_onnx_path(self, tmp_path, capsys):
+        from deeplearning4j_tpu.analysis.__main__ import main
+        p = str(tmp_path / "m.onnx")
+        with open(p, "wb") as f:
+            f.write(self._resnet_ish(classes=256))
+        assert main(["--onnx", p]) == 0
+        out = capsys.readouterr().out
+        assert "clean" in out
+
+    def test_cli_onnx_unmapped_op_fails(self, tmp_path, capsys):
+        from deeplearning4j_tpu.analysis.__main__ import main
+        blob = _model(
+            nodes=[P.encode_node("NonMaxSuppression", ["x"], ["y"])],
+            inputs=[("x", np.float32, [4])],
+            outputs=[("y", np.float32, [4])])
+        p = str(tmp_path / "bad.onnx")
+        with open(p, "wb") as f:
+            f.write(blob)
+        assert main(["--onnx", p]) == 1
+        assert "DL4J-E161" in capsys.readouterr().out

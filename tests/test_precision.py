@@ -357,3 +357,59 @@ class TestNonfiniteProvenance:
                     faults=FaultPlan(nan_layer_params_at={2: 1}))
         assert ei.value.layer == "1:DenseLayer" and ei.value.op == "params"
         assert ei.value.step == 3
+
+
+class TestTbpttProvenance:
+    """ISSUE 18 satellite: first-nonfinite attribution through the TBPTT
+    window — the one fit path ISSUE 11 left on the coarse panic_check."""
+
+    def _net(self, seed=7):
+        from deeplearning4j_tpu.nn.layers import LSTM, RnnOutputLayer
+        conf = (NeuralNetConfiguration.Builder().seed(seed)
+                .updater(Adam(0.01)).weightInit("xavier").list()
+                .layer(LSTM(nOut=6, activation="tanh"))
+                .layer(RnnOutputLayer(nOut=2, lossFunction="mcxent",
+                                      activation="softmax"))
+                .setInputType(InputType.recurrent(3, 12))
+                .backpropType("tbptt", 4)
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    def _seq_data(self, n=5, seed=0):
+        rng = np.random.RandomState(seed)
+        x = rng.randn(n, 3, 12).astype(np.float32)
+        y = np.zeros((n, 2, 12), np.float32)
+        y[np.arange(n), rng.randint(0, 2, n), :] = 1.0
+        return x, y
+
+    def test_tbptt_faultplan_poison_attributed_through_window(self):
+        """Poison at step 4 = second batch, after a full window of
+        segment dispatches — the replay must roll carried state through
+        the ring and still name the exact layer/op/step."""
+        x, y = self._seq_data()
+        net = self._net()
+        profiler.set_profiling_mode(ProfilingMode.NAN_PANIC)
+        with pytest.raises(NonfiniteAttributionError) as ei:
+            net.fit(DataSet(x, y), epochs=2,
+                    faults=FaultPlan(nan_layer_params_at={4: 0}))
+        assert ei.value.layer == "0:LSTM", ei.value.layer
+        assert ei.value.op == "params"
+        assert ei.value.step == 4
+        g = profiler.get_registry().get("dl4j_nonfinite_first_site")
+        assert ("MultiLayerNetwork", "0:LSTM",
+                "params") in g.children()
+
+    def test_tbptt_nan_input_attributed_to_batch(self):
+        x, y = self._seq_data()
+        x[1, 2, 5] = np.nan
+        net = self._net()
+        profiler.set_profiling_mode(ProfilingMode.NAN_PANIC)
+        with pytest.raises(NonfiniteAttributionError) as ei:
+            net.fit(DataSet(x, y), epochs=1)
+        assert ei.value.layer == "<input>" and ei.value.op == "batch"
+
+    def test_tbptt_clean_fit_unchanged(self):
+        x, y = self._seq_data()
+        net = self._net()
+        profiler.set_profiling_mode(ProfilingMode.NAN_PANIC)
+        net.fit(DataSet(x, y), epochs=2)   # must not raise

@@ -1531,3 +1531,47 @@ class TestLoadHints:
                 pytest.approx(hints["shed_rate"])
         finally:
             sv.close()
+
+
+class TestImportedModelWarmupGate:
+    """ISSUE 18: warmup(strict=True) on a SameDiff-backed server runs
+    the FULL graph lints (including any import_report findings) — a bad
+    import cannot reach ready=True."""
+
+    def _sd(self):
+        from deeplearning4j_tpu.autodiff.samediff import SameDiff
+        sd = SameDiff.create()
+        x = sd.placeHolder("x", shape=(None, NIN))
+        w = sd.var("w", np.random.RandomState(7)
+                   .randn(NIN, NOUT).astype(np.float32))
+        sd.nn.softmax(x.mmul(w), name="probs")
+        return sd
+
+    def test_strict_warmup_raises_on_import_error(self):
+        from deeplearning4j_tpu.analysis import (Diagnostic, ModelValidationError,
+                                                 Severity, ValidationReport)
+        from deeplearning4j_tpu.serving import samediff_forward
+        sd = self._sd()
+        sd.import_report = ValidationReport(
+            [Diagnostic("DL4J-E163", Severity.ERROR, "initializer 'w'",
+                        "seeded import-time narrowing error")],
+            subject="import")
+        sv = ModelServer(samediff_forward(sd, ["probs"]), batch_limit=8)
+        try:
+            with pytest.raises(ModelValidationError, match="DL4J-E163"):
+                sv.warmup([(NIN,)], strict=True)
+            assert not sv.ready
+        finally:
+            sv.close()
+
+    def test_strict_warmup_passes_clean_import(self):
+        from deeplearning4j_tpu.serving import samediff_forward
+        sd = self._sd()
+        sv = ModelServer(samediff_forward(sd, ["probs"]), batch_limit=8)
+        try:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")   # W112 cache advice
+                sv.warmup([(NIN,)], strict=True)
+            assert sv.ready
+        finally:
+            sv.close()

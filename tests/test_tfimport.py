@@ -984,3 +984,39 @@ class TestGraphRunnerInterop:
             pass
         with pytest.raises(GraphRunnerError, match="onnxruntime"):
             OnnxRuntimeRunner("/nonexistent.onnx")
+
+
+class TestTFImportReport:
+    """ISSUE 18: importGraphDef attaches an import_report — E163 for
+    narrowed consts, W161 for dynamic-dim placeholders, and a clean
+    bill for well-formed frozen graphs."""
+
+    def _frozen(self, fn, *specs):
+        conc = tf.function(fn).get_concrete_function(*specs)
+        return convert_variables_to_constants_v2(
+            conc).graph.as_graph_def()
+
+    def test_e163_float64_const(self):
+        def f(x):
+            return x + tf.cast(tf.constant(np.pi, tf.float64), tf.float32)
+        gd = self._frozen(f, tf.TensorSpec([2], tf.float32))
+        sd = importTensorflowGraph(gd)
+        codes = [d.code for d in sd.import_report]
+        assert "DL4J-E163" in codes, sd.import_report.format()
+
+    def test_w161_dynamic_non_batch_dim(self):
+        def f(x):
+            return tf.nn.relu(x)
+        gd = self._frozen(f, tf.TensorSpec([None, None, 8], tf.float32))
+        sd = importTensorflowGraph(gd)
+        codes = [d.code for d in sd.import_report]
+        assert "DL4J-W161" in codes, sd.import_report.format()
+
+    def test_clean_graph_attaches_empty_report(self):
+        def f(x):
+            return tf.nn.relu(tf.matmul(x, tf.ones((4, 2))))
+        gd = self._frozen(f, tf.TensorSpec([None, 4], tf.float32))
+        sd = importTensorflowGraph(gd)
+        assert hasattr(sd, "import_report")
+        assert not sd.import_report.diagnostics, \
+            sd.import_report.format()

@@ -28,10 +28,17 @@ warnings-as-errors — per-code suppressions live in pyproject.toml under
 ``[tool.dl4j.concurrency]`` and per-line ones as ``# dl4j: noqa=E201``
 comments. Ruff has no equivalent rule set, so this half always runs.
 
+The gate also re-imports every graph in the persisted TF conformance
+corpus (``tests/fixtures/tfgraphs``) and requires a clean
+``import_report`` (the DL4J-E16x/W16x import lints) with
+warnings-as-errors — suppressions live in pyproject.toml under
+``[tool.dl4j.imports]``.
+
 Usage: ``python tools/lint.py [paths...]`` (default: the package, tests,
 tools, benchmarks). ``--fallback`` forces the AST linter even when ruff
 exists (what the test suite pins); ``--no-concurrency`` skips the
-thread-safety pass (style-only run).
+thread-safety pass (style-only run); ``--no-imports`` skips the
+imported-fixture gate.
 """
 
 from __future__ import annotations
@@ -308,8 +315,8 @@ def run_fallback(paths) -> int:
 CONCURRENCY_PATHS = ["deeplearning4j_tpu"]
 
 
-def _pyproject_concurrency_suppress() -> list:
-    """``[tool.dl4j.concurrency] suppress = ["W212", ...]`` from
+def _pyproject_suppress(section: str) -> list:
+    """``[tool.dl4j.<section>] suppress = ["W212", ...]`` from
     pyproject.toml (line-scoped parse: this container is py3.10, no
     tomllib, and the gate must stay dependency-free). Scans the section
     line by line until the next ``[section]`` header, so other keys,
@@ -319,6 +326,7 @@ def _pyproject_concurrency_suppress() -> list:
         text = (REPO / "pyproject.toml").read_text(encoding="utf-8")
     except OSError:
         return []
+    header = re.escape(f"[tool.dl4j.{section}]")
     in_section = in_array = False
     body: list = []
     for line in text.splitlines():
@@ -332,7 +340,7 @@ def _pyproject_concurrency_suppress() -> list:
             if "]" in stripped:
                 return re.findall(r'"([^"]+)"', " ".join(body))
             continue
-        if re.fullmatch(r"\[tool\.dl4j\.concurrency\]", stripped):
+        if re.fullmatch(header, stripped):
             in_section = True
             continue
         if in_section and re.fullmatch(r"\[[^\]]+\]", stripped):
@@ -347,6 +355,14 @@ def _pyproject_concurrency_suppress() -> list:
                 body.append(rest)       # multi-line array: keep reading
                 in_array = True
     return []
+
+
+def _pyproject_concurrency_suppress() -> list:
+    return _pyproject_suppress("concurrency")
+
+
+def _pyproject_imports_suppress() -> list:
+    return _pyproject_suppress("imports")
 
 
 def run_concurrency(paths=None) -> int:
@@ -375,6 +391,65 @@ def run_concurrency(paths=None) -> int:
     return failed
 
 
+#: what the imported-fixture gate covers: the persisted TF conformance
+#: corpus — every graph must re-import with a clean ``import_report``
+IMPORT_FIXTURE_DIR = "tests/fixtures/tfgraphs"
+
+
+def run_imports(fixture_dir=None) -> int:
+    """Imported-fixture lint gate: re-import every graph in the persisted
+    conformance corpus and require a clean ``import_report`` (the
+    DL4J-E16x/W16x import lints), warnings-as-errors. Per-code
+    suppressions live in pyproject.toml under ``[tool.dl4j.imports]``.
+    Returns 0 when every fixture is clean; skips (0) when the corpus or
+    the TF proto stubs are absent — the gate audits shipped fixtures, it
+    does not require a TF install."""
+    fdir = Path(fixture_dir) if fixture_dir else REPO / IMPORT_FIXTURE_DIR
+    files = sorted(fdir.glob("*.npz")) if fdir.is_dir() else []
+    if not files:
+        print("imports lint: no import fixtures found — skipped")
+        return 0
+    try:
+        from tensorflow.core.framework import graph_pb2
+    except ImportError:
+        print("imports lint: tensorflow protos unavailable — skipped")
+        return 0
+    import numpy as np
+    sys.path.insert(0, str(REPO))
+    try:
+        from deeplearning4j_tpu.modelimport.tensorflow import TFGraphImport
+    finally:
+        sys.path.pop(0)
+    suppress = _pyproject_imports_suppress()
+    failed = checked = 0
+    for path in files:
+        data = np.load(path, allow_pickle=False)
+        gd = graph_pb2.GraphDef()
+        gd.ParseFromString(data["graph_def"].tobytes())
+        try:
+            sd = TFGraphImport.importGraphDef(gd)
+        except ValueError as e:
+            print(f"imports lint: {path.name}: import failed: {e}")
+            failed = 1
+            continue
+        try:
+            report = sd.import_report.apply_config(suppress=suppress)
+        except ValueError as e:
+            # a typo'd code in [tool.dl4j.imports] suppress must be a
+            # clean usage error, not a traceback
+            print(f"imports lint: bad suppress config in "
+                  f"pyproject.toml: {e}")
+            return 1
+        checked += 1
+        if not report.ok(warnings_as_errors=True):
+            report.subject = path.name
+            print(report.format())
+            failed = 1
+    print(f"imports lint: {checked} fixture(s) checked"
+          + ("" if failed else " — clean"))
+    return failed
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("paths", nargs="*", default=None)
@@ -382,6 +457,8 @@ def main(argv=None) -> int:
                     help="force the AST fallback even when ruff is on PATH")
     ap.add_argument("--no-concurrency", action="store_true",
                     help="skip the DL4J-E2xx/W21x thread-safety self-lint")
+    ap.add_argument("--no-imports", action="store_true",
+                    help="skip the DL4J-E16x/W16x imported-fixture gate")
     args = ap.parse_args(argv)
     paths = args.paths or DEFAULT_PATHS
     if not args.fallback and shutil.which("ruff"):
@@ -390,6 +467,8 @@ def main(argv=None) -> int:
         rc = run_fallback(paths)
     if not args.no_concurrency:
         rc = run_concurrency() or rc
+    if not args.no_imports:
+        rc = run_imports() or rc
     return rc
 
 
