@@ -158,6 +158,28 @@ def darknet_tiny_flops(hw=416, n_classes=20, n_boxes=5):
     return f + 2 * c_in * n_boxes * (5 + n_classes) * size * size
 
 
+def cost_calibration(conf, batch, measured_step_s, chip="tpu-v5e",
+                     precision=None):
+    """Calibrate the static cost model (analysis/cost.py) against a
+    measured step: predicted roofline step time and step-peak HBM for
+    this config on ``chip`` (the 197-TFLOP chip PEAK_TFLOPS normalizes
+    MFU against), plus ``cost_model_ratio = measured / predicted`` — the
+    number that tells you how much to trust the model's tune/-pruning
+    and capacity-planning verdicts on this hardware."""
+    from deeplearning4j_tpu.analysis import cost as _cost
+    spec = _cost.CostSpec(chip=chip, precision=precision)
+    est = _cost.step_time(conf, cost=spec, batch_size=batch)
+    mem = _cost.memory_plan(conf, cost=spec, batch_size=batch)
+    ratio = measured_step_s / est.step_s if est.step_s > 0 else None
+    return {"chip": chip,
+            "predicted_step_ms": round(est.step_s * 1e3, 3),
+            "predicted_peak_hbm_mb": round(mem.peak_bytes / 2 ** 20, 1),
+            "predicted_mfu": round(est.mfu, 4),
+            "predicted_bound": est.bound,
+            "measured_step_ms": round(measured_step_s * 1e3, 3),
+            "cost_model_ratio": None if ratio is None else round(ratio, 3)}
+
+
 # --------------------------------------------------------------- benchmarks
 class GemmBench:
     """Large square bf16 GEMM -> TFLOP/s and fraction of MXU peak
@@ -504,6 +526,13 @@ class _CnnBench:
             out["top_offenders"] = self.attribution["top_offenders"]
         if self.tuned is not None:
             out["tuned"] = self.tuned
+        try:    # static-model calibration sub-row: predicted vs measured
+            out["cost_calibration"] = cost_calibration(
+                self.net.conf, self.batch, dt / self.steps,
+                precision=self.precision)
+        except Exception as e:  # noqa: BLE001 — the sub-row must never
+            out["cost_calibration"] = {                      # void a run
+                "error": f"{type(e).__name__}: {e}"}
         return out
 
 

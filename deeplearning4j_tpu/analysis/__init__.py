@@ -63,6 +63,19 @@ validation is the same shape of tool):
   ``E203`` lock-order cycle, ``W210`` wall clock in deadline math,
   ``W211`` un-looped ``Condition.wait``, ``W212`` unjoined worker
   thread, ``W213`` double-checked initialization race.
+- :mod:`cost` / :mod:`chipspec` — whole-program static cost model
+  against a declared :class:`~chipspec.ChipSpec` (``analyze(...,
+  cost=CostSpec(chip="tpu-v4"))``, CLI ``--cost --chip tpu-v4``): an
+  activation-lifetime liveness pass over the :mod:`graphir` edges
+  computes the true training-step HBM high-water mark (params, grads,
+  fp32 masters, ZeRO-aware updater state, live activations held for
+  backward, megastep staging, prefetch), a roofline estimator predicts
+  step time / per-stage time / MFU, and a capacity planner sizes a
+  serving fleet: ``E120`` step-peak HBM overflow, ``E121`` serving-
+  bucket peak overflow, ``E122`` capacity shortfall, ``W120`` remat
+  opportunity, ``W121`` comms-bound step, ``W122`` predicted MFU below
+  target. When ``cost=`` is declared the exact plan supersedes the
+  params-only ``E104``/``W109`` heuristics.
 - :mod:`churn` — runtime detector behind the fit/compile dispatch seams:
   ``dl4j_recompiles_total{site=...}`` in the profiler registry plus a
   ``W201`` diagnostic when one site crosses the signature threshold.
@@ -78,7 +91,10 @@ is pure-static and runs anywhere the configs import.
 """
 
 from deeplearning4j_tpu.analysis.analyzer import analyze
+from deeplearning4j_tpu.analysis.chipspec import CHIP_REGISTRY, ChipSpec
 from deeplearning4j_tpu.analysis.concurrency import analyze_concurrency
+from deeplearning4j_tpu.analysis.cost import (CostSpec, capacity, lint_cost,
+                                              memory_plan, plan, step_time)
 from deeplearning4j_tpu.analysis.churn import (RecompileChurnDetector,
                                                array_fingerprint,
                                                get_churn_detector)
@@ -88,7 +104,8 @@ from deeplearning4j_tpu.analysis.diagnostics import (DIAGNOSTIC_CODES,
                                                      Severity,
                                                      ValidationReport,
                                                      normalize_code)
-from deeplearning4j_tpu.analysis.distribution import MeshSpec, PipelineSpec
+from deeplearning4j_tpu.analysis.distribution import (MeshSpec, PipelineSpec,
+                                                      StageProfile)
 from deeplearning4j_tpu.analysis.graphir import (GraphIR, from_multilayer,
                                                  from_samediff,
                                                  lint_ir_distribution,
@@ -110,7 +127,10 @@ __all__ = [
     "analyze", "analyze_concurrency", "analyze_samediff", "Diagnostic",
     "Severity",
     "ValidationReport", "ModelValidationError", "DIAGNOSTIC_CODES",
-    "MeshSpec", "PipelineSpec", "InputPipelineSpec", "lint_input_pipeline",
+    "MeshSpec", "PipelineSpec", "StageProfile", "InputPipelineSpec",
+    "lint_input_pipeline",
+    "ChipSpec", "CHIP_REGISTRY", "CostSpec", "memory_plan", "step_time",
+    "capacity", "lint_cost", "plan",
     "DataRangeSpec", "lint_numerics",
     "normalize_code", "RecompileChurnDetector",
     "get_churn_detector", "array_fingerprint", "lint_serving",

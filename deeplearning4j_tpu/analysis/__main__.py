@@ -8,6 +8,8 @@ Usage::
     python -m deeplearning4j_tpu.analysis my.module:build  # one attribute
     python -m deeplearning4j_tpu.analysis --samediff my.module:sd
     python -m deeplearning4j_tpu.analysis --onnx model.onnx
+    python -m deeplearning4j_tpu.analysis --zoo --mesh data=8 --cost \\
+        --chip tpu-v4                      # E12x/W12x cost model
 
 A module target is scanned for ZooModel subclasses, configurations, and
 networks; a ``module:attr`` target names one such object (callables are
@@ -160,6 +162,29 @@ def main(argv=None) -> int:
                          "host-feed-this-chip check, e.g. 'workers=8,"
                          "batch=256,decode_ms=1.3,h2d_mbps=6.2,hw=224"
                          "[,dtype=uint8][,mfu=0.3][,device_img_s=2184]'")
+    ap.add_argument("--cost", action="store_true",
+                    help="run the E12x/W12x whole-program cost model: "
+                         "liveness-based step-peak HBM plan, roofline "
+                         "step-time/MFU estimate, capacity planner "
+                         "(default chip tpu-v4; supersedes the params-"
+                         "only E104/W109 heuristics)")
+    ap.add_argument("--chip", default=None, metavar="NAME",
+                    help="chip to cost against (tpu-v3, tpu-v4, tpu-v5e, "
+                         "cpu) — implies --cost")
+    ap.add_argument("--qps", type=float, default=None,
+                    help="target aggregate serving QPS for the E122 "
+                         "capacity check — implies --cost")
+    ap.add_argument("--p99-ms", type=float, default=None,
+                    help="target p99 latency budget in ms for E122 — "
+                         "implies --cost")
+    ap.add_argument("--profile", default=None, metavar="PATH",
+                    help="measured per-stage device-time profile (JSON "
+                         "from profiler/devicetime.py) — W105 stage "
+                         "imbalance is judged on measured time instead "
+                         "of the FLOP model (needs --mesh)")
+    ap.add_argument("--stages", type=int, default=None, metavar="N",
+                    help="declare an N-stage pipeline split for the "
+                         "per-stage lints (needs --mesh)")
     ap.add_argument("--suppress", action="append", default=[],
                     metavar="CODES",
                     help="suppress diagnostic codes (comma-separated or "
@@ -194,6 +219,26 @@ def main(argv=None) -> int:
         ap.error("--hbm-gb needs a mesh declaration: pass --mesh as well")
     if args.zero and not args.mesh:
         ap.error("--zero needs a mesh declaration: pass --mesh as well")
+    if args.profile and not args.mesh:
+        ap.error("--profile needs a mesh declaration: pass --mesh as well")
+    if args.stages is not None and not args.mesh:
+        ap.error("--stages needs a mesh declaration: pass --mesh as well")
+    cost_spec = None
+    if args.cost or args.chip or args.qps is not None \
+            or args.p99_ms is not None:
+        from deeplearning4j_tpu.analysis.cost import CostSpec
+        try:
+            cost_spec = CostSpec(chip=args.chip or "tpu-v4", qps=args.qps,
+                                 p99_ms=args.p99_ms)
+        except ValueError as e:
+            ap.error(f"--chip: {e}")
+    profile_spec = None
+    if args.profile:
+        from deeplearning4j_tpu.analysis.distribution import StageProfile
+        try:
+            profile_spec = StageProfile.coerce(args.profile)
+        except (OSError, ValueError) as e:
+            ap.error(f"--profile: {e}")
     policy_spec = None
     if args.policy:
         from deeplearning4j_tpu.nn.precision import PrecisionPolicy
@@ -283,10 +328,12 @@ def main(argv=None) -> int:
         else:                                                # the report
             report = analyze(obj, batch_size=args.batch_size,
                              data_devices=args.devices, mesh=args.mesh,
+                             pipeline=args.stages,
                              hbm_gb=args.hbm_gb,
                              zero=True if args.zero else None,
                              input_pipeline=pipeline_spec,
                              policy=policy_spec, data_range=range_spec,
+                             cost=cost_spec, profile=profile_spec,
                              suppress=suppress,
                              severity_overrides=overrides)
         report.subject = name

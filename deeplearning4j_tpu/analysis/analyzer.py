@@ -38,6 +38,7 @@ def analyze(target, batch_size: Optional[int] = None,
             data_devices: Optional[int] = None, mesh=None, sharding=None,
             pipeline=None, hbm_gb: Optional[float] = None, zero=None,
             input_pipeline=None, policy=None, data_range=None,
+            cost=None, profile=None,
             suppress=None, severity_overrides=None) -> ValidationReport:
     """Analyze a configuration, builder, network, or SameDiff graph.
 
@@ -64,11 +65,23 @@ def analyze(target, batch_size: Optional[int] = None,
     numerics lints — with neither, the pass still runs under the policy
     implied by the config's ``dataType`` (or the network's attached
     ``setPrecisionPolicy``).
+    ``cost`` (a :class:`~deeplearning4j_tpu.analysis.cost.CostSpec`,
+    ``True``, a chip name like ``"tpu-v4"``, or a dict) switches on the
+    E12x/W12x static cost-model lints: liveness-aware step-peak HBM,
+    roofline step-time/MFU, serving-bucket peak, and fleet capacity.
+    ``profile`` (a ``profiler.devicetime.DeviceTimeTable``, a list of
+    ``{"layer": ..., "device_ms": ...}`` rows, or a JSON trace path)
+    makes the W105 pipeline-balance lint judge on MEASURED per-stage
+    device time instead of the FLOP model (needs ``mesh=`` with a
+    pipeline declared).
     ``suppress``/``severity_overrides`` shape the report per code
     (:meth:`ValidationReport.apply_config`).
     """
     conf = getattr(target, "conf", target)
     mesh_spec = _mesh_spec(mesh, sharding, pipeline, hbm_gb, zero)
+    if profile is not None and mesh_spec is None:
+        raise ValueError("the measured-profile W105 lint (profile=) needs "
+                         "a mesh declaration — pass mesh=... as well")
     if hasattr(conf, "_nodes") and hasattr(conf, "_placeholders"):
         if input_pipeline is not None:
             raise ValueError(
@@ -77,12 +90,14 @@ def analyze(target, batch_size: Optional[int] = None,
         from deeplearning4j_tpu.analysis.samediff import analyze_samediff
         report = analyze_samediff(conf, batch_size=batch_size or 1)
         report.extend(_samediff_lints(conf, batch_size, data_devices,
-                                      mesh_spec, policy, data_range))
+                                      mesh_spec, policy, data_range,
+                                      profile=profile))
     elif hasattr(conf, "graph_inputs") and hasattr(conf, "nodes"):
-        report = _analyze_graph(conf, batch_size, data_devices, mesh_spec)
+        report = _analyze_graph(conf, batch_size, data_devices, mesh_spec,
+                                profile=profile)
     elif hasattr(conf, "layers") and hasattr(conf, "base"):
         report = _analyze_multilayer(conf, batch_size, data_devices,
-                                     mesh_spec)
+                                     mesh_spec, profile=profile)
     else:
         raise TypeError(f"cannot analyze {type(target).__name__}: expected a "
                         "MultiLayerConfiguration, ComputationGraph"
@@ -95,6 +110,18 @@ def analyze(target, batch_size: Optional[int] = None,
         report.extend(_numerics.lint_numerics(
             conf, policy=policy, data_range=data_range,
             model=target if target is not conf else None))
+    if cost is not None:
+        from deeplearning4j_tpu.analysis import cost as _cost
+        report.extend(_cost.lint_cost(conf, cost, mesh=mesh_spec,
+                                      batch_size=batch_size, policy=policy))
+        # The liveness plan counts params + grads + masters + updater
+        # state exactly (ZeRO-aware) against the DECLARED chip's HBM, so
+        # the params-only-era heuristics are subsumed: E104's budget
+        # check and W109's replicated-state advice would double-report
+        # (against a different, default budget) what E120 already
+        # decides — its message names updater state when it dominates.
+        report.diagnostics = [d for d in report.diagnostics
+                              if d.code not in ("DL4J-E104", "DL4J-W109")]
     if target is not conf:                       # a network: add model-level
         report.extend(_model_checks(target))
     for holder in (target, conf):       # importer-attached findings (E16x)
@@ -106,7 +133,7 @@ def analyze(target, batch_size: Optional[int] = None,
 
 
 def _samediff_lints(sd, batch_size, data_devices, mesh_spec, policy,
-                    data_range) -> List[Diagnostic]:
+                    data_range, profile=None) -> List[Diagnostic]:
     """Full lint parity for recorded graphs: lower the SameDiff to the
     analysis IR (:mod:`~deeplearning4j_tpu.analysis.graphir`) and run the
     same layout/distribution/numerics families native configs get, plus
@@ -119,7 +146,8 @@ def _samediff_lints(sd, batch_size, data_devices, mesh_spec, policy,
         ir, batch_size,
         data_devices if mesh_spec is None else None))
     if mesh_spec is not None:
-        diags.extend(_gir.lint_ir_distribution(ir, mesh_spec, batch_size))
+        diags.extend(_gir.lint_ir_distribution(ir, mesh_spec, batch_size,
+                                               profile=profile))
     diags.extend(_gir.lint_ir_numerics(ir, policy=policy,
                                        data_range=data_range))
     diags.extend(_imports.lint_frozen_constants(sd))
@@ -192,7 +220,8 @@ def _layer_loc(i: int, layer) -> str:
 
 
 def _analyze_multilayer(conf, batch_size, data_devices,
-                        mesh: Optional[MeshSpec] = None) -> ValidationReport:
+                        mesh: Optional[MeshSpec] = None,
+                        profile=None) -> ValidationReport:
     report = ValidationReport(subject="MultiLayerConfiguration")
     layers = list(conf.layers)
     preprocessors = dict(getattr(conf, "preprocessors", {}) or {})
@@ -235,7 +264,8 @@ def _analyze_multilayer(conf, batch_size, data_devices,
     report.extend(_layout.lint_dtype(
         getattr(conf.base, "dtype", None)))
     if mesh is not None:
-        report.extend(_dist.lint_multilayer(conf, mesh, batch_size))
+        report.extend(_dist.lint_multilayer(conf, mesh, batch_size,
+                                            profile=profile))
     else:
         report.extend(_layout.lint_batch_mesh(batch_size, data_devices))
     return report
@@ -411,7 +441,8 @@ def _node_loc(node) -> str:
 
 
 def _analyze_graph(conf, batch_size, data_devices,
-                   mesh: Optional[MeshSpec] = None) -> ValidationReport:
+                   mesh: Optional[MeshSpec] = None,
+                   profile=None) -> ValidationReport:
     report = ValidationReport(subject="ComputationGraphConfiguration")
     nodes = list(conf.nodes)
     inputs = list(conf.graph_inputs)
@@ -481,7 +512,8 @@ def _analyze_graph(conf, batch_size, data_devices,
                                           compute_layout=layout_fmt))
     report.extend(_layout.lint_dtype(getattr(conf.base, "dtype", None)))
     if mesh is not None:
-        report.extend(_dist.lint_graph(conf, mesh, batch_size))
+        report.extend(_dist.lint_graph(conf, mesh, batch_size,
+                                       profile=profile))
     else:
         report.extend(_layout.lint_batch_mesh(batch_size, data_devices))
     return report

@@ -1,0 +1,97 @@
+"""Chip capability registry for the static cost model (jax-free).
+
+A :class:`ChipSpec` is the hardware half of the cost model's inputs: the
+peak matmul throughput, HBM capacity and bandwidth, and interconnect
+bandwidth that :mod:`analysis.cost` roofs its predictions against.  The
+registry carries the published numbers for the TPU generations the repo
+targets plus a deliberately small ``cpu`` entry for tests; everything is
+plain Python so the module imports (and lints) with jax blocked.
+
+Numbers are per-chip (not per-board) and intentionally round — the cost
+model is a planning oracle, not a benchmark.  ``peak_flops`` is the
+bf16/low-precision MXU peak; :meth:`ChipSpec.peak_for` halves it for
+fp32 compute, matching how the MXU is scheduled.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Dict, Union
+
+
+@dataclass(frozen=True)
+class ChipSpec:
+    """Per-chip hardware capabilities used by the roofline/liveness model.
+
+    :param name: registry key (``"tpu-v4"``) or a free-form label for
+        custom specs.
+    :param peak_flops: bf16 matmul peak, FLOP/s per chip.
+    :param hbm_gb: HBM capacity per chip in GiB.
+    :param hbm_gbps: HBM bandwidth, GB/s per chip.
+    :param ici_gbps: inter-chip interconnect bandwidth, GB/s per chip
+        (the divisor for gradient-collective bytes).
+    :param host_gbps: host <-> chip (PCIe/DCN) bandwidth, GB/s — used
+        for prefetch/staging feasibility, not the step-time roofline.
+    """
+
+    name: str
+    peak_flops: float
+    hbm_gb: float
+    hbm_gbps: float
+    ici_gbps: float
+    host_gbps: float = 16.0
+
+    def peak_for(self, dtype: str = "bf16") -> float:
+        """MXU peak for a compute dtype: fp32 runs at half the bf16 rate."""
+        d = (dtype or "bf16").lower()
+        if d in ("float32", "fp32", "f32"):
+            return self.peak_flops / 2.0
+        return self.peak_flops
+
+    @property
+    def hbm_bytes(self) -> float:
+        return self.hbm_gb * (1 << 30)
+
+    def with_hbm_gb(self, hbm_gb: float) -> "ChipSpec":
+        return replace(self, hbm_gb=hbm_gb)
+
+    @classmethod
+    def coerce(cls, obj: Union["ChipSpec", str, Dict, None],
+               default: str = "tpu-v4") -> "ChipSpec":
+        """Accept a ChipSpec, a registry name, a dict of fields, or None
+        (-> the default chip).  Unknown names raise with the known list.
+        """
+        if obj is None:
+            return CHIP_REGISTRY[default]
+        if isinstance(obj, cls):
+            return obj
+        if isinstance(obj, str):
+            key = obj.lower()
+            if key not in CHIP_REGISTRY:
+                raise ValueError(
+                    "unknown chip %r — known chips: %s"
+                    % (obj, ", ".join(sorted(CHIP_REGISTRY))))
+            return CHIP_REGISTRY[key]
+        if isinstance(obj, dict):
+            d = dict(obj)
+            d.setdefault("name", "custom")
+            return cls(**d)
+        raise TypeError("cannot coerce %r to a ChipSpec" % (obj,))
+
+
+#: Published per-chip numbers (bf16 peak / HBM GiB / HBM GB/s / ICI GB/s).
+CHIP_REGISTRY: Dict[str, ChipSpec] = {
+    "tpu-v3": ChipSpec("tpu-v3", peak_flops=123e12, hbm_gb=16.0,
+                       hbm_gbps=900.0, ici_gbps=100.0),
+    "tpu-v4": ChipSpec("tpu-v4", peak_flops=275e12, hbm_gb=32.0,
+                       hbm_gbps=1228.0, ici_gbps=300.0),
+    "tpu-v5e": ChipSpec("tpu-v5e", peak_flops=197e12, hbm_gb=16.0,
+                        hbm_gbps=819.0, ici_gbps=200.0),
+    # Test/dev stand-in: small enough that fixtures can overflow it.
+    "cpu": ChipSpec("cpu", peak_flops=0.5e12, hbm_gb=4.0,
+                    hbm_gbps=50.0, ici_gbps=10.0, host_gbps=8.0),
+}
+
+
+def chip_names() -> tuple:
+    return tuple(sorted(CHIP_REGISTRY))

@@ -118,6 +118,38 @@ DIAGNOSTIC_CODES = {
                  "directory is set (or the directory is unwritable), so "
                  "every fresh process, rollout, and hot-swap staging pays "
                  "full XLA compile instead of a disk hit",
+    # E12x/W12x static cost-model lints (analysis/cost.py): liveness-aware
+    # HBM planning, roofline step-time/MFU prediction, fleet capacity.
+    "DL4J-E120": "training step-peak HBM overflow: the liveness-aware "
+                 "high-water mark (params + grads + fp32 masters + updater "
+                 "state + live backward activations + megastep staging + "
+                 "prefetch) exceeds the chip's per-device HBM — the "
+                 "message names the dominating liveness component, which "
+                 "params-only accounting (E104) would have missed",
+    "DL4J-E121": "serving-bucket peak HBM overflow: replicated params plus "
+                 "the largest bucket's liveness-aware activation peak "
+                 "exceed the chip's per-device HBM at peak coalesced load",
+    "DL4J-E122": "fleet capacity shortfall: at the predicted per-replica "
+                 "throughput the declared replica count cannot sustain the "
+                 "declared QPS (or the predicted per-request latency "
+                 "already exceeds the p99 budget on an idle replica) — the "
+                 "message names the minimal replica count that can",
+    "DL4J-W120": "rematerialization opportunity: live backward activations "
+                 "dominate the step-peak HBM high-water mark and the peak "
+                 "sits near the chip's budget — recomputing activations "
+                 "in the backward pass trades cheap FLOPs for the "
+                 "dominating memory term",
+    "DL4J-W121": "comms-bound step: predicted gradient-collective time "
+                 "over the declared ICI bandwidth exceeds half the "
+                 "predicted step time, so scaling the data axis further "
+                 "buys little — larger per-device batch, gradient "
+                 "accumulation, or precision-reduced collectives move the "
+                 "roofline",
+    "DL4J-W122": "predicted MFU below target: the roofline step-time "
+                 "estimate puts model FLOP utilization under the declared "
+                 "mfu_target on the declared chip — the message names the "
+                 "binding resource (compute, HBM bandwidth, or "
+                 "collectives)",
     # E2xx/W21x concurrency lints (analysis/concurrency.py): AST-level
     # thread-safety analysis of the framework's own (or user) source.
     "DL4J-E201": "unguarded cross-thread mutation: an attribute (or a "

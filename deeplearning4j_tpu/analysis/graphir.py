@@ -572,6 +572,19 @@ def from_samediff(sd, batch_size: int = 1) -> GraphIR:
     return ir
 
 
+def _type_shape(it, batch_size: int) -> Shape:
+    """``(batch,) + positive declared dims`` from an InputType, None when
+    the type (or any dim) is unknown — the activation-byte fact the cost
+    model's liveness pass reads."""
+    if it is None:
+        return None
+    dims = [int(v) for v in getattr(it, "dims", {}).values()
+            if isinstance(v, (int, float)) and v > 0]
+    if not dims:
+        return None
+    return (int(batch_size),) + tuple(dims)
+
+
 def from_multilayer(conf, batch_size: int = 1) -> GraphIR:
     """Lower a native sequential config to the same facts — the parity
     adapter: param names/shapes match ``distribution._param_facts`` and
@@ -586,14 +599,17 @@ def from_multilayer(conf, batch_size: int = 1) -> GraphIR:
     prev_out = "input"
     it0 = getattr(conf, "input_type", None)
     ir.tensors["input"] = TensorFact(
-        "input",
-        (batch_size,) + tuple(
-            int(v) for v in getattr(it0, "dims", {}).values()
-            if isinstance(v, (int, float)) and v > 0)
-        if it0 is not None else None,
-        dt, "placeholder")
+        "input", _type_shape(it0, batch_size), dt, "placeholder")
+    seen_names: Dict[str, int] = {}
     for idx, layer in enumerate(getattr(conf, "layers", ()) or ()):
         lname = getattr(layer, "name", None) or type(layer).__name__
+        # repeated default-named layers must not collide in the tensor
+        # dict (the liveness/byte accounting would silently drop them) —
+        # disambiguate with the layer index, matching nothing less
+        # specific than the class-name prefix sharding regexes target
+        if lname in seen_names:
+            lname = f"{lname}_{idx}"
+        seen_names[lname] = idx
         shapes = getattr(layer, "param_shapes", lambda: {})()
         pnames = []
         for pname, shape in (shapes or {}).items():
@@ -607,14 +623,75 @@ def from_multilayer(conf, batch_size: int = 1) -> GraphIR:
             pnames.append(full)
         out_name = f"{lname}:act"
         it, out_it = types[idx]
-        ir.tensors[out_name] = TensorFact(out_name, None, dt, "activation",
-                                          producer=idx)
+        ir.tensors[out_name] = TensorFact(out_name,
+                                          _type_shape(out_it, batch_size),
+                                          dt, "activation", producer=idx)
         ir.tensors[prev_out].consumers.append(idx)
         ir.ops.append(OpFact(
             idx, type(layer).__name__, lname,
             tuple([prev_out] + pnames), (out_name,), {},
             flops=_dist._approx_flops(layer, it, out_it)))
         prev_out = out_name
+    return ir
+
+
+def from_graph(conf, batch_size: int = 1) -> GraphIR:
+    """Lower a ComputationGraphConfiguration to the IR — layer nodes AND
+    vertices become ops in topological order, so the cost model's
+    liveness pass sees the same producer/consumer edges the sequential
+    lowering gives (vertices carry no params and zero FLOPs; their
+    output shapes stay unknown and the liveness pass degrades to the
+    layer-activation facts)."""
+    ir = GraphIR(subject="ComputationGraphConfiguration",
+                 batch_size=batch_size)
+    base = getattr(conf, "base", None)
+    ir.updater = getattr(base, "updater", None)
+    dtype = getattr(base, "dtype", None)
+    dt = str(dtype) if dtype is not None else "float32"
+    input_types = dict(getattr(conf, "input_types", {}) or {})
+    for gi in getattr(conf, "graph_inputs", ()) or ():
+        ir.tensors[gi] = TensorFact(
+            gi, _type_shape(input_types.get(gi), batch_size), dt,
+            "placeholder")
+    types = _dist._propagate_graph_types(conf)
+    nodes = _dist._graph_order_all(conf, list(getattr(conf, "nodes", ())))
+    act_of = {}                      # node name -> its activation tensor
+    for idx, n in enumerate(nodes):
+        in_refs = []
+        for r in n.inputs:
+            ref = r if r in ir.tensors and r not in act_of else \
+                act_of.get(r, f"{r}:act")
+            in_refs.append(ref)
+            t = ir.tensors.get(ref)
+            if t is not None:
+                t.consumers.append(idx)
+        pnames = []
+        flops = 0
+        if getattr(n, "kind", None) == "layer":
+            lname = getattr(n, "name", None) or type(n.obj).__name__
+            for pname, shape in (getattr(n.obj, "param_shapes",
+                                         lambda: {})() or {}).items():
+                if not shape or any(not d or d < 0 for d in shape):
+                    continue
+                full = f"{lname}/{pname}"
+                t = TensorFact(full, tuple(int(d) for d in shape), dt,
+                               "param")
+                t.weight_of = idx
+                t.consumers.append(idx)
+                ir.tensors[full] = t
+                pnames.append(full)
+            it, out_it = types.get(n.name, (None, None))
+            flops = _dist._approx_flops(n.obj, it, out_it)
+        else:
+            out_it = None
+        out_name = f"{n.name}:act"
+        ir.tensors[out_name] = TensorFact(out_name,
+                                          _type_shape(out_it, batch_size),
+                                          dt, "activation", producer=idx)
+        act_of[n.name] = out_name
+        ir.ops.append(OpFact(
+            idx, type(n.obj).__name__, n.name,
+            tuple(in_refs + pnames), (out_name,), {}, flops=flops))
     return ir
 
 
@@ -715,8 +792,8 @@ def _dominant_param_dtype(ir: GraphIR) -> Optional[str]:
     return max(counts.items(), key=lambda kv: kv[1])[0]
 
 
-def lint_ir_distribution(ir: GraphIR, mesh,
-                         batch_size: Optional[int]) -> List[Diagnostic]:
+def lint_ir_distribution(ir: GraphIR, mesh, batch_size: Optional[int],
+                         profile=None) -> List[Diagnostic]:
     """E101/E102/E104/W104–W107 (+E103/W105 under a declared pipeline)
     over IR param facts — the codes native configs get from
     ``distribution.lint_multilayer``, driven by the same machinery."""
@@ -724,7 +801,7 @@ def lint_ir_distribution(ir: GraphIR, mesh,
     diags = _dist.lint_entries(entries, mesh, batch_size,
                                _dominant_param_dtype(ir),
                                updater=ir.updater)
-    diags.extend(_dist._lint_pipeline(entries, mesh))
+    diags.extend(_dist._lint_pipeline(entries, mesh, profile=profile))
     return diags
 
 

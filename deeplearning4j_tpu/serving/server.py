@@ -685,7 +685,7 @@ class ModelServer:
 
     # ------------------------------------------------------------- warmup
     def warmup(self, shapes: Iterable[Sequence[int]],
-               strict: bool = False) -> "ModelServer":
+               strict: bool = False, cost=None) -> "ModelServer":
         """AOT-compile every bucket x feature shape on the serving mesh
         BEFORE taking traffic: ``shapes`` is an iterable of per-request
         feature shapes WITHOUT the leading batch dim (e.g. ``[(4,)]``
@@ -693,12 +693,18 @@ class ModelServer:
         (``strict=True`` raises on E-codes, else warnings), then flips
         ``ready`` true. Each compile registers its signature with the
         W201 churn detector; :meth:`recompiles_after_warmup` measures
-        steady-state compiles against this baseline."""
+        steady-state compiles against this baseline.
+
+        ``cost`` (a :class:`~deeplearning4j_tpu.analysis.cost.CostSpec`,
+        chip name, or dict) additionally runs the E121/E122 cost-model
+        serving checks against this server's bucket ladder — with
+        ``strict=True`` a predicted bucket-peak overflow or capacity
+        shortfall refuses to warm."""
         shapes = [tuple(int(d) for d in s) for s in shapes]
         # check_cache: warmup is the moment the cold-start bill lands, so
         # DL4J-W112 (no/unwritable persistent compile cache — every
         # rollout pays full compile) fires here, not on static validate()
-        report = self.validate(shapes=shapes, check_cache=True)
+        report = self.validate(shapes=shapes, check_cache=True, cost=cost)
         if strict:
             report.raise_if_errors()
         for d in report.diagnostics:
@@ -739,11 +745,15 @@ class ModelServer:
         return self._churn.signature_count("serving:forward",
                                            owner=self) - self._warm_sig_count
 
-    def validate(self, shapes=None, hbm_gb=None, check_cache: bool = False):
+    def validate(self, shapes=None, hbm_gb=None, check_cache: bool = False,
+                 cost=None):
         """Static serving-config lint: buckets x mesh x HBM (analysis.
         serving) plus any W201 churn findings recorded for this server.
         ``check_cache=True`` (what ``warmup`` passes) adds the DL4J-W112
-        persistent-compile-cache check."""
+        persistent-compile-cache check. ``cost`` (CostSpec / chip name /
+        dict) adds the liveness-based E121 bucket-peak and E122 capacity
+        checks over THIS server's bucket ladder and mesh — declare
+        ``qps=``/``p99_ms=`` on the CostSpec to size the fleet."""
         from deeplearning4j_tpu.analysis.serving import lint_serving
         report = lint_serving(self.model, self.buckets(), mesh=self.mesh,
                               shapes=shapes, hbm_gb=hbm_gb,
@@ -754,6 +764,21 @@ class ModelServer:
         if sd is not None:      # samediff_forward stamp: run the full
             from deeplearning4j_tpu.analysis import analyze   # graph lints
             report.extend(analyze(sd).diagnostics)
+        if cost is not None:
+            from deeplearning4j_tpu.analysis import cost as _cost
+            spec = _cost.CostSpec.coerce(cost) or _cost.CostSpec()
+            spec = _cost.CostSpec(
+                chip=spec.chip, qps=spec.qps, p99_ms=spec.p99_ms,
+                replicas=spec.replicas, mfu_target=spec.mfu_target,
+                buckets=spec.buckets or tuple(self.buckets()),
+                steps_per_dispatch=spec.steps_per_dispatch,
+                prefetch=spec.prefetch, precision=spec.precision)
+            # serving surface: only the serving-relevant codes — the
+            # training-step E120/W120/W121 family belongs to fit-side
+            # validate(), not a replica's bucket ladder
+            report.extend(d for d in _cost.lint_cost(
+                self.model, spec, mesh=self.mesh)
+                if d.code in ("DL4J-E121", "DL4J-E122"))
         return report
 
     # ------------------------------------------------------- health surface

@@ -10,7 +10,11 @@ The three pieces (ISSUE 17):
 - :mod:`~deeplearning4j_tpu.tune.driver` — :func:`tune` searches the
   space on live hardware (random + successive halving + offender-seeded
   greedy refinement; min-of-reps trials through ``CachedDispatch``; a
-  loss-parity gate on the winner).
+  loss-parity gate on the winner; with ``cost_spec=`` the
+  :mod:`analysis.cost` model statically prunes dominated candidates —
+  predicted OOM or step time far beyond the default plan — before any
+  measurement is spent, recording each prune's reason on the
+  :class:`TuningReport`).
 - :mod:`~deeplearning4j_tpu.tune.records` — the persistent
   :class:`TuningRecord` store, keyed like the compile cache (model
   fingerprint x mesh x backend x jax version), consulted by
@@ -23,8 +27,8 @@ CLI: ``python -m deeplearning4j_tpu.tune <zoo-model> --budget N``.
 from deeplearning4j_tpu.tune.space import (AXES, K_CHOICES, TuningPlan,
                                            TuningSpace, axis_priority)
 from deeplearning4j_tpu.tune.driver import (Trial, TuneResult,
-                                            estimate_mfu, loss_parity,
-                                            tune)
+                                            TuningReport, estimate_mfu,
+                                            loss_parity, tune)
 from deeplearning4j_tpu.tune.records import (TuningRecord, auto_apply,
                                              best_plan, configure, lookup,
                                              mesh_signature, put,
@@ -33,7 +37,8 @@ from deeplearning4j_tpu.tune.records import (TuningRecord, auto_apply,
 
 __all__ = [
     "AXES", "K_CHOICES", "TuningPlan", "TuningSpace", "axis_priority",
-    "Trial", "TuneResult", "estimate_mfu", "loss_parity", "tune",
+    "Trial", "TuneResult", "TuningReport", "estimate_mfu", "loss_parity",
+    "tune",
     "TuningRecord", "auto_apply", "best_plan", "configure", "lookup",
     "mesh_signature", "put", "record_key", "reset_configuration",
 ]

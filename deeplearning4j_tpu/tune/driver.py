@@ -75,7 +75,8 @@ class TuneResult:
                  default_cost_s: float, trials: List[Trial],
                  record=None, model_fp: str = "",
                  rejected: Optional[List[tuple]] = None,
-                 mfu: Optional[float] = None):
+                 mfu: Optional[float] = None,
+                 pruned: Optional[List[tuple]] = None):
         self.best_plan = best_plan
         self.best_cost_s = float(best_cost_s)
         self.default_cost_s = float(default_cost_s)
@@ -84,6 +85,7 @@ class TuneResult:
         self.model_fp = model_fp
         self.rejected = rejected or []     # [(plan, reason)]
         self.mfu = mfu
+        self.pruned = pruned or []         # [(plan, reason)] — never measured
 
     @property
     def speedup(self) -> float:
@@ -103,6 +105,11 @@ class TuneResult:
             f"{self.speedup:.2f}x)")
         for plan, reason in self.rejected:
             lines.append(f"rejected: {plan.signature()} — {reason}")
+        if self.pruned:
+            lines.append(f"statically pruned (cost model, no measurement "
+                         f"spent): {len(self.pruned)} candidate(s)")
+            for plan, reason in self.pruned:
+                lines.append(f"pruned: {plan.signature()} — {reason}")
         return "\n".join(lines)
 
 
@@ -191,7 +198,8 @@ def tune(model_or_factory, features, labels, *, budget: int = 20,
          parity_steps: int = 6, parity_tol: float = PARITY_TOL,
          timings=None, peak_flops: Optional[float] = None,
          trial_fn: Optional[Callable[[TuningPlan], float]] = None,
-         parity_fn: Optional[Callable[[TuningPlan], bool]] = None
+         parity_fn: Optional[Callable[[TuningPlan], bool]] = None,
+         cost_spec=None, pruner=None, prune_bound: float = 3.0
          ) -> TuneResult:
     """Search ``space`` for the fastest plan on live hardware.
 
@@ -205,6 +213,16 @@ def tune(model_or_factory, features, labels, *, budget: int = 20,
     measurement / parity check — the mock-cost harness used by the
     planted-optimum tests, and the seam a future learned cost model
     plugs into.
+
+    ``cost_spec`` (a :class:`~deeplearning4j_tpu.analysis.cost.CostSpec`,
+    chip name, or dict) turns on STATIC PRUNING: before any non-default
+    candidate is measured, the analysis.cost model predicts its step
+    peak and step time — a candidate that OOMs the declared chip or
+    predicts slower than ``prune_bound`` x the default plan's prediction
+    is dropped without spending a measurement, recorded on
+    ``TuneResult.pruned`` with the reason.  ``pruner`` overrides the
+    auto-built one (any ``plan -> Optional[reason]`` callable).  The
+    incumbent default plan is never offered for pruning.
 
     The model the search measured is left with the WINNING plan applied.
     The winner is persisted to the record store (``persist=True``) under
@@ -226,6 +244,24 @@ def tune(model_or_factory, features, labels, *, budget: int = 20,
     latch = ErrorLatch()
     spent = [0]                    # measurements consumed against budget
 
+    default = space.default_plan()
+    pruned: List[tuple] = []       # [(plan, reason)] — dropped unmeasured
+    pruned_sigs: set = set()
+    if pruner is None and cost_spec is not None:
+        from deeplearning4j_tpu.analysis import cost as _cost
+        try:
+            pruner = _cost.plan_pruner(model, None if features is None
+                                       else getattr(features, "shape",
+                                                    (None,))[0],
+                                       cost_spec, mesh=mesh,
+                                       bound=prune_bound)
+        except Exception as e:     # an unlowerable harness object: search
+            warnings.warn(         # without pruning rather than die
+                f"tune: static pruning disabled — the cost model cannot "
+                f"lower this model ({type(e).__name__}: {e})",
+                stacklevel=2)
+            pruner = None
+
     def evaluate(plan: TuningPlan, phase: str, n_reps: int
                  ) -> Optional[Trial]:
         sig = plan.signature()
@@ -233,6 +269,21 @@ def tune(model_or_factory, features, labels, *, budget: int = 20,
             prev = book.get(sig)
             if prev is not None and prev.reps >= n_reps:
                 return prev        # already measured at >= this fidelity
+        # static domination check — BEFORE the measurement is spent; the
+        # default plan (the yardstick) is never offered for pruning
+        if pruner is not None and plan != default:
+            with book_lock:
+                if sig in pruned_sigs:
+                    return None
+            try:
+                reason = pruner(plan)
+            except Exception:      # a pruner bug must not cost coverage
+                reason = None
+            if reason is not None:
+                with book_lock:
+                    pruned_sigs.add(sig)
+                    pruned.append((plan, reason))
+                return None
         spent[0] += 1
         trials_counter.inc()
         try:
@@ -256,7 +307,6 @@ def tune(model_or_factory, features, labels, *, budget: int = 20,
         return t if t.ok else None
 
     # ---- baseline: the default plan is trial #0 and the yardstick
-    default = space.default_plan()
     base = evaluate(default, "default", reps)
     if base is None:
         # the DEFAULT plan failing is not a tuning result — re-raise
@@ -363,4 +413,9 @@ def tune(model_or_factory, features, labels, *, budget: int = 20,
             record = None
     return TuneResult(winner.plan, winner.cost_s, default_cost, log,
                       record=record, model_fp=fp, rejected=rejected,
-                      mfu=mfu)
+                      mfu=mfu, pruned=pruned)
+
+
+#: The tuning report type the serving/bench surfaces name — the search
+#: result IS the report (trials, rejections, static prunes, summary()).
+TuningReport = TuneResult

@@ -450,6 +450,47 @@ def run_imports(fixture_dir=None) -> int:
     return failed
 
 
+def run_cost(chip: str = "tpu-v4") -> int:
+    """Cost-model gate: every zoo architecture through the DL4J-E12x/W12x
+    whole-program cost lints on the default chip, warnings-as-errors — a
+    config change that statically OOMs (or regresses the predicted plan
+    on) the reference chip fails the gate before any hardware sees it.
+    Per-code suppressions live under ``[tool.dl4j.cost]``. Skips (0)
+    when the model stack cannot import (the gate needs the layer
+    definitions, not jax — analysis itself is jax-free)."""
+    sys.path.insert(0, str(REPO))
+    try:
+        from deeplearning4j_tpu.analysis import analyze
+        from deeplearning4j_tpu.analysis.cost import CostSpec
+        from deeplearning4j_tpu.models import zoo
+    except ImportError as e:
+        print(f"cost lint: model stack unavailable ({e}) — skipped")
+        return 0
+    finally:
+        sys.path.pop(0)
+    suppress = _pyproject_suppress("cost")
+    failed = checked = 0
+    for name, cls in zoo.ZOO_MODELS.items():
+        try:
+            report = analyze(cls().conf_builder(), cost=CostSpec(chip=chip),
+                             suppress=suppress)
+        except ValueError as e:
+            # a typo'd code in [tool.dl4j.cost] suppress must be a clean
+            # usage error, not a traceback
+            print(f"cost lint: bad suppress config in pyproject.toml: {e}")
+            return 1
+        report.diagnostics = [d for d in report.diagnostics
+                              if d.code.startswith(("DL4J-E12", "DL4J-W12"))]
+        checked += 1
+        if not report.ok(warnings_as_errors=True):
+            report.subject = name
+            print(report.format())
+            failed = 1
+    print(f"cost lint: {checked} zoo model(s) checked on {chip}"
+          + ("" if failed else " — clean"))
+    return failed
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("paths", nargs="*", default=None)
@@ -459,6 +500,8 @@ def main(argv=None) -> int:
                     help="skip the DL4J-E2xx/W21x thread-safety self-lint")
     ap.add_argument("--no-imports", action="store_true",
                     help="skip the DL4J-E16x/W16x imported-fixture gate")
+    ap.add_argument("--no-cost", action="store_true",
+                    help="skip the DL4J-E12x/W12x zoo cost-model gate")
     args = ap.parse_args(argv)
     paths = args.paths or DEFAULT_PATHS
     if not args.fallback and shutil.which("ruff"):
@@ -469,6 +512,8 @@ def main(argv=None) -> int:
         rc = run_concurrency() or rc
     if not args.no_imports:
         rc = run_imports() or rc
+    if not args.no_cost:
+        rc = run_cost() or rc
     return rc
 
 
