@@ -49,7 +49,12 @@ and loss parity (bf16) — into ``detail.device_timing``;
 observability-plane cost gate: tracecontext / flightrec / SLO-engine
 fit columns plus the serve-path always-on column, each asserted <5%
 over the all-off baseline (tracing-ON serve ratio report-only) — into
-``detail.obs_overhead``).
+``detail.obs_overhead``;
+``--lifecycle`` folds ``benchmarks/probe_lifecycle.py`` — the ISSUE-20
+continuous-training loop under live traffic: per-promote roll latency
+and per-candidate gate wall time from the driver's own histograms,
+with the zero-dropped-request and zero-steady-state-recompile pins
+asserted by the probe itself — into ``detail.lifecycle``).
 
 BENCH_r06 (ISSUE 14): the CNN rows measure the OPTIMIZED conv path —
 ``precision: "bf16"`` (explicit PrecisionPolicy), NHWC compute layout,
@@ -871,6 +876,16 @@ def bench_obs(quick: bool = False):
         timeout=900)
 
 
+def bench_lifecycle(quick: bool = False):
+    """Lifecycle-loop probe (benchmarks/probe_lifecycle.py): roll
+    latency + gate wall time for the continuous-training driver under
+    background traffic; the probe exits nonzero (surfacing here as an
+    ``error`` entry) unless dropped requests and steady-state
+    recompiles are both exactly zero."""
+    return _run_probe("probe_lifecycle.py",
+                      ["--quick"] if quick else [], timeout=900)
+
+
 def bench_dp_scaling_virtual():
     """GSPMD dp_scaling on the 8-virtual-device CPU mesh (ISSUE 15
     satellite — the row is no longer an empty dict). 1->2->4->8 data
@@ -1107,6 +1122,8 @@ def main(argv):
         detail["device_timing"] = bench_device_timing(quick)
     if "--obs" in argv:
         detail["obs_overhead"] = bench_obs(quick)
+    if "--lifecycle" in argv:
+        detail["lifecycle"] = bench_lifecycle(quick)
 
     print(json.dumps({
         "metric": "bert_base_seq128_train_samples_per_sec_per_chip",

@@ -1,0 +1,174 @@
+"""Probe: the lifecycle loop's serving-visible costs (ISSUE 20).
+
+Runs a real :class:`~deeplearning4j_tpu.lifecycle.driver.LifecycleDriver`
+— train -> eval gate -> canary -> promote — for a few rounds against a
+warmed :class:`~deeplearning4j_tpu.serving.registry.ModelRegistry` while
+a background client submits steadily, and reports what the closed loop
+costs the serve path:
+
+- **roll latency** — wall time of each promote (``registry.roll``: the
+  atomic swap plus the canary clear), mean and max, from the
+  ``dl4j_lifecycle_roll_seconds`` histogram;
+- **gate wall time** — per-candidate eval-gate cost
+  (``dl4j_lifecycle_gate_seconds``), the pre-serving work each round
+  pays before a candidate may load;
+- **dropped requests** — MUST be 0: every submit issued during the
+  storm of rolls either resolved exactly once or was shed with a
+  structured ``ServingError`` at admission. A request that vanished or
+  double-resolved FAILS the probe (exit 1).
+
+Prints ONE JSON line::
+
+  {"probe": "lifecycle", "rounds": ..., "promotions": ...,
+   "roll_ms": {"mean": ..., "max": ..., "n": ...},
+   "gate_ms": {"mean": ..., "max": ..., "n": ...},
+   "requests": ..., "shed": ..., "dropped_requests": 0,
+   "recompiles_after_warmup": 0}
+
+Run: python benchmarks/probe_lifecycle.py [--rounds N] [--quick]
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+# 8 virtual CPU devices, set before jax import (same contract as the
+# test suite's conftest)
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+NIN = 8
+
+
+def linear_model(delta):
+    rng = np.random.RandomState(0)
+    W = (rng.randn(NIN, 4).astype(np.float32)
+         + np.float32(delta))
+    return lambda x: np.asarray(x, np.float32) @ W
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rounds", type=int, default=4)
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+    rounds = 3 if args.quick else args.rounds
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from deeplearning4j_tpu import profiler as prof
+    from deeplearning4j_tpu.lifecycle import LifecycleDriver
+    from deeplearning4j_tpu.lifecycle.driver import (GATE_SECONDS,
+                                                     ROLL_SECONDS)
+    from deeplearning4j_tpu.serving import ServingError
+    from deeplearning4j_tpu.serving.registry import (ModelNotFoundError,
+                                                     ModelRegistry)
+
+    rng = np.random.RandomState(1)
+    eval_x = rng.randn(32, NIN).astype(np.float32)
+    state_dir = f"/tmp/dl4j_lifecycle_probe_{os.getpid()}"
+
+    stop = threading.Event()
+    handles, shed = [], [0]
+
+    reg = ModelRegistry(batch_limit=8, coalesce_ms=0.5)
+    try:
+        def traffic():
+            while not stop.is_set():
+                try:
+                    if reg.active_version("m") is not None:
+                        handles.append(reg.submit(
+                            "m", rng.randn(2, NIN).astype(np.float32)))
+                except ModelNotFoundError:
+                    pass
+                except ServingError:
+                    shed[0] += 1
+                time.sleep(0.005)
+
+        t = threading.Thread(target=traffic, daemon=True)
+        t.start()
+
+        gate0, roll0 = GATE_SECONDS.count, ROLL_SECONDS.count
+        gsum0, rsum0 = GATE_SECONDS.sum, ROLL_SECONDS.sum
+
+        import warnings
+        drv = LifecycleDriver(
+            reg, "m", lambda r: linear_model(0.001 * r), state_dir,
+            eval_x=eval_x, shapes=[(NIN,)], canary_fraction=0.25,
+            observe_ticks=2, confirm_ticks=1, tick_interval=0.02)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            summary = drv.run(rounds)
+        stop.set()
+        t.join(5.0)
+
+        # drain every outstanding handle; a structured serving error is
+        # a resolved outcome, silence is a drop
+        dropped = 0
+        for h in handles:
+            try:
+                h.get(15.0)
+            except ServingError:
+                pass
+            if h.resolutions != 1:
+                dropped += 1
+
+        # per-phase roll/gate cost from the driver's own histograms
+        gn, rn = GATE_SECONDS.count - gate0, ROLL_SECONDS.count - roll0
+        gs, rs = GATE_SECONDS.sum - gsum0, ROLL_SECONDS.sum - rsum0
+        roll_max = ROLL_SECONDS.quantile(1.0) or 0.0
+        gate_max = GATE_SECONDS.quantile(1.0) or 0.0
+
+        recompiles = sum(
+            reg.server("m", v).recompiles_after_warmup()
+            for v in reg.models()["m"]["versions"])
+
+        out = {
+            "probe": "lifecycle",
+            "n_devices": len(jax.devices()),
+            "rounds": summary["rounds"],
+            "promotions": summary["promotions"],
+            "rollbacks": summary["rollbacks"],
+            "roll_ms": {"mean": round(rs / rn * 1e3, 2) if rn else None,
+                        "max": round(roll_max * 1e3, 2), "n": rn},
+            "gate_ms": {"mean": round(gs / gn * 1e3, 2) if gn else None,
+                        "max": round(gate_max * 1e3, 2), "n": gn},
+            "requests": len(handles),
+            "shed": shed[0],
+            "dropped_requests": dropped,
+            "recompiles_after_warmup": recompiles,
+        }
+        print(json.dumps(out))
+        failed = False
+        if dropped != 0:
+            print(f"# FAIL: {dropped} request(s) dropped (resolved != 1) "
+                  "across the lifecycle rolls", file=sys.stderr)
+            failed = True
+        if recompiles != 0:
+            print(f"# FAIL: {recompiles} steady-state recompile(s) "
+                  "across the lifecycle's servers", file=sys.stderr)
+            failed = True
+        if summary["promotions"] < rounds:
+            print(f"# FAIL: only {summary['promotions']} of {rounds} "
+                  "clean rounds promoted", file=sys.stderr)
+            failed = True
+        if failed:
+            sys.exit(1)
+    finally:
+        stop.set()
+        reg.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -118,6 +118,16 @@ DIAGNOSTIC_CODES = {
                  "directory is set (or the directory is unwritable), so "
                  "every fresh process, rollout, and hot-swap staging pays "
                  "full XLA compile instead of a disk hit",
+    "DL4J-W113": "lifecycle observation window shorter than the SLO fast "
+                 "window: the canary judge's burn-rate lookback cannot "
+                 "contain even one fast-window reference sample, so every "
+                 "canary verdict reads a burn of ~0 and promotes blind",
+    "DL4J-W114": "canary fraction below routing resolution: fraction x "
+                 "expected-requests-per-tick rounds to zero canary-routed "
+                 "requests per observation tick (or the fraction is so "
+                 "small the smallest batch bucket never fills), so the "
+                 "observation window measures the incumbent, not the "
+                 "canary",
     # E12x/W12x static cost-model lints (analysis/cost.py): liveness-aware
     # HBM planning, roofline step-time/MFU prediction, fleet capacity.
     "DL4J-E120": "training step-peak HBM overflow: the liveness-aware "

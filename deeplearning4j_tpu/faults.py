@@ -77,6 +77,28 @@ Wire-level chaos (ISSUE 12 — the HTTP ingress front door):
   while a replay is in flight: the zero-drop hot-swap pin (every
   request resolves exactly once against exactly one version).
 
+Lifecycle fault kinds (ISSUE 20 — the continuous-training loop's
+chaos pins, consumed by :class:`~deeplearning4j_tpu.lifecycle.driver.
+LifecycleDriver`):
+
+- **Trainer death mid-roll** (``trainer_death_at_roll=k``) — as the
+  driver's k-th roll (1-based) is in flight (candidate staged and
+  canarying, not yet promoted), the trainer is killed: a subprocess
+  trainer gets a real SIGKILL, an in-process one unwinds through
+  :class:`~deeplearning4j_tpu.lifecycle.driver.TrainerKilledError`.
+  The registry must keep serving a consistent version and a new driver
+  over the same state dir must resume from its checkpointed state
+  machine.
+- **Bad candidate at round k** (``bad_candidate_at={k: "nan" |
+  "regressed"}``) — the k-th training round's candidate is poisoned:
+  ``"nan"`` makes its outputs non-finite, ``"regressed"`` inflates its
+  eval loss past the gate's parity bound. The eval gate must quarantine
+  it with a structured reason; it is never loaded.
+- **SLO regression during canary** (``slo_regression_during_canary=k``)
+  — the k-th roll's post-promote confirmation window reads as an SLO
+  regression; the driver must ``rollback()`` automatically
+  (bit-identical to the pre-roll incumbent).
+
 Race kinds (ISSUE 8 — the concurrency analyzer's dynamic layer,
 ``pytest -m races``):
 
@@ -152,7 +174,10 @@ class FaultPlan:
                  serve_fail_at: Iterable[int] = (),
                  serve_device_loss_at_batch: Optional[int] = None,
                  nan_layer_params_at: Optional[dict] = None,
-                 coord_peer_death: Optional[dict] = None):
+                 coord_peer_death: Optional[dict] = None,
+                 trainer_death_at_roll: Optional[int] = None,
+                 bad_candidate_at: Optional[dict] = None,
+                 slo_regression_during_canary: Optional[int] = None):
         self.seed = seed
         self.nan_grads_at = _as_step_set(nan_grads_at)
         self.data_error_at = _as_step_set(data_error_at)
@@ -183,6 +208,19 @@ class FaultPlan:
         #: deterministically for every waiter in that round.
         self.coord_peer_death = dict(coord_peer_death) \
             if coord_peer_death else None
+        #: lifecycle kinds (ISSUE 20): 1-based roll index at which the
+        #: trainer dies mid-roll; {round: "nan"|"regressed"} candidate
+        #: poisons; 1-based roll index whose post-promote confirmation
+        #: reads as an SLO regression
+        self.trainer_death_at_roll = trainer_death_at_roll
+        self.bad_candidate_at = {int(k): str(v) for k, v in
+                                 (bad_candidate_at or {}).items()}
+        for k, v in self.bad_candidate_at.items():
+            if v not in ("nan", "regressed"):
+                raise ValueError(
+                    f"bad_candidate_at[{k}]={v!r}: kind must be "
+                    "'nan' or 'regressed'")
+        self.slo_regression_during_canary = slo_regression_during_canary
         # consumed-state: each fault fires once
         self._nan_pending = set(self.nan_grads_at)
         self._data_pending = set(self.data_error_at)
@@ -193,6 +231,10 @@ class FaultPlan:
         self._serve_fail_pending = set(self.serve_fail_at)
         self._serve_loss_active = False
         self._layer_poison_pending = set(self.nan_layer_params_at)
+        self._trainer_death_pending = trainer_death_at_roll is not None
+        self._bad_candidate_pending = set(self.bad_candidate_at)
+        self._slo_regression_pending = \
+            slo_regression_during_canary is not None
         self._hang_release = threading.Event()
         self._pull_index = 0
 
@@ -271,6 +313,39 @@ class FaultPlan:
                    slow_replica_at=slow_at, slow_seconds=slow_seconds,
                    hung_dispatch_at=hang_at, hang_seconds=hang_seconds,
                    serve_device_loss_at_batch=loss_at, lose_devices=lose)
+
+    @classmethod
+    def seeded_lifecycle(cls, seed: int, rounds: int, n_bad: int = 1,
+                         bad_kind: Optional[str] = None,
+                         trainer_death: bool = False,
+                         slo_regression: bool = False) -> "FaultPlan":
+        """A lifecycle plan from one seed: fault *round indices* are
+        drawn without replacement from ``[2, rounds]`` (round 1 is left
+        clean so every storm promotes at least one good candidate
+        first). ``n_bad`` poisons that many candidates (``bad_kind``
+        fixes the kind; default alternates nan/regressed per draw),
+        ``trainer_death`` SIGKILLs the trainer mid-roll at a drawn roll
+        index, and ``slo_regression`` plants one genuine SLO regression
+        in a drawn roll's confirmation window. The chaos storm
+        (``pytest -m chaos``) sweeps this across seeds."""
+        rng = np.random.RandomState(seed)
+        n_faults = n_bad + (1 if trainer_death else 0) \
+            + (1 if slo_regression else 0)
+        lo = 2
+        pool = rng.permutation(np.arange(lo, max(rounds + 1, lo + n_faults)))
+        picks = [int(p) for p in pool[:n_faults]]
+        kinds = ("nan", "regressed")
+        bad = {picks[i]: (bad_kind if bad_kind is not None
+                          else kinds[i % 2]) for i in range(n_bad)}
+        pos = n_bad
+        death = None
+        if trainer_death:
+            death = picks[pos]
+            pos += 1
+        regression = picks[pos] if slo_regression else None
+        return cls(seed=seed, bad_candidate_at=bad,
+                   trainer_death_at_roll=death,
+                   slo_regression_during_canary=regression)
 
     # ----------------------------------------------------------- data seams
     def wrap_iterator(self, iterator: DataSetIterator) -> DataSetIterator:
@@ -435,6 +510,41 @@ class FaultPlan:
         watchdog abandoned that never reaches the device."""
         self._hang_release.set()
 
+    # ------------------------------------------------------ lifecycle seams
+    def trainer_dies_at_roll(self, roll_index: int) -> bool:
+        """True exactly once, when the driver's ``roll_index``-th roll
+        (1-based) is the planned trainer-death point — the driver kills
+        its trainer (SIGKILL for a subprocess) and unwinds; a later
+        driver over the same state dir must resume."""
+        if self._trainer_death_pending \
+                and self.trainer_death_at_roll is not None \
+                and int(roll_index) >= int(self.trainer_death_at_roll):
+            self._trainer_death_pending = False
+            return True
+        return False
+
+    def candidate_fault(self, round_index: int) -> Optional[str]:
+        """The planned candidate poison for training round
+        ``round_index`` (1-based): ``"nan"`` (non-finite outputs),
+        ``"regressed"`` (eval loss inflated past the gate's parity
+        bound), or None. Fires once per planned round."""
+        k = int(round_index)
+        if k in self._bad_candidate_pending:
+            self._bad_candidate_pending.discard(k)
+            return self.bad_candidate_at[k]
+        return None
+
+    def canary_regression(self, roll_index: int) -> bool:
+        """True exactly once, when roll ``roll_index``'s post-promote
+        confirmation window is the planned SLO-regression point — the
+        driver must roll back automatically."""
+        if self._slo_regression_pending \
+                and self.slo_regression_during_canary is not None \
+                and int(roll_index) >= int(self.slo_regression_during_canary):
+            self._slo_regression_pending = False
+            return True
+        return False
+
     # ------------------------------------------------------ preemption seam
     def preemption_signal(self):
         """A StepPreemption for the planned synthetic preemption, or
@@ -456,7 +566,10 @@ class FaultPlan:
                 f"hung={sorted(self.hung_dispatch_at)}, "
                 f"slow={sorted(self.slow_replica_at)}, "
                 f"serve_fail={sorted(self.serve_fail_at)}, "
-                f"serve_loss={self.serve_device_loss_at_batch})")
+                f"serve_loss={self.serve_device_loss_at_batch}, "
+                f"trainer_death_at_roll={self.trainer_death_at_roll}, "
+                f"bad_candidate={sorted(self.bad_candidate_at.items())}, "
+                f"slo_regression={self.slo_regression_during_canary})")
 
 
 def _poison(ds):

@@ -866,13 +866,6 @@ class MultiLayerNetwork:
             self.setPrecisionPolicy(precision)
         _maybe_attach_env_profiler(self)
         tbptt_len = self._tbptt_length()
-        if tbptt_len is not None and self._precision is not None:
-            import warnings
-            warnings.warn(
-                "the TBPTT fit path ignores the attached PrecisionPolicy "
-                "— truncated-BPTT segments train in plain fp32 with no "
-                "loss scaling (mixed precision x TBPTT is a ROADMAP "
-                "carried follow-up)", stacklevel=2)
         session = None
         if checkpoint is not None or nan_policy is not None \
                 or faults is not None:
@@ -1270,45 +1263,104 @@ class MultiLayerNetwork:
     def _make_tbptt_step(self, with_lmask: bool):
         """Compiled TBPTT segment step (one XLA program, cached — the jit
         retraces only when the carried-state pytree structure changes, i.e.
-        once after the first segment materializes RNN states)."""
+        once after the first segment materializes RNN states).
+
+        An attached :class:`~deeplearning4j_tpu.nn.precision.
+        PrecisionPolicy` is honored per segment exactly like the plain
+        train step: ``policy_cast`` on every layer (the state-carrying
+        RNN layers included), the loss scaled inside ``value_and_grad``
+        and divided straight back out. A dynamic policy threads the
+        ``[scale, good_steps]`` carry through the segment with the same
+        drop-on-overflow selects — the carried RNN segment state comes
+        from the forward pass (old params, stop_gradient'd), so it stays
+        valid whether or not the update applies."""
         base = self.conf.base
         updater = base.updater
         seed = base.seed
+        pol = self._precision
+        dynamic = pol is not None and pol.is_dynamic
+        loss_scale = None if (pol is None or dynamic) else pol.loss_scale
+        cdt = self._compute_dtype()
 
-        def step(params, states, opt_state, t, x, y, lmask, seg_states):
-            def loss_fn(p):
-                cur = x
-                key = jax.random.fold_in(jax.random.PRNGKey(seed), t)
-                new_seg = []
-                for i, layer in enumerate(self.layers):
-                    if i in self.conf.preprocessors:
-                        cur = self.conf.preprocessors[i](cur)
-                    key, sub = jax.random.split(key)
-                    if hasattr(layer, "apply_with_state"):
-                        cur, s_new = layer.apply_with_state(p[i], cur,
-                                                            seg_states[i])
-                        new_seg.append(jax.tree_util.tree_map(
-                            jax.lax.stop_gradient, s_new))
+        def forward_loss(p, states, t, x, y, lmask, seg_states, scale):
+            cur = x
+            key = jax.random.fold_in(jax.random.PRNGKey(seed), t)
+            new_seg = []
+            for i, layer in enumerate(self.layers):
+                if i in self.conf.preprocessors:
+                    cur = self.conf.preprocessors[i](cur)
+                key, sub = jax.random.split(key)
+                p_i = p[i]
+                if cdt is not None:
+                    p_i, cur = L.policy_cast(layer, p_i, cur, cdt)
+                if hasattr(layer, "apply_with_state"):
+                    cur, s_new = layer.apply_with_state(p_i, cur,
+                                                        seg_states[i])
+                    new_seg.append(jax.tree_util.tree_map(
+                        jax.lax.stop_gradient, s_new))
+                else:
+                    if isinstance(layer, _MASK_AWARE):
+                        cur, _ = layer.apply(p_i, states[i], cur,
+                                             True, sub, mask=None)
                     else:
-                        if isinstance(layer, _MASK_AWARE):
-                            cur, _ = layer.apply(p[i], states[i], cur,
-                                                 True, sub, mask=None)
-                        else:
-                            cur, _ = layer.apply(p[i], states[i], cur,
-                                                 True, sub)
-                        new_seg.append(None)
-                loss = self.layers[-1].compute_loss(
-                    y, cur, mask=lmask if with_lmask else None)
-                return loss, new_seg
+                        cur, _ = layer.apply(p_i, states[i], cur,
+                                             True, sub)
+                    new_seg.append(None)
+            loss = self.layers[-1].compute_loss(
+                y, cur, mask=lmask if with_lmask else None)
+            if scale is not None:           # dynamic: current carry value
+                return loss * scale, new_seg
+            if loss_scale:
+                return loss * loss_scale, new_seg
+            return loss, new_seg
 
-            (loss, new_seg), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
-            new_params, new_opt = _process_and_apply_grads(
-                base, updater, params, grads, opt_state, t.astype(jnp.float32))
-            return new_params, new_opt, t + 1, loss, new_seg
-        # params/opt_state/t are consumed and replaced (states is read-only
-        # here — the segment threads seg_states instead, which retrace-safely
-        # starts as a list of None)
-        return jax.jit(step, donate_argnums=(0, 2, 3))
+        if dynamic:
+            def step(params, states, opt_state, t, scale_state, x, y,
+                     lmask, seg_states):
+                scale = scale_state[0]
+                (loss, new_seg), grads = jax.value_and_grad(
+                    lambda p: forward_loss(p, states, t, x, y, lmask,
+                                           seg_states, scale),
+                    has_aux=True)(params)
+                inv = 1.0 / scale
+                loss = loss * inv       # listeners/score see true loss
+                grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
+                ok = _grads_all_finite(grads)
+                new_params, new_opt = _process_and_apply_grads(
+                    base, updater, params, grads, opt_state,
+                    t.astype(jnp.float32))
+                new_params = _select_update(ok, new_params, params)
+                new_opt = _select_update(ok, new_opt, opt_state)
+                return (new_params, new_opt, t + 1,
+                        _dynamic_scale_next(pol, scale_state, ok), loss,
+                        new_seg)
+            donate = (0, 2, 3, 4)
+        else:
+            def step(params, states, opt_state, t, x, y, lmask,
+                     seg_states):
+                (loss, new_seg), grads = jax.value_and_grad(
+                    lambda p: forward_loss(p, states, t, x, y, lmask,
+                                           seg_states, None),
+                    has_aux=True)(params)
+                if loss_scale:
+                    inv = 1.0 / loss_scale
+                    loss = loss * inv   # listeners/score see true loss
+                    grads = jax.tree_util.tree_map(lambda g: g * inv,
+                                                   grads)
+                new_params, new_opt = _process_and_apply_grads(
+                    base, updater, params, grads, opt_state,
+                    t.astype(jnp.float32))
+                return new_params, new_opt, t + 1, loss, new_seg
+            donate = (0, 2, 3)
+        # params/opt_state/t (and the dynamic scale carry) are consumed
+        # and replaced (states is read-only here — the segment threads
+        # seg_states instead, which retrace-safely starts as a list of
+        # None). Behind the compile-cache seam like every other compiled
+        # step, so AOT warmup and the persistent cache apply.
+        return _cc.cached_dispatch(
+            step, "mln:tbptt_step",
+            key_parts=self._compile_key_parts(1) + ("tbptt", with_lmask),
+            donate_argnums=donate)
 
     def _fit_one_tbptt(self, ds: DataSet, seg_states):
         """One TBPTT segment: like _fit_one but threading initial RNN state
@@ -1321,6 +1373,13 @@ class MultiLayerNetwork:
         if sig not in self._tbptt_step_cache:
             self._tbptt_step_cache[sig] = self._make_tbptt_step(sig)
         step = self._tbptt_step_cache[sig]
+        # recompile-churn seam (mirrors _fit_one): one extra signature per
+        # batch's first segment is expected (the carried-state pytree goes
+        # None -> materialized); anything beyond that is churn
+        _churn.get_churn_detector().record(
+            "MultiLayerNetwork.tbptt",
+            _churn.array_fingerprint(x, y, lmask)
+            + (seg_states[0] is None,), owner=self)
         # provenance (profiler.sanitizer): the segment dispatch retains
         # its carried RNN state so a nonfinite loss attributes to the
         # (layer, op, step) — including a poisoned carry crossing the
@@ -1330,10 +1389,17 @@ class MultiLayerNetwork:
         for lst in self._listeners:
             if hasattr(lst, "onIterationStart"):
                 lst.onIterationStart(self, self._iteration + 1)
-        self._params, self._opt_state, self._t_dev, loss, new_seg = step(
-            self._params, self._states, self._opt_state,
-            self._ensure_clock(), x, y,
-            lmask if lmask is not None else jnp.zeros((1,)), seg_states)
+        lm = lmask if lmask is not None else jnp.zeros((1,))
+        if self._dynamic_scaling():
+            (self._params, self._opt_state, self._t_dev, self._scale_state,
+             loss, new_seg) = step(
+                self._params, self._states, self._opt_state,
+                self._ensure_clock(), self._ensure_scale_state(), x, y,
+                lm, seg_states)
+        else:
+            self._params, self._opt_state, self._t_dev, loss, new_seg = \
+                step(self._params, self._states, self._opt_state,
+                     self._ensure_clock(), x, y, lm, seg_states)
         self._score = loss  # on-device; score() converts lazily
         _sanitizer.check(self, tok, loss,
                          context=f"tBPTT loss at iteration {self._iteration}")

@@ -269,6 +269,25 @@ class SLOEngine:
                                              spec.step_time_regression)
         return burns
 
+    def burn_over(self, seconds: float) -> Dict[str, float]:
+        """One-off burn per spec over an arbitrary lookback window —
+        what the lifecycle driver's canary judge asks ("how did the
+        fleet burn over THIS observation window?", which rarely matches
+        the spec's alerting windows). Snapshots now but does NOT append
+        a sample or export gauges, so interleaved calls never perturb
+        :meth:`evaluate`'s multi-window series. Returns
+        ``{spec_name: burn}`` (0.0 while the ring is empty)."""
+        now = self._clock()
+        snap = self._capture()
+        with self._lock:
+            samples_view = list(self._samples)
+        out: Dict[str, float] = {}
+        for spec in self.specs:
+            ref = self._reference(samples_view, now, float(seconds))
+            criteria = self._spec_burn(spec, snap, ref[1] if ref else None)
+            out[spec.name] = max(criteria.values()) if criteria else 0.0
+        return out
+
     def evaluate(self) -> dict:
         """Take a fresh snapshot, compute every spec's fast/slow burn,
         export the gauges, and return the full detail dict::

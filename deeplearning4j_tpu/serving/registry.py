@@ -33,6 +33,19 @@ The swap protocol:
    to finish, then closes it (zero-drop by construction: retire refuses
    the active version).
 
+Canary rolls (ISSUE 20 — what the lifecycle driver drives):
+``begin_canary("m", 2, fraction=0.1)`` routes a deterministic fraction
+of unpinned submits to the staged version through the same dispatch
+path (a credit accumulator under the registry lock — exactly
+``round(n * fraction)`` of any n requests, no sampling noise), while
+the active version keeps the rest. ``roll("m", 2)`` (or
+:meth:`promote_canary`) promotes it — the same atomic pointer swap,
+clearing the canary state in the same critical section; a ``roll`` to
+any OTHER version while a canary observes raises
+:class:`CanaryInProgressError` (refuse, never interleave — a second
+roll would make the observation window unattributable).
+``abort_canary("m")`` sends the fraction back to the incumbent.
+
 Routing is one locked pointer read per submit; the submit itself runs
 outside the registry lock, so a slow admission on one model never
 blocks routing for another.
@@ -40,7 +53,9 @@ blocks routing for another.
 Metrics: ``dl4j_registry_rolls_total{model=}``,
 ``dl4j_registry_active_version{model=}``,
 ``dl4j_registry_models`` (loaded names),
-``dl4j_registry_versions{model=}`` (loaded versions per name).
+``dl4j_registry_versions{model=}`` (loaded versions per name),
+``dl4j_registry_canary_version{model=}`` /
+``dl4j_registry_canary_fraction{model=}`` (0 when no canary).
 """
 
 from __future__ import annotations
@@ -73,6 +88,14 @@ VERSIONS_GAUGE = _REG.gauge(
     "dl4j_registry_versions",
     "Loaded (not retired) versions per model name",
     labelnames=("model",))
+CANARY_VERSION = _REG.gauge(
+    "dl4j_registry_canary_version",
+    "The version receiving canary traffic per model name (0 = none)",
+    labelnames=("model",))
+CANARY_FRACTION = _REG.gauge(
+    "dl4j_registry_canary_fraction",
+    "Fraction of unpinned traffic routed to the canary (0 = none)",
+    labelnames=("model",))
 
 
 class ModelNotFoundError(KeyError):
@@ -84,6 +107,44 @@ class ModelNotFoundError(KeyError):
         self.version = version
         at = f" version {version}" if version is not None else ""
         super().__init__(f"model {name!r}{at} is not loaded")
+
+
+class CanaryInProgressError(RuntimeError):
+    """A second :meth:`ModelRegistry.roll` / :meth:`begin_canary` while
+    a canary is still observing — refused, never interleaved: two
+    overlapping observation windows would make neither attributable.
+    Promote (roll TO the canary version), :meth:`abort_canary`, or
+    wait."""
+
+    def __init__(self, name: str, canary: int, fraction: float,
+                 target: Optional[int] = None):
+        self.model = name
+        self.canary = canary
+        self.fraction = fraction
+        self.target = target
+        extra = (f" while rolling to v{target}" if target is not None
+                 and target != canary else "")
+        super().__init__(
+            f"model {name!r} has a canary in progress (v{canary} at "
+            f"{fraction:.0%} of traffic){extra} — promote it, "
+            "abort_canary(), or wait; interleaving rolls would make the "
+            "observation window unattributable")
+
+
+class RollbackTargetGoneError(ValueError):
+    """:meth:`ModelRegistry.rollback` when the pre-roll incumbent has
+    since been retired/evicted — there is no previous version left to
+    restore. Structured (model + version attributes) so the lifecycle
+    driver can report it; subclasses ValueError, not KeyError, because
+    the route itself exists."""
+
+    def __init__(self, name: str, version: int):
+        self.model = name
+        self.version = version
+        super().__init__(
+            f"model {name!r} has no previous version to roll back to: "
+            f"v{version} was retired after the roll — load it again and "
+            "roll explicitly instead")
 
 
 class _Version:
@@ -98,7 +159,8 @@ class _Version:
 
 class _Route:
     __slots__ = ("name", "versions", "active", "previous", "decode",
-                 "reserved")
+                 "reserved", "canary", "canary_fraction", "canary_acc",
+                 "evicted_previous")
 
     def __init__(self, name: str):
         self.name = name
@@ -109,6 +171,24 @@ class _Route:
         self.reserved: set = set()  # versions being built/warmed: picked
         # under the lock, registered later — a concurrent load must not
         # hand out the same number while warmup runs unlocked
+        self.canary: Optional[int] = None   # version observing under a
+        self.canary_fraction: float = 0.0   # fraction of unpinned traffic
+        self.canary_acc: float = 0.0        # credit accumulator: gains
+        # `fraction` per unpinned submit, fires a canary-routed request
+        # each time it crosses 1.0 — deterministic, no sampling noise
+        self.evicted_previous: Optional[int] = None  # what `previous`
+        # pointed at when retire() nulled it — rollback() turns this
+        # into RollbackTargetGoneError instead of a bare "no previous"
+
+    def _clear_canary(self) -> Optional[int]:
+        # lock held by caller; returns the version that was canarying
+        ver, self.canary = self.canary, None
+        self.canary_fraction = 0.0
+        self.canary_acc = 0.0
+        if ver is not None:
+            CANARY_VERSION.labels(model=self.name).set(0)
+            CANARY_FRACTION.labels(model=self.name).set(0.0)
+        return ver
 
 
 class ModelRegistry:
@@ -246,6 +326,31 @@ class ModelRegistry:
         """The routed (or explicitly versioned) server for ``name``."""
         return self._version(name, version).server
 
+    def _pick_submit(self, name: str, version: Optional[int]):
+        """Route one unpinned submit, canary-aware: under the lock the
+        credit accumulator gains ``canary_fraction``; each time it
+        crosses 1.0 one request is routed to the canary version —
+        exactly ``round(n * fraction)`` of any n unpinned submits, a
+        deterministic interleave rather than a coin flip. Pinned
+        (``version=``) submits never count against the accumulator.
+        Returns ``(server, is_canary)``."""
+        with self._lock:
+            route = self._route(name)
+            if version is None and route.canary is not None:
+                route.canary_acc += route.canary_fraction
+                if route.canary_acc >= 1.0 - 1e-9:
+                    route.canary_acc -= 1.0
+                    ver = route.versions.get(route.canary)
+                    if ver is not None and not ver.retired:
+                        return ver.server, True
+            v = route.active if version is None else int(version)
+            if v is None:
+                raise ModelNotFoundError(name)
+            ver = route.versions.get(v)
+            if ver is None or ver.retired:
+                raise ModelNotFoundError(name, v)
+            return ver.server, False
+
     def active_version(self, name: str) -> Optional[int]:
         with self._lock:
             return self._route(name).active
@@ -267,11 +372,11 @@ class ModelRegistry:
         t0_us = _prof.now_us()
         ctx = (trace if trace is not None
                else _tracectx.TraceContext.new())
-        server = self._version(name, version).server
+        server, is_canary = self._pick_submit(name, version)
         _tracectx.record_span(
             "serve:route", ctx.child(), t0_us, _prof.now_us() - t0_us,
             args={"model": name, "server": server.name,
-                  "pinned_version": version})
+                  "pinned_version": version, "canary": is_canary})
         return server.submit(x, deadline=deadline, trace=ctx)
 
     def output(self, name: str, x, timeout: float = 30.0,
@@ -319,12 +424,21 @@ class ModelRegistry:
         ``strict=True`` refuses a W111-flagged roll, otherwise findings
         surface as warnings. Returns the previously active version.
         In-flight and already-queued requests complete on the version
-        that admitted them; nothing is drained or dropped."""
+        that admitted them; nothing is drained or dropped. While a
+        canary observes, only a roll TO the canary version is allowed
+        (that is the promote: the swap clears the canary state in the
+        same critical section); any other target raises
+        :class:`CanaryInProgressError`."""
         with self._lock:
             # pin the target BEFORE linting: a concurrent load() staging
             # a newer (possibly unwarmed) version between the lint and
             # the swap must not silently become the rolled-to version
-            version = self._pick_roll_target(self._route(name), version)
+            route = self._route(name)
+            version = self._pick_roll_target(route, version)
+            if route.canary is not None and version != route.canary:
+                raise CanaryInProgressError(
+                    name, route.canary, route.canary_fraction,
+                    target=version)
         report = self.validate_roll(name, version)
         if strict and report.diagnostics:
             from deeplearning4j_tpu.analysis.diagnostics import \
@@ -337,37 +451,149 @@ class ModelRegistry:
         with self._lock:
             route = self._route(name)
             version = self._pick_roll_target(route, version)
+            if route.canary is not None and version != route.canary:
+                raise CanaryInProgressError(
+                    name, route.canary, route.canary_fraction,
+                    target=version)
             prev = route.active
             route.previous = prev
+            route.evicted_previous = None
             route.active = version
+            promoted = route._clear_canary() is not None
             self._gauges(route)
         ROLLS.labels(model=name).inc()
         _flightrec.get_flight_recorder().record(
-            "registry:roll", model=name, previous=prev, active=version)
-        logger.info("registry: rolled %s v%s -> v%d", name, prev, version)
+            "registry:roll", model=name, previous=prev, active=version,
+            promoted_canary=promoted)
+        logger.info("registry: rolled %s v%s -> v%d%s", name, prev, version,
+                    " (canary promoted)" if promoted else "")
         return prev
 
     def rollback(self, name: str) -> int:
         """Swap the route back to the version active before the last
         :meth:`roll` — the old server is still loaded and warmed, so the
-        restored traffic is bit-identical to pre-roll."""
+        restored traffic is bit-identical to pre-roll. A canary in
+        progress is aborted in the same critical section (its fraction
+        returns to the restored incumbent). Raises
+        :class:`RollbackTargetGoneError` when the pre-roll incumbent
+        has since been retired."""
         with self._lock:
             route = self._route(name)
             prev = route.previous
             if prev is None:
+                if route.evicted_previous is not None:
+                    raise RollbackTargetGoneError(
+                        name, route.evicted_previous)
                 raise ValueError(f"model {name!r} has no previous version "
                                  "to roll back to")
             ver = route.versions.get(prev)
             if ver is None or ver.retired:
-                raise ModelNotFoundError(name, prev)
+                raise RollbackTargetGoneError(name, prev)
             route.previous = route.active
             route.active = prev
+            aborted = route._clear_canary()
             self._gauges(route)
         ROLLS.labels(model=name).inc()
         _flightrec.get_flight_recorder().record(
-            "registry:rollback", model=name, active=prev)
+            "registry:rollback", model=name, active=prev,
+            aborted_canary=aborted)
         logger.info("registry: rolled back %s -> v%d", name, prev)
         return prev
+
+    # -------------------------------------------------------------- canary
+    def begin_canary(self, name: str, version: Optional[int] = None,
+                     fraction: float = 0.1, strict: bool = False) -> int:
+        """Start routing ``fraction`` of ``name``'s unpinned traffic to
+        ``version`` (default: newest staged) through the normal dispatch
+        path, while the active version keeps the rest. The split is a
+        deterministic credit accumulator, not sampling: any n submits
+        send exactly ``round(n * fraction)`` to the canary. Runs the
+        same pre-roll lint as :meth:`roll` (the canary serves real
+        traffic — an unwarmed ladder would recompile under it). Refuses
+        (:class:`CanaryInProgressError`) while another canary observes.
+        Promote with :meth:`roll`/:meth:`promote_canary`, abandon with
+        :meth:`abort_canary`. Returns the canary version."""
+        if not 0.0 < fraction < 1.0:
+            raise ValueError(
+                f"canary fraction must be in (0, 1), got {fraction!r} — "
+                "1.0 is a roll, 0.0 is a no-op")
+        with self._lock:
+            route = self._route(name)
+            if route.canary is not None:
+                raise CanaryInProgressError(name, route.canary,
+                                            route.canary_fraction)
+            if route.active is None:
+                raise ValueError(
+                    f"model {name!r} has no active version to canary "
+                    "against — the first version just rolls")
+            version = self._pick_roll_target(route, version)
+            if version == route.active:
+                raise ValueError(
+                    f"model {name!r} v{version} is already the active "
+                    "version — nothing to canary")
+        report = self.validate_roll(name, version)
+        if strict and report.diagnostics:
+            from deeplearning4j_tpu.analysis.diagnostics import \
+                ModelValidationError
+            raise ModelValidationError(report)
+        import warnings as _warnings
+        for d in report.diagnostics:
+            _warnings.warn(f"registry canary: {d.code}: {d.message}",
+                           stacklevel=2)
+        with self._lock:
+            route = self._route(name)
+            version = self._pick_roll_target(route, version)
+            if route.canary is not None:
+                raise CanaryInProgressError(name, route.canary,
+                                            route.canary_fraction)
+            route.canary = version
+            route.canary_fraction = float(fraction)
+            route.canary_acc = 0.0
+            CANARY_VERSION.labels(model=name).set(version)
+            CANARY_FRACTION.labels(model=name).set(float(fraction))
+        _flightrec.get_flight_recorder().record(
+            "registry:canary_begin", model=name, canary=version,
+            fraction=float(fraction), incumbent=self.active_version(name))
+        logger.info("registry: canary %s v%d at %.0f%% of traffic",
+                    name, version, fraction * 100.0)
+        return version
+
+    def promote_canary(self, name: str, strict: bool = False) -> int:
+        """Roll to the observing canary version (the canary state clears
+        atomically with the swap). Returns the canary version now
+        active."""
+        with self._lock:
+            route = self._route(name)
+            if route.canary is None:
+                raise ValueError(
+                    f"model {name!r} has no canary in progress to promote")
+            target = route.canary
+        self.roll(name, target, strict=strict)
+        return target
+
+    def abort_canary(self, name: str) -> Optional[int]:
+        """Stop a canary: its traffic fraction returns to the incumbent.
+        The canary version STAYS loaded and warmed (quarantine/retire is
+        the caller's policy call). Idempotent — returns the version that
+        was observing, or None."""
+        with self._lock:
+            route = self._route(name)
+            ver = route._clear_canary()
+        if ver is not None:
+            _flightrec.get_flight_recorder().record(
+                "registry:canary_abort", model=name, canary=ver)
+            logger.info("registry: canary aborted %s v%d", name, ver)
+        return ver
+
+    def canary(self, name: str) -> Optional[dict]:
+        """The observing canary for ``name`` as ``{"version", "fraction"}``,
+        or None."""
+        with self._lock:
+            route = self._route(name)
+            if route.canary is None:
+                return None
+            return {"version": route.canary,
+                    "fraction": route.canary_fraction}
 
     # ----------------------------------------------------------- retirement
     def retire(self, name: str, version: int, timeout: float = 30.0) -> None:
@@ -383,6 +609,10 @@ class ModelRegistry:
                 raise ValueError(
                     f"refusing to retire {name!r} v{version}: it is the "
                     "active route (roll first)")
+            if route.canary == int(version):
+                raise ValueError(
+                    f"refusing to retire {name!r} v{version}: it is the "
+                    "observing canary (promote or abort_canary first)")
             ver = route.versions.get(int(version))
             if ver is None:
                 raise ModelNotFoundError(name, version)
@@ -405,7 +635,11 @@ class ModelRegistry:
         with self._lock:
             ver.retired = True
             if route.previous == ver.version:
+                # remember WHAT was evicted: a later rollback() raises
+                # the structured RollbackTargetGoneError, not a bare
+                # "no previous"
                 route.previous = None
+                route.evicted_previous = ver.version
             self._gauges(route)
 
     def unload(self, name: str) -> None:
@@ -439,10 +673,13 @@ class ModelRegistry:
             with self._lock:
                 vers = dict(route.versions)
                 active, previous = route.active, route.previous
+                canary, frac = route.canary, route.canary_fraction
                 has_decode = route.decode is not None
             out[route.name] = {
                 "active": active,
                 "previous": previous,
+                "canary": canary,
+                "canary_fraction": frac,
                 "accepts_images": has_decode,
                 "versions": {
                     v: {"state": ver.server.state,
@@ -459,13 +696,24 @@ class ModelRegistry:
         server's :meth:`~ModelServer.load_hints` per model plus fleet
         totals a load balancer can threshold on."""
         with self._lock:
-            actives = [(r.name, r.versions[r.active])
+            actives = [(r.name, r.versions[r.active],
+                        r.versions.get(r.canary)
+                        if r.canary is not None else None,
+                        r.canary_fraction)
                        for r in self._routes.values()
                        if r.active is not None]
         per_model = {}
-        for name, ver in actives:
+        for name, ver, canary_ver, frac in actives:
             hints = ver.server.load_hints()
             hints["version"] = ver.version
+            if canary_ver is not None and not canary_ver.retired:
+                # the canary's own hints ride along so the lifecycle
+                # driver (and any load balancer) can watch its p99/
+                # shed-rate separately from the incumbent's
+                chints = canary_ver.server.load_hints()
+                chints["version"] = canary_ver.version
+                chints["fraction"] = frac
+                hints["canary"] = chints
             per_model[name] = hints
         n = len(per_model)
         return {

@@ -497,6 +497,11 @@ class ModelServer:
         epilogues, precision) before the forward resolves — the bucket
         ladder then warms the TUNED program. No record: one warning,
         defaults stand.
+    capture : a :class:`~deeplearning4j_tpu.lifecycle.capture.
+        TrafficCapture` (or any ``.record(features, deadline=)``)
+        sampling live requests at admission into the ServingLoad replay
+        format — the captured stream doubles as the lifecycle eval set
+        and as deterministic chaos input (ISSUE 20).
     """
 
     def __init__(self, model, mesh: DeviceMesh = None, batch_limit: int = 32,
@@ -508,7 +513,8 @@ class ModelServer:
                  drain_timeout: float = 30.0, input_dtype=np.float32,
                  preemption=None, faults=None, rewarm_on_shrink: bool = True,
                  name: Optional[str] = None, forward=None, head=None,
-                 tuned: bool = False, _breaker_clock=time.monotonic):
+                 tuned: bool = False, capture=None,
+                 _breaker_clock=time.monotonic):
         self.model = model
         if tuned and hasattr(model, "setComputeLayout"):
             # autotuner record store (ISSUE 17): apply the winning plan's
@@ -535,6 +541,10 @@ class ModelServer:
         self.input_dtype = np.dtype(input_dtype)
         self.rewarm_on_shrink = bool(rewarm_on_shrink)
         self._faults = faults
+        self._capture = capture     # lifecycle.TrafficCapture (or any
+        # .record(features, deadline=)) sampling live traffic on the
+        # serve path — the captured stream doubles as the eval set and
+        # as deterministic chaos input (ISSUE 20)
         self.breaker = CircuitBreaker(breaker_threshold, breaker_cooldown,
                                       clock=_breaker_clock, name=self.name)
         self._queue_gauge = QUEUE_DEPTH.labels(server=self.name)
@@ -628,6 +638,11 @@ class ModelServer:
                     "warmup([shape]) before serving it")
         now = time.monotonic()
         dl = self.default_deadline if deadline is None else deadline
+        if self._capture is not None:
+            # after validation (only servable traffic is worth replaying)
+            # but BEFORE admission: a request shed under overload is
+            # exactly the traffic a chaos replay wants to reproduce
+            self._capture.record(x, deadline=dl)
         req = ServingRequest(x, now + dl if dl is not None else None, now,
                              trace=trace)
         req.server = self.name

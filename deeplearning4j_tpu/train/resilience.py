@@ -1098,3 +1098,84 @@ def begin_session(model, data, checkpoint=None, nan_policy=None, faults=None):
         session.close()
         raise
     return session, wrapped
+
+
+# ------------------------------------------------- lifecycle driver state
+class DriverStateStore:
+    """Atomic, checksummed persistence for the lifecycle driver's state
+    machine (ISSUE 20) — the same durability contract as a training
+    checkpoint, scaled down to one JSON document: a crash mid-write can
+    never leave a half-state under the real name (temp file + one
+    ``os.replace``), every load verifies a SHA-256 over the canonical
+    payload, and a corrupt file is QUARANTINED (renamed aside) instead
+    of trusted, so a resumed driver starts from "no state" rather than
+    from garbage. Writes ride :func:`retry_io`.
+
+    The driver persists at every phase transition, so after a SIGKILL
+    the successor knows exactly which round/phase/candidate was in
+    flight and whether a canary must be aborted before continuing.
+    """
+
+    FILENAME = "lifecycle_driver_state.json"
+
+    def __init__(self, state_dir: str, io_retries: int = 3,
+                 io_backoff: float = 0.05):
+        self.dir = state_dir
+        self.path = os.path.join(state_dir, self.FILENAME)
+        self._retries = int(io_retries)
+        self._backoff = float(io_backoff)
+        os.makedirs(state_dir, exist_ok=True)
+
+    @staticmethod
+    def _digest(state: dict) -> str:
+        canon = json.dumps(state, sort_keys=True,
+                           separators=(",", ":")).encode()
+        return hashlib.sha256(canon).hexdigest()
+
+    def save(self, state: dict) -> None:
+        """Persist ``state`` atomically (JSON-serializable values only)."""
+        doc = {"state": state, "sha256": self._digest(state)}
+        tmp = self.path + ".tmp"
+
+        def write():
+            with open(tmp, "w") as f:
+                json.dump(doc, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+
+        retry_io(write, retries=self._retries, backoff=self._backoff)
+
+    def load(self) -> Optional[dict]:
+        """The last saved state, or None (no state yet, or the file was
+        corrupt — in which case it has been quarantined and counted in
+        ``dl4j_checkpoint_quarantined_total``)."""
+        if not os.path.exists(self.path):
+            return None
+        try:
+            with open(self.path) as f:
+                doc = json.load(f)
+            state = doc["state"]
+            if self._digest(state) != doc["sha256"]:
+                raise CorruptCheckpointError(
+                    f"driver state {self.path}: checksum mismatch")
+            return state
+        except (OSError, ValueError, KeyError, TypeError,
+                CorruptCheckpointError) as e:
+            quarantine = os.path.join(
+                self.dir, "quarantine_" + self.FILENAME)
+            try:
+                os.replace(self.path, quarantine)
+            except OSError:
+                pass
+            QUARANTINED.inc()
+            logger.warning(
+                "driver state %s failed validation (%s) — quarantined to "
+                "%s; the driver resumes stateless", self.path, e, quarantine)
+            return None
+
+    def clear(self) -> None:
+        try:
+            os.remove(self.path)
+        except OSError:
+            pass

@@ -61,6 +61,49 @@ def pytest_configure(config):
         "`tune resnet50 --budget 20` step-time-reduction pin)")
 
 
+# ---------------------------------------------------- tier-1 budget report
+# The tier-1 gate is `-m 'not slow'` under a 1500 s timeout (ROADMAP).
+# This report keeps the headroom visible on every run: total non-slow
+# wall time vs the ceiling (warn at 80%) plus the slowest 10 non-slow
+# tests — the candidates to optimize or demote to `slow` BEFORE the
+# ceiling is hit, not after CI starts flaking on timeout.
+TIER1_CEILING_S = 1500.0
+TIER1_WARN_FRAC = 0.8
+_test_durations: dict = {}
+_slow_nodeids: set = set()
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if item.get_closest_marker("slow") is not None:
+            _slow_nodeids.add(item.nodeid)
+
+
+def pytest_runtest_logreport(report):
+    # sum setup+call+teardown per nodeid
+    _test_durations[report.nodeid] = (
+        _test_durations.get(report.nodeid, 0.0) + report.duration)
+
+
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    non_slow = {nid: d for nid, d in _test_durations.items()
+                if nid not in _slow_nodeids}
+    if not non_slow:
+        return
+    total = sum(non_slow.values())
+    tr = terminalreporter
+    tr.section("tier-1 budget")
+    pct = 100.0 * total / TIER1_CEILING_S
+    tr.write_line(f"non-slow wall time: {total:.1f}s of "
+                  f"{TIER1_CEILING_S:.0f}s ceiling ({pct:.0f}%)")
+    if total >= TIER1_WARN_FRAC * TIER1_CEILING_S:
+        tr.write_line(
+            f"WARNING: past {TIER1_WARN_FRAC:.0%} of the tier-1 ceiling "
+            "— optimize or demote tests to `slow` (candidates below)")
+    for nid, d in sorted(non_slow.items(), key=lambda kv: -kv[1])[:10]:
+        tr.write_line(f"  {d:7.2f}s  {nid}")
+
+
 @pytest.fixture(scope="session")
 def devices():
     return jax.devices()

@@ -1,0 +1,607 @@
+"""Lifecycle loop tests (ISSUE 20): capture, gate, driver state machine,
+registry canary routing, and THE chaos-storm pin — a seeded storm
+(trainer SIGKILLed mid-roll + one bad candidate + one genuine SLO
+regression during canary) that must end with the registry serving the
+last good version, the driver resumed from its checkpointed state, zero
+dropped requests, a bit-identical rollback, and zero steady-state
+recompiles with trainer and registry sharing one mesh.
+"""
+
+import json
+import os
+import threading
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.analysis.lifecycle import lint_lifecycle
+from deeplearning4j_tpu.faults import FaultPlan, ServingLoad
+from deeplearning4j_tpu.lifecycle import (EvalGate, GatePolicy,
+                                          LifecycleDriver, TrafficCapture,
+                                          TrainerKilledError,
+                                          spawn_trainer_process)
+from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                   NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.serving.registry import (CanaryInProgressError,
+                                                 ModelRegistry,
+                                                 RollbackTargetGoneError)
+from deeplearning4j_tpu.train import updaters
+from deeplearning4j_tpu.train.resilience import DriverStateStore
+
+NIN, NOUT = 4, 3
+W0 = np.random.RandomState(0).randn(NIN, NOUT).astype(np.float32)
+
+
+def linear_model(delta: float):
+    """Deterministic candidate: x @ (W0 + delta) — bit-identical math
+    is assertable across promote/rollback."""
+    W = (W0 + np.float32(delta)).astype(np.float32)
+    return lambda x: np.asarray(x, np.float32) @ W
+
+
+def feats(rows, seed=0):
+    return np.random.RandomState(seed).randn(rows, NIN).astype(np.float32)
+
+
+def quiet_registry(**kw):
+    kw.setdefault("batch_limit", 8)
+    kw.setdefault("coalesce_ms", 0.5)
+    return ModelRegistry(**kw)
+
+
+# --------------------------------------------------------------- capture
+class TestTrafficCapture:
+    def test_sampling_is_deterministic_and_replayable(self, tmp_path):
+        path = str(tmp_path / "cap.jsonl")
+        cap = TrafficCapture(path, sample_rate=0.5)
+        for i in range(10):
+            cap.record(feats(2, seed=i), deadline=1.5)
+        # credit accumulator: exactly round(10 * 0.5) records
+        assert cap.captured == 5
+        recs = TrafficCapture.load(path)
+        assert len(recs) == 5
+        assert all(r["rows"] == 2 and r["deadline"] == 1.5 for r in recs)
+        load = TrafficCapture.to_serving_load(path)
+        assert len(load) == 5
+        assert [s.rows for s in load.specs] == [2] * 5
+        ev = TrafficCapture.eval_features(path)
+        assert ev.shape == (10, NIN)
+
+    def test_truncated_tail_loads_cleanly(self, tmp_path):
+        # flight-recorder style: a crash mid-append must not poison the
+        # eval set the capture left behind
+        path = str(tmp_path / "cap.jsonl")
+        cap = TrafficCapture(path)
+        cap.record(feats(2, seed=0))
+        cap.record(feats(3, seed=1))
+        with open(path, "a") as f:
+            f.write('{"at": 0.5, "rows": 4, "deadl')   # torn record
+        recs = TrafficCapture.load(path)
+        assert [r["rows"] for r in recs] == [2, 3]
+        assert TrafficCapture.eval_features(path).shape == (5, NIN)
+        assert len(TrafficCapture.to_serving_load(path)) == 2
+
+    def test_capture_failure_never_raises(self, tmp_path):
+        cap = TrafficCapture(str(tmp_path / "no" / "such" / "dir" / "c.jl"))
+        assert cap.record(feats(2)) is False
+        assert cap.dropped == 1
+
+    def test_max_records_bound(self, tmp_path):
+        path = str(tmp_path / "cap.jsonl")
+        cap = TrafficCapture(path, max_records=3)
+        for i in range(6):
+            cap.record(feats(1, seed=i))
+        assert cap.captured == 3 and cap.dropped == 3
+        assert len(TrafficCapture.load(path)) == 3
+
+    def test_server_capture_hook(self, tmp_path):
+        path = str(tmp_path / "cap.jsonl")
+        cap = TrafficCapture(path)
+        with quiet_registry(capture=cap) as reg:
+            reg.load("m", linear_model(0.0), shapes=[(NIN,)])
+            reg.output("m", feats(4))
+            reg.output("m", feats(2))
+        assert cap.captured == 2
+        assert [r["rows"] for r in TrafficCapture.load(path)] == [4, 2]
+
+
+# ------------------------------------------------------------------ gate
+class TestEvalGate:
+    def test_pass_and_parity_rejection(self):
+        gate = EvalGate(GatePolicy(parity_bound=0.05))
+        x = feats(16)
+        ok = gate.evaluate(linear_model(1e-4), linear_model(0.0), x)
+        assert ok and ok.reason is None
+        bad = gate.evaluate(linear_model(5.0), linear_model(0.0), x)
+        assert not bad and bad.reason == "parity_violation"
+        assert bad.to_dict()["detail"]["parity_rel"] > 0.05
+
+    def test_nan_candidate_rejected(self):
+        gate = EvalGate()
+        verdict = gate.evaluate(lambda x: np.full((len(x), NOUT), np.nan),
+                                linear_model(0.0), feats(8))
+        assert not verdict
+        assert verdict.reason == "non_finite_outputs"
+        assert verdict.detail["non_finite_values"] == 8 * NOUT
+
+    def test_scorecard_regression_with_labels(self):
+        x = feats(16)
+        y = x @ W0     # ground truth IS the incumbent's function
+        gate = EvalGate(GatePolicy(max_regression=0.05))
+        good = gate.evaluate(linear_model(1e-4), linear_model(0.0), x, y)
+        assert good
+        bad = gate.evaluate(linear_model(1.0), linear_model(0.0), x, y)
+        assert not bad and bad.reason == "scorecard_regression"
+        assert bad.candidate_score > bad.incumbent_score
+
+    def test_empty_eval_fails_closed(self):
+        verdict = EvalGate().evaluate(linear_model(0.0), None, None)
+        assert not verdict and verdict.reason == "insufficient_eval"
+
+
+# ----------------------------------------------------------- state store
+class TestDriverStateStore:
+    def test_roundtrip_atomic(self, tmp_path):
+        store = DriverStateStore(str(tmp_path))
+        state = {"round": 3, "phase": "observe", "quarantined": []}
+        store.save(state)
+        assert DriverStateStore(str(tmp_path)).load() == state
+
+    def test_corrupt_state_quarantined_not_trusted(self, tmp_path):
+        store = DriverStateStore(str(tmp_path))
+        store.save({"round": 1})
+        with open(store.path) as f:
+            doc = json.load(f)
+        doc["state"]["round"] = 99          # tampered: checksum now wrong
+        with open(store.path, "w") as f:
+            json.dump(doc, f)
+        assert store.load() is None
+        assert os.path.exists(os.path.join(
+            str(tmp_path), "quarantine_" + DriverStateStore.FILENAME))
+        # and a fresh store starts clean, not from garbage
+        assert store.load() is None
+
+
+# -------------------------------------------------------- registry canary
+class TestRegistryCanary:
+    def test_fraction_is_deterministic(self):
+        with quiet_registry() as reg:
+            v1 = reg.load("m", linear_model(0.0), shapes=[(NIN,)])
+            v2 = reg.load("m", linear_model(0.1), shapes=[(NIN,)])
+            reg.begin_canary("m", v2, fraction=0.25)
+            handles = [reg.submit("m", feats(2, seed=i)) for i in range(40)]
+            for h in handles:
+                h.get(10)
+            on_canary = sum(1 for h in handles
+                            if h.server == f"m:v{v2}")
+            # credit accumulator: EXACTLY round(40 * 0.25), no noise
+            assert on_canary == 10
+            assert sum(1 for h in handles
+                       if h.server == f"m:v{v1}") == 30
+            # pinned submits never count against the accumulator
+            assert reg.submit("m", feats(2), version=v1).get(10) is not None
+            assert reg.canary("m") == {"version": v2, "fraction": 0.25}
+
+    def test_roll_refused_while_canary_observing(self):
+        # the driver leans on this: two interleaved observation windows
+        # would make neither attributable
+        with quiet_registry() as reg:
+            reg.load("m", linear_model(0.0), shapes=[(NIN,)])
+            v2 = reg.load("m", linear_model(0.1), shapes=[(NIN,)])
+            v3 = reg.load("m", linear_model(0.2), shapes=[(NIN,)])
+            reg.begin_canary("m", v2, fraction=0.5)
+            with pytest.raises(CanaryInProgressError) as ei:
+                reg.roll("m", v3)
+            assert ei.value.canary == v2 and ei.value.target == v3
+            with pytest.raises(CanaryInProgressError):
+                reg.begin_canary("m", v3, fraction=0.5)
+            # roll TO the canary version IS the promote, and clears it
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                reg.roll("m", v2)
+            assert reg.active_version("m") == v2
+            assert reg.canary("m") is None
+            # with the canary gone, other rolls work again
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                reg.roll("m", v3)
+            assert reg.active_version("m") == v3
+
+    def test_promote_and_abort(self):
+        with quiet_registry() as reg:
+            reg.load("m", linear_model(0.0), shapes=[(NIN,)])
+            v2 = reg.load("m", linear_model(0.1), shapes=[(NIN,)])
+            reg.begin_canary("m", v2, fraction=0.5)
+            assert reg.abort_canary("m") == v2
+            assert reg.canary("m") is None
+            assert reg.abort_canary("m") is None      # idempotent
+            # the aborted version stays loaded and warmed
+            reg.begin_canary("m", v2, fraction=0.5)
+            assert reg.promote_canary("m") == v2
+            assert reg.active_version("m") == v2
+            assert reg.canary("m") is None
+
+    def test_rollback_aborts_canary(self):
+        with quiet_registry() as reg:
+            reg.load("m", linear_model(0.0), shapes=[(NIN,)])
+            v2 = reg.load("m", linear_model(0.1), shapes=[(NIN,)])
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                reg.roll("m", v2)
+            v3 = reg.load("m", linear_model(0.2), shapes=[(NIN,)])
+            reg.begin_canary("m", v3, fraction=0.5)
+            assert reg.rollback("m") == 1
+            assert reg.canary("m") is None
+
+    def test_retire_refuses_observing_canary(self):
+        with quiet_registry() as reg:
+            reg.load("m", linear_model(0.0), shapes=[(NIN,)])
+            v2 = reg.load("m", linear_model(0.1), shapes=[(NIN,)])
+            reg.begin_canary("m", v2, fraction=0.5)
+            with pytest.raises(ValueError, match="observing canary"):
+                reg.retire("m", v2, timeout=1.0)
+
+    def test_rollback_after_eviction_structured_error(self):
+        # the driver leans on this: rollback() when the pre-roll
+        # incumbent was retired must be a structured error, not KeyError
+        with quiet_registry() as reg:
+            v1 = reg.load("m", linear_model(0.0), shapes=[(NIN,)])
+            v2 = reg.load("m", linear_model(0.1), shapes=[(NIN,)])
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                reg.roll("m", v2)
+            reg.retire("m", v1, timeout=5.0)
+            with pytest.raises(RollbackTargetGoneError) as ei:
+                reg.rollback("m")
+            assert not isinstance(ei.value, KeyError)
+            assert isinstance(ei.value, ValueError)
+            assert ei.value.model == "m" and ei.value.version == v1
+            assert "no previous" in str(ei.value)
+
+    def test_hints_and_models_carry_canary(self):
+        with quiet_registry() as reg:
+            reg.load("m", linear_model(0.0), shapes=[(NIN,)])
+            v2 = reg.load("m", linear_model(0.1), shapes=[(NIN,)])
+            assert reg.models()["m"]["canary"] is None
+            reg.begin_canary("m", v2, fraction=0.2)
+            m = reg.models()["m"]
+            assert m["canary"] == v2 and m["canary_fraction"] == 0.2
+            hints = reg.load_hints()["models"]["m"]
+            assert hints["canary"]["version"] == v2
+            assert hints["canary"]["fraction"] == 0.2
+            assert "shed_rate" in hints["canary"]
+
+
+# -------------------------------------------------------------- SLO layer
+class TestBurnOver:
+    def test_burn_over_does_not_perturb_the_ring(self):
+        from deeplearning4j_tpu.profiler.slo import SLOEngine, SLOSpec
+        from deeplearning4j_tpu.profiler import metrics as _metrics
+        reg = _metrics.MetricsRegistry()
+        req = reg.counter("dl4j_serving_requests_total", "t",
+                          labelnames=("outcome",))
+        spec = SLOSpec("serve", shed_rate=0.1, windows=(10.0, 100.0))
+        t = [0.0]
+        eng = SLOEngine([spec], registry=reg, clock=lambda: t[0])
+        req.labels(outcome="completed").inc(100)
+        eng.evaluate()
+        n = len(eng._samples)
+        t[0] = 30.0
+        req.labels(outcome="shed_overload").inc(50)
+        burns = eng.burn_over(20.0)
+        # delta vs the 30s-old reference: 50 shed of 50 new -> 1.0/0.1
+        assert burns["serve"] == pytest.approx(10.0)
+        assert len(eng._samples) == n       # no sample appended
+
+
+# ------------------------------------------------------------------ lints
+class TestLifecycleLints:
+    def test_w113_window_shorter_than_fast(self):
+        rep = lint_lifecycle(observation_window=5.0, canary_fraction=0.2,
+                             slo_windows=(60.0, 600.0))
+        assert [d.code for d in rep.diagnostics] == ["DL4J-W113"]
+
+    def test_w114_fraction_below_resolution(self):
+        rep = lint_lifecycle(observation_window=120.0, canary_fraction=0.01,
+                             slo_windows=(60.0, 600.0),
+                             requests_per_tick=50)
+        assert [d.code for d in rep.diagnostics] == ["DL4J-W114"]
+
+    def test_w114_bucket_underfill(self):
+        rep = lint_lifecycle(observation_window=120.0, canary_fraction=0.1,
+                             requests_per_tick=40, buckets=[8, 16, 32])
+        assert [d.code for d in rep.diagnostics] == ["DL4J-W114"]
+        assert "bucket" in rep.diagnostics[0].message
+
+    def test_clean_plan(self):
+        rep = lint_lifecycle(observation_window=120.0, canary_fraction=0.25,
+                             slo_windows=(60.0, 600.0),
+                             requests_per_tick=100, buckets=[8, 16])
+        assert rep.diagnostics == []
+
+    def test_cli(self, capsys):
+        from deeplearning4j_tpu.lifecycle.__main__ import main
+        rc = main(["--observation-window", "5", "--canary-fraction", "0.2",
+                   "--slo-windows", "60,600"])
+        assert rc == 1
+        assert "DL4J-W113" in capsys.readouterr().out
+        rc = main(["--observation-window", "120",
+                   "--canary-fraction", "0.25"])
+        assert rc == 0
+
+
+# ----------------------------------------------------------------- driver
+def make_trainer():
+    def trainer(r):
+        return linear_model(0.001 * r)
+    return trainer
+
+
+class TestLifecycleDriver:
+    def test_happy_path_promotes_each_round(self, tmp_path):
+        with quiet_registry() as reg:
+            drv = LifecycleDriver(reg, "m", make_trainer(),
+                                  str(tmp_path / "state"),
+                                  eval_x=feats(16), shapes=[(NIN,)],
+                                  observe_ticks=1, confirm_ticks=1)
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                summary = drv.run(3)
+            assert summary["promotions"] == 3
+            assert summary["rollbacks"] == 0
+            assert summary["quarantined"] == []
+            assert reg.active_version("m") == 3
+            assert drv.incumbent_version == 3
+            # driver resumable state is idle/clean
+            st = DriverStateStore(str(tmp_path / "state")).load()
+            assert st["phase"] == "idle" and st["in_round"] is None
+
+    def test_bad_candidate_quarantined_never_loaded(self, tmp_path):
+        plan = FaultPlan(bad_candidate_at={2: "nan"})
+        with quiet_registry() as reg:
+            drv = LifecycleDriver(reg, "m", make_trainer(),
+                                  str(tmp_path / "state"),
+                                  eval_x=feats(16), shapes=[(NIN,)],
+                                  observe_ticks=1, confirm_ticks=1,
+                                  faults=plan)
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                summary = drv.run(3)
+        assert summary["promotions"] == 2
+        q = summary["quarantined"]
+        assert len(q) == 1 and q[0]["reason"] == "gate:non_finite_outputs"
+        assert q[0]["version"] is None      # NEVER loaded
+        # versions 1 and 2 exist; the poisoned round produced none
+        assert reg.models()["m"]["versions"].keys() == {1, 2}
+
+    def test_regressed_candidate_quarantined(self, tmp_path):
+        plan = FaultPlan(bad_candidate_at={2: "regressed"})
+        with quiet_registry() as reg:
+            drv = LifecycleDriver(reg, "m", make_trainer(),
+                                  str(tmp_path / "state"),
+                                  eval_x=feats(16), shapes=[(NIN,)],
+                                  gate=EvalGate(GatePolicy(
+                                      parity_bound=0.05)),
+                                  observe_ticks=1, confirm_ticks=1,
+                                  faults=plan)
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                summary = drv.run(2)
+        assert [q["reason"] for q in summary["quarantined"]] \
+            == ["gate:parity_violation"]
+
+    def test_trainer_death_mid_roll_then_resume(self, tmp_path):
+        plan = FaultPlan(trainer_death_at_roll=1)
+        proc = spawn_trainer_process()
+        state_dir = str(tmp_path / "state")
+        with quiet_registry() as reg:
+            drv = LifecycleDriver(reg, "m", make_trainer(), state_dir,
+                                  eval_x=feats(16), shapes=[(NIN,)],
+                                  observe_ticks=1, confirm_ticks=1,
+                                  faults=plan, trainer_process=proc)
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                with pytest.raises(TrainerKilledError):
+                    drv.run(2)
+            # the trainer subprocess is DEAD (SIGKILL)
+            assert proc.poll() is not None and proc.returncode == -9
+            # registry is consistent: incumbent serving, canary live or
+            # cleanly abortable, v2 loaded
+            assert reg.active_version("m") == 1
+            np.testing.assert_array_equal(
+                reg.output("m", feats(4)),
+                linear_model(0.001)(feats(4)))
+            # a NEW driver over the same state_dir resumes the round
+            drv2 = LifecycleDriver(reg, "m", make_trainer(), state_dir,
+                                   eval_x=feats(16), shapes=[(NIN,)],
+                                   observe_ticks=1, confirm_ticks=1,
+                                   faults=plan)
+            assert drv2.resumed
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                summary = drv2.run(2)
+            assert summary["rounds"] == 2
+            assert reg.active_version("m") == 2
+            assert reg.canary("m") is None
+            # the interrupted candidate was NOT retrained or reloaded
+            assert reg.models()["m"]["versions"].keys() == {1, 2}
+
+
+# ------------------------------------------------------------- THE storm
+def storm_net():
+    conf = (NeuralNetConfiguration.Builder().seed(7)
+            .updater(updaters.Sgd(0.05)).list()
+            .layer(DenseLayer(nOut=8, activation="relu"))
+            .layer(OutputLayer(nOut=NOUT, lossFunction="mcxent",
+                               activation="softmax"))
+            .setInputType(InputType.feedForward(NIN))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+@pytest.mark.chaos
+class TestChaosStorm:
+    def test_train_gate_roll_rollback_storm(self, tmp_path):
+        """THE pin (acceptance criteria): seed 23 fires all three chaos
+        kinds — trainer SIGKILLed mid-roll (roll 2), one NaN candidate
+        (round 3), one SLO regression during canary (roll 4) — across 5
+        rounds under live traffic, with a REAL trainer fitting on the
+        same mesh the registry serves from."""
+        plan = FaultPlan.seeded_lifecycle(seed=23, rounds=5, n_bad=1,
+                                          trainer_death=True,
+                                          slo_regression=True)
+        assert plan.trainer_death_at_roll == 2
+        assert plan.bad_candidate_at == {3: "nan"}
+        assert plan.slo_regression_during_canary == 4
+
+        from deeplearning4j_tpu.analysis.churn import get_churn_detector
+        det = get_churn_detector()
+        net = storm_net()
+        fit_x = feats(8, seed=3)
+        fit_y = np.eye(NOUT, dtype=np.float32)[
+            np.random.RandomState(4).randint(NOUT, size=8)]
+
+        def trainer(r):
+            # the REAL trainer: fit on the shared mesh every round
+            net.fit(fit_x, fit_y)
+            return linear_model(0.001 * r)
+
+        # warm the compiled fit path once, then pin its signature count:
+        # rounds must reuse it (zero steady-state trainer recompiles)
+        trainer(0)
+        fit_sigs = det.signature_count("MultiLayerNetwork.fit", owner=net)
+
+        proc = spawn_trainer_process()
+        state_dir = str(tmp_path / "state")
+        stop = threading.Event()
+        handles, submit_errors = [], []
+
+        reg = quiet_registry()
+        try:
+            from deeplearning4j_tpu.serving.registry import \
+                ModelNotFoundError
+
+            def traffic():
+                i = 0
+                while not stop.is_set():
+                    try:
+                        if reg.active_version("m") is not None:
+                            handles.append(
+                                reg.submit("m", feats(2, seed=i)))
+                    except ModelNotFoundError:
+                        pass            # nothing loaded yet
+                    except Exception as e:   # admission shed = outcome
+                        submit_errors.append(e)
+                    i += 1
+                    time.sleep(0.02)
+
+            t = threading.Thread(target=traffic, daemon=True)
+            t.start()
+
+            def driver(faults):
+                return LifecycleDriver(
+                    reg, "m", trainer, state_dir, eval_x=feats(16),
+                    shapes=[(NIN,)], canary_fraction=0.25,
+                    observe_ticks=2, confirm_ticks=1,
+                    tick_interval=0.05, faults=faults,
+                    trainer_process=proc)
+
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                drv = driver(plan)
+                with pytest.raises(TrainerKilledError):
+                    drv.run(5)
+                # mid-roll SIGKILL: trainer dead, registry consistent
+                assert proc.poll() is not None and proc.returncode == -9
+                assert reg.active_version("m") == 2
+
+                drv2 = driver(plan)
+                assert drv2.resumed     # resumed from checkpointed state
+                drv2.run(4)             # finish the interrupted round 4
+                assert reg.active_version("m") == 3
+                # bit-identical pre-roll incumbent evidence
+                probe = feats(8, seed=99)
+                pre_roll = np.asarray(reg.output("m", probe))
+
+                drv2.run(5)             # round 5: promote v4 -> SLO
+                #                         regression -> auto-rollback
+            stop.set()
+            t.join(5.0)
+
+            # (1) registry serves the LAST GOOD version
+            assert reg.active_version("m") == 3
+            assert drv2.incumbent_version == 3
+            assert drv2.rollbacks == 1
+            assert [q["reason"] for q in drv2.quarantined] == \
+                ["gate:non_finite_outputs", "slo_regression"]
+
+            # (2) rollback is bit-identical to the pre-roll incumbent
+            post_roll = np.asarray(reg.output("m", probe))
+            np.testing.assert_array_equal(pre_roll, post_roll)
+            np.testing.assert_array_equal(
+                post_roll, linear_model(0.004)(probe))
+
+            # (3) zero dropped requests: every admitted request resolved
+            # exactly once; every rejection was a structured outcome
+            assert handles, "traffic thread never submitted"
+            for h in handles:
+                try:
+                    h.get(15.0)
+                except Exception:
+                    pass                # structured outcome, not a drop
+                assert h.resolutions == 1
+            from deeplearning4j_tpu.serving import ServingError
+            assert all(isinstance(e, ServingError)
+                       for e in submit_errors)
+
+            # (4) zero steady-state recompiles, trainer and registry on
+            # one mesh: the fit signature set never grew after warmup,
+            # and no version's server compiled past its own warmup
+            assert det.signature_count("MultiLayerNetwork.fit",
+                                       owner=net) == fit_sigs
+            for v in reg.models()["m"]["versions"]:
+                assert reg.server("m", v).recompiles_after_warmup() == 0
+            assert not det.diagnostics_for(net)
+
+            # (5) driver state machine ends clean and idle
+            st = DriverStateStore(state_dir).load()
+            assert st["phase"] == "idle" and st["in_round"] is None
+            assert st["round"] == 5
+        finally:
+            stop.set()
+            if proc.poll() is None:
+                proc.kill()
+            reg.close()
+
+    def test_capture_doubles_as_chaos_input(self, tmp_path):
+        """Captured live traffic replays as a deterministic ServingLoad
+        against a fresh registry — the capture IS the chaos input."""
+        path = str(tmp_path / "cap.jsonl")
+        cap = TrafficCapture(path, sample_rate=1.0)
+        with quiet_registry(capture=cap) as reg:
+            reg.load("m", linear_model(0.0), shapes=[(NIN,)])
+            load = ServingLoad.seeded(11, mix="steady", n=30, rps=400.0,
+                                      max_rows=4)
+            outcomes = load.replay(
+                lambda x, deadline=None:
+                reg.submit("m", x, deadline=deadline), (NIN,))
+            for _spec, out in outcomes:
+                assert not isinstance(out, Exception)
+                out.get(10.0)
+        assert cap.captured == 30
+        replay = TrafficCapture.to_serving_load(path)
+        assert [s.rows for s in replay.specs] == \
+            [s.rows for s in load.specs]
+        with quiet_registry() as reg2:
+            reg2.load("m", linear_model(0.5), shapes=[(NIN,)])
+            outcomes = replay.replay(
+                lambda x, deadline=None:
+                reg2.submit("m", x, deadline=deadline), (NIN,),
+                time_scale=0.5)
+            for _spec, out in outcomes:
+                assert not isinstance(out, Exception)
+                assert out.get(10.0) is not None
+                assert out.resolutions == 1

@@ -326,21 +326,64 @@ class TestNonfiniteProvenance:
         assert hasattr(nn_pkg.layers, "DenseLayer")
         assert nn_pkg.PrecisionPolicy is PrecisionPolicy
 
-    def test_tbptt_fit_warns_policy_ignored(self):
+    def _tbptt_net(self, seed=7):
         from deeplearning4j_tpu.nn.layers import LSTM, RnnOutputLayer
-        conf = (NeuralNetConfiguration.Builder().seed(7).updater(Adam(0.01))
-                .list()
-                .layer(LSTM(nOut=8))
-                .layer(RnnOutputLayer(nOut=2, lossFunction="mcxent"))
+        conf = (NeuralNetConfiguration.Builder().seed(seed)
+                .updater(Adam(0.01)).weightInit("xavier").list()
+                .layer(LSTM(nOut=8, activation="tanh"))
+                .layer(RnnOutputLayer(nOut=2, lossFunction="mcxent",
+                                      activation="softmax"))
                 .setInputType(InputType.recurrent(4, 8))
                 .backpropType("tbptt", tbpttLength=4).build())
-        rng = np.random.RandomState(0)
-        x = rng.randn(4, 4, 8).astype(np.float32)
-        y = np.zeros((4, 2, 8), np.float32)
-        y[:, 0, :] = 1.0
-        net = MultiLayerNetwork(conf).init()
-        with pytest.warns(UserWarning, match="TBPTT.*PrecisionPolicy"):
-            net.fit(x, y, epochs=1, precision="bf16")
+        return MultiLayerNetwork(conf).init()
+
+    def _tbptt_data(self, n=4, seed=0):
+        rng = np.random.RandomState(seed)
+        x = rng.randn(n, 4, 8).astype(np.float32)
+        y = np.zeros((n, 2, 8), np.float32)
+        y[np.arange(n), rng.randint(0, 2, n), :] = 1.0
+        return x, y
+
+    def test_tbptt_honors_precision_policy(self):
+        """ISSUE 20 satellite (PR 11 carry closed): the compiled TBPTT
+        step honors the attached PrecisionPolicy — policy_cast + loss
+        scaling per segment, no warning, bf16 loss parity vs fp32."""
+        import warnings as _w
+        x, y = self._tbptt_data()
+        n32 = self._tbptt_net()
+        n32.fit(x, y, epochs=3)
+        nbf = self._tbptt_net()
+        with _w.catch_warnings():
+            _w.simplefilter("error")        # the old warning is GONE
+            nbf.fit(x, y, epochs=3, precision="bf16")
+        l32, lbf = float(n32.score()), float(nbf.score())
+        assert np.isfinite(lbf)
+        assert abs(l32 - lbf) / abs(l32) < 0.05, (l32, lbf)
+        # master params stay fp32 under the policy
+        assert str(nbf._params[0]["W"].dtype) == "float32"
+        # fp16 static loss scaling survives the segment backward too
+        n16 = self._tbptt_net()
+        n16.fit(x, y, epochs=3,
+                precision=PrecisionPolicy("float16", loss_scale=1024.0))
+        assert abs(l32 - float(n16.score())) / abs(l32) < 0.15
+
+    def test_tbptt_policy_zero_steady_state_recompiles(self):
+        """The policy keys the TBPTT step cache, it does not churn it:
+        exactly two signatures (carried-state pytree None -> materialized
+        on each batch's first segment) however many epochs run."""
+        from deeplearning4j_tpu.analysis.churn import get_churn_detector
+        det = get_churn_detector()
+        x, y = self._tbptt_data()
+        net = self._tbptt_net()
+        net.setPrecisionPolicy("bf16")
+        net.fit(x, y, epochs=2)
+        after_warm = det.signature_count("MultiLayerNetwork.tbptt",
+                                         owner=net)
+        assert after_warm == 2, after_warm
+        net.fit(x, y, epochs=3)
+        assert det.signature_count("MultiLayerNetwork.tbptt",
+                                   owner=net) == after_warm
+        assert not det.diagnostics_for(net)
 
     def test_mid_dispatch_poison_fires_at_next_boundary(self):
         """Review regression: a poison planned for a mid-megastep step
